@@ -1,0 +1,463 @@
+"""Decoder — the consuming end of a replication session.
+
+Capability parity with the reference Decoder (reference: decode.js:63-262),
+re-designed as a push-based incremental parser with an explicit pending
+counter instead of Node Writable plumbing:
+
+* :meth:`write` feeds wire bytes; the internal state machine is
+  header → (change | blob payload) → header …, slicing without copying on the
+  fast path (reference keeps the same discipline, decode.js:217-227,198-201).
+* Handlers are registered with :meth:`change` / :meth:`blob` /
+  :meth:`finalize` (same registration-style API as the reference,
+  decode.js:112-122). Each handler receives a ``done`` callable;
+  **backpressure**: while any ``done`` is outstanding, parsing pauses and
+  :meth:`write` returns ``False`` — the analogue of the reference withholding
+  the Writable's callback (reference: decode.js:87-99,168).
+* Unregistered handlers never deadlock the pipeline: changes are dropped,
+  blobs drained, finalize auto-acked (reference: decode.js:50-61).
+* :meth:`end` invokes the finalize handler after all prior frames are
+  consumed, before the session completes — the sentinel-write trick of the
+  reference (decode.js:6,124-142) becomes an explicit queued finalization.
+* Unknown frame type ids destroy the session with
+  :class:`~..wire.framing.ProtocolError` (reference: decode.js:159-161).
+* Counters ``bytes`` / ``changes`` / ``blobs`` (reference: decode.js:68-70).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..wire.change_codec import Change, decode_change
+from ..wire.framing import MAX_HEADER_LEN, TYPE_BLOB, TYPE_CHANGE, TYPE_HEADER, ProtocolError
+from ..wire.varint import decode_uvarint
+
+OnDone = Optional[Callable[[], None]]
+
+
+class DecoderDestroyedError(Exception):
+    pass
+
+
+class BlobReader:
+    """Read side of one streamed blob, handed to the app's blob handler.
+
+    Chunks are delivered through :meth:`on_data` as they are parsed; chunks
+    arriving before a handler is registered are buffered and replayed at
+    registration (the Readable-buffer behavior of the reference's BlobStream,
+    reference: decode.js:8-48). :meth:`pause` / :meth:`resume` give the app
+    per-chunk backpressure: while paused the decoder stops parsing, which
+    propagates to the transport.
+    """
+
+    def __init__(self, decoder: "Decoder", length: int):
+        self._decoder = decoder
+        self.length = length
+        self.received = 0
+        self.ended = False
+        self.destroyed = False
+        self._data_cb: Optional[Callable[[bytes], None]] = None
+        self._end_cbs: list[Callable[[], None]] = []
+        self._buffered: list[bytes] = []
+        self._paused = False
+
+    def on_data(self, cb: Callable[[bytes], None]) -> "BlobReader":
+        self._data_cb = cb
+        if self._buffered:
+            chunks, self._buffered = self._buffered, []
+            for c in chunks:
+                cb(c)
+        return self
+
+    def on_end(self, cb: Callable[[], None]) -> "BlobReader":
+        if self.ended:
+            cb()
+        else:
+            self._end_cbs.append(cb)
+        return self
+
+    def collect(self, cb: Callable[[bytes], None]) -> "BlobReader":
+        """Convenience: buffer the whole blob and deliver it once on end —
+        the role `concat-stream` plays in the reference suite
+        (reference: test/basic.js:36-40)."""
+        parts: list[bytes] = []
+        self.on_data(parts.append)
+        self.on_end(lambda: cb(b"".join(parts)))
+        return self
+
+    def pause(self) -> None:
+        """Stop the decoder from parsing further input (chunk granularity)
+        until :meth:`resume` — per-chunk backpressure, the analogue of the
+        reference's Readable drain accounting (reference: decode.js:35-48)."""
+        if self._paused:
+            return
+        self._paused = True
+        self._decoder._paused_readers += 1
+
+    def resume(self) -> None:
+        if not self._paused:
+            return
+        self._paused = False
+        self._decoder._paused_readers -= 1
+        self._decoder._resume()
+
+    def destroy(self, err: Exception | None = None) -> None:
+        """Destroying a blob reader tears down the whole session
+        (reference: decode.js:20-26)."""
+        if self.destroyed:
+            return
+        self.destroyed = True
+        self._decoder.destroy(err)
+
+    # -- driven by the decoder ---------------------------------------------
+
+    def _deliver(self, chunk: bytes) -> None:
+        self.received += len(chunk)
+        if self._data_cb is not None:
+            self._data_cb(chunk)
+        else:
+            self._buffered.append(chunk)
+
+    def _finish(self) -> None:
+        self.ended = True
+        cbs, self._end_cbs = self._end_cbs, []
+        for cb in cbs:
+            cb()
+
+
+def _drain_blob(blob: BlobReader, done: Callable[[], None]) -> None:
+    """Default blob handler: consume and discard (reference: decode.js:58-61)."""
+    blob.on_end(done)
+
+
+class Decoder:
+    """Push-based incremental wire parser. See module docstring."""
+
+    def __init__(self):
+        self.bytes = 0
+        self.changes = 0
+        self.blobs = 0
+        self.destroyed = False
+        self.finished = False
+        self._on_change: Callable[[Change, Callable[[], None]], None] | None = None
+        self._on_blob: Callable[[BlobReader, Callable[[], None]], None] | None = None
+        self._on_finalize: Callable[[Callable[[], None]], None] | None = None
+        self._error_cbs: list[Callable[[Exception | None], None]] = []
+        self._finish_cbs: list[Callable[[], None]] = []
+
+        # parser state
+        self._state = TYPE_HEADER
+        self._header = bytearray()  # accumulating varint+id bytes
+        self._missing = 0  # payload bytes still to consume
+        self._payload_parts: list[bytes] | None = None  # change slow path
+        self._current_blob: BlobReader | None = None
+
+        # flow control
+        self._pending = 0
+        self._paused_readers = 0
+        self._overflow: list[memoryview] = []  # unparsed input, in order
+        self._write_cbs: list[Callable[[], None]] = []
+        self._end_queued = False
+        self._end_cb: OnDone = None
+        self._consuming = False  # reentrancy guard for _consume
+
+    # -- handler registration (same shape as the reference API) -------------
+
+    def change(self, cb: Callable[[Change, Callable[[], None]], None]) -> "Decoder":
+        self._on_change = cb
+        return self
+
+    def blob(self, cb: Callable[[BlobReader, Callable[[], None]], None]) -> "Decoder":
+        self._on_blob = cb
+        return self
+
+    def finalize(self, cb: Callable[[Callable[[], None]], None]) -> "Decoder":
+        self._on_finalize = cb
+        return self
+
+    def on_error(self, cb: Callable[[Exception | None], None]) -> "Decoder":
+        self._error_cbs.append(cb)
+        return self
+
+    def on_finish(self, cb: Callable[[], None]) -> "Decoder":
+        if self.finished:
+            cb()
+        else:
+            self._finish_cbs.append(cb)
+        return self
+
+    # -- write side ---------------------------------------------------------
+
+    def write(self, data, on_consumed: OnDone = None) -> bool:
+        """Feed wire bytes. Returns True if fully consumed synchronously;
+        False if parsing stalled on an outstanding ``done`` (the
+        ``on_consumed`` callback then fires when the app drains —
+        reference: decode.js:124-133,168)."""
+        if self.destroyed:
+            raise DecoderDestroyedError("write after destroy")
+        if self.finished or self._end_queued:
+            raise DecoderDestroyedError("write after end")
+        data = memoryview(data.encode("utf-8") if isinstance(data, str) else data)
+        self.bytes += len(data)
+        if len(data):
+            self._overflow.append(data)
+        self._consume()
+        if self._overflow or self._stalled():
+            if on_consumed is not None:
+                self._write_cbs.append(on_consumed)
+            return False
+        if on_consumed is not None:
+            on_consumed()
+        return True
+
+    def end(self, on_finished: OnDone = None) -> None:
+        """Graceful end: after all prior frames are consumed, the finalize
+        handler runs, then the session finishes (reference: decode.js:135-142)."""
+        if self.destroyed:
+            raise DecoderDestroyedError("end after destroy")
+        if self._end_queued or self.finished:
+            return
+        self._end_queued = True
+        self._end_cb = on_finished
+        self._maybe_finalize()
+
+    def destroy(self, err: Exception | None = None) -> None:
+        """Fail-fast teardown, cascading to a live blob reader
+        (reference: decode.js:104-110)."""
+        if self.destroyed:
+            return
+        self.destroyed = True
+        blob, self._current_blob = self._current_blob, None
+        if blob is not None and not blob.destroyed:
+            blob.destroyed = True
+        self._overflow.clear()
+        for cb in self._error_cbs:
+            cb(err)
+        # Release parked write-completion callbacks so a transport blocked on
+        # "consumed" wakes up and observes the destroyed state (Node errors
+        # the pending Writable callback for the same reason).
+        cbs, self._write_cbs = self._write_cbs, []
+        for cb in cbs:
+            cb()
+
+    def writable(self) -> bool:
+        return not (self._stalled() or self._overflow or self.destroyed or self.finished)
+
+    # -- flow control --------------------------------------------------------
+
+    def _stalled(self) -> bool:
+        return self._pending > 0 or self._paused_readers > 0
+
+    def _up(self) -> Callable[[], None]:
+        """Create a one-shot ``done`` for an app callback; parsing pauses
+        while any are outstanding (reference: decode.js:87-99)."""
+        self._pending += 1
+        fired = False
+
+        def done() -> None:
+            nonlocal fired
+            if fired:
+                return
+            fired = True
+            self._pending -= 1
+            self._resume()
+
+        return done
+
+    def _resume(self) -> None:
+        if self.destroyed or self._stalled():
+            return
+        self._consume()
+        if not self._overflow and not self._stalled():
+            cbs, self._write_cbs = self._write_cbs, []
+            for cb in cbs:
+                cb()
+            self._maybe_finalize()
+
+    def _maybe_finalize(self) -> None:
+        if (
+            not self._end_queued
+            or self.finished
+            or self.destroyed
+            or self._overflow
+            or self._stalled()
+        ):
+            return
+        if self._state != TYPE_HEADER or self._header:
+            self.destroy(ProtocolError("stream ended mid-frame"))
+            return
+        self._end_queued = False  # run once
+
+        def finish() -> None:
+            self.finished = True
+            cb, self._end_cb = self._end_cb, None
+            if cb is not None:
+                cb()
+            cbs, self._finish_cbs = self._finish_cbs, []
+            for fcb in cbs:
+                fcb()
+
+        if self._on_finalize is not None:
+            self._on_finalize(finish)
+        else:
+            finish()
+
+    # -- parser --------------------------------------------------------------
+
+    def _consume(self) -> None:
+        """Main parse loop: drain overflow while the app is keeping up
+        (reference: decode.js:144-169).
+
+        Guarded against reentrancy: a handler that acks synchronously while
+        the loop holds a chunk's unparsed remainder in a local must not
+        re-enter and pop the *next* queued chunk out of order — the guard
+        makes the nested resume a no-op and the outer loop carries on.
+        """
+        if self._consuming:
+            return
+        self._consuming = True
+        try:
+            while self._overflow and not self._stalled() and not self.destroyed:
+                chunk = self._overflow.pop(0)
+                rest = self._consume_chunk(chunk)
+                if self.destroyed:
+                    return
+                if rest is not None and len(rest):
+                    self._overflow.insert(0, rest)
+        finally:
+            self._consuming = False
+
+    def _consume_chunk(self, chunk: memoryview) -> memoryview | None:
+        if self._state == TYPE_HEADER:
+            return self._scan_header(chunk)
+        if self._state == TYPE_CHANGE:
+            return self._change_data(chunk)
+        if self._state == TYPE_BLOB:
+            return self._blob_data(chunk)
+        raise AssertionError(f"bad parser state {self._state}")
+
+    def _scan_header(self, chunk: memoryview) -> memoryview | None:
+        """Byte-at-a-time varint scan; the byte after the varint is the type
+        id (reference: decode.js:251-262). Bounded at MAX_HEADER_LEN."""
+        i = 0
+        n = len(chunk)
+        while i < n:
+            self._header.append(chunk[i])
+            i += 1
+            # varint terminated iff the *previous* byte had its MSB clear and
+            # we now also hold the id byte.
+            if len(self._header) >= 2 and not (self._header[-2] & 0x80):
+                framed_len, _ = decode_uvarint(self._header)
+                type_id = self._header[-1]
+                self._header.clear()
+                self._missing = framed_len - 1  # length counts the id byte
+                if framed_len < 1:
+                    self.destroy(ProtocolError("frame length must be >= 1"))
+                    return None
+                if type_id == TYPE_CHANGE:
+                    self._state = TYPE_CHANGE
+                    self._payload_parts = None
+                elif type_id == TYPE_BLOB:
+                    self._state = TYPE_BLOB
+                    self._current_blob = None
+                    self._open_blob_if_ready()
+                else:
+                    self.destroy(
+                        ProtocolError(f"Protocol error, unknown type: {type_id}")
+                    )
+                    return None
+                return chunk[i:]
+            if len(self._header) >= MAX_HEADER_LEN:
+                self.destroy(ProtocolError("frame header too long"))
+                return None
+        return None
+
+    # -- change frames -------------------------------------------------------
+
+    def _change_data(self, chunk: memoryview) -> memoryview | None:
+        if self._payload_parts is None and len(chunk) >= self._missing:
+            # fast path: whole payload inside one chunk — zero-copy slice
+            # (reference: decode.js:217-227)
+            payload = chunk[: self._missing]
+            rest = chunk[self._missing :]
+            self._missing = 0
+            self._finish_change(payload)
+            return rest
+        # slow path: accumulate across chunk boundaries (reference:
+        # decode.js:229-248)
+        if self._payload_parts is None:
+            self._payload_parts = []
+        take = min(len(chunk), self._missing)
+        self._payload_parts.append(bytes(chunk[:take]))
+        self._missing -= take
+        rest = chunk[take:]
+        if self._missing == 0:
+            parts, self._payload_parts = self._payload_parts, None
+            self._finish_change(b"".join(parts))
+        return rest
+
+    def _finish_change(self, payload) -> None:
+        try:
+            change = decode_change(payload)
+        except ValueError as e:
+            self.destroy(ProtocolError(str(e)))
+            return
+        self.changes += 1
+        self._state = TYPE_HEADER
+        if self._on_change is not None:
+            self._on_change(change, self._up())
+        # default: drop (reference: decode.js:54-56)
+
+    # -- blob frames ---------------------------------------------------------
+
+    def _open_blob_if_ready(self) -> None:
+        """Create the reader and invoke the app handler.
+
+        The blob-level ``done`` does NOT gate parsing of the blob's own
+        payload — the reference hands the handler ``_down`` without a matching
+        ``_up`` and instead increments pending at blob END
+        (reference: decode.js:171-177,182), so frames *after* the blob wait
+        for the app's ack. The latch below reproduces exactly that pairing.
+        (The reference defers reader creation to the first payload byte,
+        decode.js:180-184; creating at header time additionally supports
+        zero-length blobs.)"""
+        blob = BlobReader(self, self._missing)
+        self._current_blob = blob
+        self.blobs += 1
+        latch = {"ended": False, "acked": False}
+        blob._pending_latch = latch
+
+        def done() -> None:
+            if latch["acked"]:
+                return
+            latch["acked"] = True
+            if latch["ended"]:
+                self._pending -= 1
+                self._resume()
+
+        handler = self._on_blob if self._on_blob is not None else _drain_blob
+        handler(blob, done)
+        if self._missing == 0:
+            self._end_blob()
+
+    def _blob_data(self, chunk: memoryview) -> memoryview | None:
+        blob = self._current_blob
+        assert blob is not None
+        take = min(len(chunk), self._missing)
+        self._missing -= take
+        blob._deliver(bytes(chunk[:take]))
+        rest = chunk[take:]
+        if self._missing == 0:
+            self._end_blob()
+        return rest
+
+    def _end_blob(self) -> None:
+        blob, self._current_blob = self._current_blob, None
+        self._state = TYPE_HEADER
+        if blob is not None:
+            # Hold the pipeline until the app acks the blob — the
+            # `_pending++` of the reference's _onblobend (decode.js:171-177).
+            latch = blob._pending_latch
+            if not latch["acked"]:
+                latch["ended"] = True
+                self._pending += 1
+            blob._finish()

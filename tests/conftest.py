@@ -1,0 +1,15 @@
+"""Test env: force a virtual 8-device CPU mesh before JAX initializes.
+
+Multi-chip hardware is unavailable in CI; sharding tests run against
+``--xla_force_host_platform_device_count=8`` on the CPU backend, which
+exercises the same mesh/collective code paths XLA uses on real ICI.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,114 @@
+import pytest
+
+from dat_replication_protocol_tpu.wire.change_codec import (
+    Change,
+    decode_change,
+    encode_change,
+)
+
+
+def test_roundtrip_basic():
+    c = Change(key="key", change=1, from_=0, to=1, value=b"hello")
+    out = decode_change(encode_change(c))
+    # decoded optionals default to '' / b'' — matches the reference suite's
+    # expectation of `subset: ''` (reference: test/basic.js:10-17)
+    assert out == Change(key="key", change=1, from_=0, to=1, value=b"hello", subset="")
+
+
+def test_roundtrip_dict_with_from_keyword():
+    d = {"key": "some-row", "change": 7, "from": 3, "to": 4, "value": b"v", "subset": "s"}
+    out = decode_change(encode_change(d))
+    assert out.to_dict() == {
+        "subset": "s",
+        "key": "some-row",
+        "change": 7,
+        "from": 3,
+        "to": 4,
+        "value": b"v",
+    }
+
+
+def test_golden_bytes_no_optionals():
+    # Hand-computed proto2 encoding: key(2)="key", change(3)=1, from(4)=0, to(5)=1
+    c = Change(key="key", change=1, from_=0, to=1)
+    assert encode_change(c) == b"\x12\x03key\x18\x01\x20\x00\x28\x01"
+
+
+def test_golden_bytes_all_fields():
+    c = Change(key="k", change=300, from_=1, to=2, value=b"\x00\xff", subset="s")
+    assert (
+        encode_change(c)
+        == b"\x0a\x01s" + b"\x12\x01k" + b"\x18\xac\x02" + b"\x20\x01" + b"\x28\x02" + b"\x32\x02\x00\xff"
+    )
+
+
+def test_matches_google_protobuf_if_available():
+    """Cross-check byte-compatibility against the canonical protobuf runtime."""
+    pytest.importorskip("google.protobuf")
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "change_xcheck.proto"
+    fdp.syntax = "proto2"
+    msg = fdp.message_type.add()
+    msg.name = "Change"
+    fields = [
+        ("subset", 1, "TYPE_STRING", "LABEL_OPTIONAL"),
+        ("key", 2, "TYPE_STRING", "LABEL_REQUIRED"),
+        ("change", 3, "TYPE_UINT32", "LABEL_REQUIRED"),
+        ("from", 4, "TYPE_UINT32", "LABEL_REQUIRED"),
+        ("to", 5, "TYPE_UINT32", "LABEL_REQUIRED"),
+        ("value", 6, "TYPE_BYTES", "LABEL_OPTIONAL"),
+    ]
+    for name, num, ftype, label in fields:
+        f = msg.field.add()
+        f.name = name
+        f.number = num
+        f.type = getattr(descriptor_pb2.FieldDescriptorProto, ftype)
+        f.label = getattr(descriptor_pb2.FieldDescriptorProto, label)
+    pool.Add(fdp)
+    cls = message_factory.GetMessageClass(pool.FindMessageTypeByName("Change"))
+
+    m = cls()
+    m.key = "row-1"
+    m.change = 9
+    setattr(m, "from", 123456)
+    m.to = 123457
+    m.value = b"payload \x00 bytes"
+    m.subset = "sub"
+    golden = m.SerializeToString()
+
+    ours = encode_change(
+        Change(key="row-1", change=9, from_=123456, to=123457, value=b"payload \x00 bytes", subset="sub")
+    )
+    assert ours == golden
+
+    out = decode_change(golden)
+    assert out.key == "row-1" and out.from_ == 123456 and out.to == 123457
+
+
+def test_unknown_fields_skipped():
+    base = encode_change(Change(key="k", change=1, from_=0, to=1))
+    # append unknown field 7 (varint) and field 8 (fixed32)
+    extra = b"\x38\x2a" + b"\x45\x01\x02\x03\x04"
+    out = decode_change(base + extra)
+    assert out.key == "k"
+
+
+def test_missing_required_rejected():
+    with pytest.raises(ValueError):
+        decode_change(b"\x18\x01")  # only change=1
+
+
+def test_uint32_range_enforced():
+    with pytest.raises(ValueError):
+        encode_change(Change(key="k", change=2**32, from_=0, to=1))
+    with pytest.raises(ValueError):
+        encode_change(Change(key="k", change=-1, from_=0, to=1))
+
+
+def test_utf8_and_binary_values():
+    c = Change(key="ключ-🔑", change=1, from_=0, to=1, value=bytes(range(256)), subset="αβ")
+    out = decode_change(encode_change(c))
+    assert out.key == "ключ-🔑" and out.value == bytes(range(256)) and out.subset == "αβ"

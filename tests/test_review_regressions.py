@@ -1,0 +1,159 @@
+"""Regression tests for defects found in review: parser reentrancy, parked
+backpressure accounting, str writes, Pipe.done liveness, required-field
+enforcement, destroy notification, and the backend='tpu' entry point."""
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.wire.change_codec import Change, encode_change
+
+
+def test_parser_reentrancy_synchronous_done_across_parked_chunks():
+    """A handler acking synchronously while parsing resumes mid-chunk must not
+    reorder parked chunks (reentrancy into _consume)."""
+    e = protocol.encode()
+    for i in range(4):
+        e.change({"key": f"k{i}", "change": i, "from": 0, "to": 1, "value": b"x" * 40})
+    e.finalize()
+    wire = bytearray()
+    while (c := e.read()) not in (None, b""):
+        wire += c
+
+    d = protocol.decode()
+    got = []
+    held = []
+
+    def on_change(c, done):
+        got.append(c.key)
+        if c.key == "k0":
+            held.append(done)  # defer only the first; rest ack synchronously
+        else:
+            done()
+
+    d.change(on_change)
+    # split so frame boundaries straddle the parked chunks
+    third = len(wire) // 3
+    d.write(wire[:third])
+    d.write(wire[third : 2 * third])
+    d.write(wire[2 * third :])
+    assert got == ["k0"]
+    held.pop()()  # releasing must parse the remaining frames in order
+    d.end()
+    assert got == ["k0", "k1", "k2", "k3"]
+    assert d.finished and not d.destroyed
+
+
+def test_parked_blob_bytes_count_toward_high_water():
+    e = protocol.encode(high_water=64)
+    e.blob(1000)  # head blob, streams slowly
+    b2 = e.blob(100)
+    assert b2.write(b"x" * 100) is False  # parked bytes must apply backpressure
+    assert e.buffered_bytes + e._parked_bytes >= 64
+
+
+def test_parked_change_bytes_count_toward_high_water():
+    e = protocol.encode(high_water=64)
+    e.blob(1000)
+    ok = e.change({"key": "k" * 100, "change": 1, "from": 0, "to": 1})
+    assert ok is False
+
+
+def test_str_writes_accepted_everywhere():
+    e = protocol.encode()
+    d = protocol.decode()
+    got = []
+    d.blob(lambda blob, done: blob.collect(lambda x: (got.append(x), done())))
+    b = e.blob(11)
+    b.write("hello ")
+    b.end("world")
+    e.finalize()
+    protocol.pipe(e, d)
+    assert got == [b"hello world"]
+    # decoder str input
+    d2 = protocol.decode()
+    assert d2.write("") is True
+
+
+def test_pipe_done_reflects_late_finalize_ack():
+    e = protocol.encode()
+    d = protocol.decode()
+    fin = []
+    d.finalize(lambda done: fin.append(done))
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    p = protocol.pipe(e, d)
+    assert p.done is False
+    fin.pop()()
+    assert d.finished and p.done is True
+
+
+def test_from_dict_missing_from_raises():
+    with pytest.raises(KeyError):
+        encode_change({"key": "k", "change": 1, "to": 5})
+
+
+def test_destroy_releases_parked_write_callbacks():
+    e = protocol.encode()
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1, "value": b"v"})
+    e.change({"key": "bad", "change": 2, "from": 0, "to": 1})
+    e.finalize()
+    wire = bytearray()
+    while (c := e.read()) not in (None, b""):
+        wire += c
+    wire += bytes(protocol.wire.frame(9, b"zz"))  # trailing garbage frame
+
+    d = protocol.decode()
+    held = []
+    woke = []
+    d.change(lambda c, done: held.append(done))
+    d.on_error(lambda err: None)
+    d.write(bytes(wire), on_consumed=lambda: woke.append("consumed"))
+    assert woke == []  # stalled on held done
+    held.pop()()  # resumes parsing; second change stalls again
+    held.pop()()  # resumes; garbage frame destroys the session
+    assert d.destroyed
+    assert woke == ["consumed"]  # parked write cb released on destroy
+
+
+def test_tpu_backend_entry_points_work():
+    e = protocol.encode(backend="tpu")
+    d = protocol.decode(backend="tpu")
+    digests = []
+    d.on_digest(lambda kind, seq, dg: digests.append((kind, seq, dg)))
+    order = []
+    d.change(lambda c, done: (order.append("change"), done()))
+    d.blob(lambda blob, done: blob.collect(lambda x: (order.append("blob"), done())))
+    d.finalize(lambda done: (order.append("finalize"), done()))
+
+    b = e.blob(11)
+    b.write(b"hello ")
+    b.end(b"world")
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1, "value": b"v"})
+    e.finalize()
+    protocol.pipe(e, d)
+
+    assert d.finished
+    # flush-before-finalize: digests delivered before the finalize hook
+    assert order == ["blob", "change", "finalize"]
+    import hashlib
+
+    expect_blob = hashlib.blake2b(b"hello world", digest_size=32).digest()
+    by_kind = {(k, s): dg for k, s, dg in digests}
+    assert by_kind[("blob", 0)] == expect_blob
+    assert ("change", 0) in by_kind
+
+
+def test_tpu_encoder_digests_match_decoder():
+    e = protocol.encode(backend="tpu")
+    enc_digests = []
+    e.on_digest(lambda kind, seq, dg: enc_digests.append((kind, seq, dg)))
+    b = e.blob(5)
+    b.end(b"12345")
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+
+    d = protocol.decode(backend="tpu")
+    dec_digests = []
+    d.on_digest(lambda kind, seq, dg: dec_digests.append((kind, seq, dg)))
+    protocol.pipe(e, d)
+    assert sorted(enc_digests) == sorted(dec_digests)

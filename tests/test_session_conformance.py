@@ -1,0 +1,107 @@
+"""Conformance port of the reference suite (reference: test/basic.js:1-127).
+
+Each test mirrors one tape test: construct a real Encoder and Decoder, pipe
+them together in-process, and assert the decoded callbacks — loopback piping
+is the fake backend, exactly as in the reference.
+"""
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.wire.change_codec import Change
+
+
+def test_encode_decode_changes():
+    # reference: test/basic.js:5-30
+    e = protocol.encode()
+    d = protocol.decode()
+    got = []
+
+    d.change(lambda change, done: (got.append(change), done()))
+
+    e.change({"key": "key", "from": 0, "to": 1, "change": 1, "value": b"hello"})
+    e.finalize()
+    protocol.pipe(e, d)
+
+    assert got == [
+        Change(key="key", from_=0, to=1, change=1, value=b"hello", subset="")
+    ]
+
+
+def test_encode_decode_blob():
+    # reference: test/basic.js:32-51
+    e = protocol.encode()
+    d = protocol.decode()
+    got = []
+
+    def on_blob(blob, done):
+        blob.collect(lambda data: (got.append(data), done()))
+
+    d.blob(on_blob)
+
+    blob = e.blob(11)
+    blob.write(b"hello ")
+    blob.write(b"world")
+    blob.end()
+    e.finalize()
+    protocol.pipe(e, d)
+
+    assert got == [b"hello world"]
+    assert len(got[0]) == 11
+
+
+def test_encode_decode_mixed_blobs():
+    # reference: test/basic.js:53-84 — the concurrency test: two blobs created
+    # before either is written, writes interleaved; both must arrive intact
+    # and in creation order (exercises cork/uncork, reference: encode.js:87-94).
+    e = protocol.encode()
+    d = protocol.decode()
+    expects = [b"hello world", b"HELLO WORLD"]
+    got = []
+
+    def on_blob(blob, done):
+        blob.collect(lambda data: (got.append(data), done()))
+
+    d.blob(on_blob)
+
+    b1 = e.blob(11)
+    b2 = e.blob(11)
+    b1.write(b"hello ")
+    b2.write(b"HELLO ")
+    b1.write(b"world")
+    b2.write(b"WORLD")
+    b1.end()
+    b2.end()
+    e.finalize()
+    protocol.pipe(e, d)
+
+    assert got == expects
+
+
+def test_encode_decode_blob_and_changes():
+    # reference: test/basic.js:86-127 — a change submitted while a blob is
+    # open must be parked and arrive after the blob (reference: encode.js:104-107).
+    e = protocol.encode()
+    d = protocol.decode()
+    order = []
+
+    def on_blob(blob, done):
+        blob.collect(lambda data: (order.append(("blob", data)), done()))
+
+    def on_change(change, done):
+        order.append(("change", change))
+        done()
+
+    d.blob(on_blob)
+    d.change(on_change)
+
+    blob = e.blob(11)
+    blob.write(b"hello ")
+    blob.write(b"world")
+    e.change({"key": "key", "from": 0, "to": 1, "change": 1, "value": b"hello"})
+    blob.end()
+    e.finalize()
+    protocol.pipe(e, d)
+
+    assert order == [
+        ("blob", b"hello world"),
+        ("change", Change(key="key", from_=0, to=1, change=1, value=b"hello", subset="")),
+    ]

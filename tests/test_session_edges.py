@@ -1,0 +1,359 @@
+"""Coverage for the reference suite's gaps (SURVEY §4): destroy/error paths,
+unknown-type protocol error, finalize callbacks, multi-byte varints (frames
+>127 bytes), chunk-boundary splits mid-header / mid-change, backpressure
+timing, ordering invariants, counters."""
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.session.encoder import BlobLengthError
+from dat_replication_protocol_tpu.wire import ProtocolError, frame, TYPE_CHANGE
+from dat_replication_protocol_tpu.wire.change_codec import Change, encode_change
+
+
+def wire_bytes(build):
+    """Run ``build(encoder)`` and return everything the encoder produced."""
+    e = protocol.encode()
+    build(e)
+    e.finalize()
+    out = bytearray()
+    while True:
+        data = e.read()
+        if data is None:
+            return bytes(out)
+        if not data:
+            return bytes(out)
+        out += data
+
+
+def feed_bytewise(d, data):
+    for i in range(len(data)):
+        d.write(data[i : i + 1])
+
+
+def test_large_frame_multibyte_varint_and_split_feeds():
+    # a change with a 4 KiB value ⇒ frame length needs a multi-byte varint
+    big = bytes(range(256)) * 16
+    data = wire_bytes(
+        lambda e: e.change({"key": "k" * 200, "change": 1, "from": 0, "to": 1, "value": big})
+    )
+    assert len(data) > 4096  # really is a multi-byte-varint frame
+
+    got = []
+    d = protocol.decode()
+    d.change(lambda c, done: (got.append(c), done()))
+    feed_bytewise(d, data)  # worst-case chunk boundaries: 1 byte at a time
+    d.end()
+    assert d.finished
+    assert got[0].value == big and got[0].key == "k" * 200
+
+
+def test_blob_split_across_every_boundary():
+    payload = bytes(range(251)) * 5  # 1255 bytes
+    data = wire_bytes(lambda e: (e.blob(len(payload)).end(payload)))
+    for chunk_size in (1, 2, 3, 7, 128, 1024):
+        got = []
+        d = protocol.decode()
+        d.blob(lambda b, done: b.collect(lambda x: (got.append(x), done())))
+        for i in range(0, len(data), chunk_size):
+            d.write(data[i : i + chunk_size])
+        d.end()
+        assert got == [payload], f"chunk_size={chunk_size}"
+
+
+def test_unknown_type_id_is_protocol_error():
+    # reference: decode.js:159-161
+    d = protocol.decode()
+    errs = []
+    d.on_error(lambda e: errs.append(e))
+    d.write(frame(7, b"xx"))
+    assert d.destroyed
+    assert isinstance(errs[0], ProtocolError)
+    assert "unknown type" in str(errs[0])
+
+
+def test_corrupt_change_payload_is_protocol_error():
+    d = protocol.decode()
+    errs = []
+    d.on_error(lambda e: errs.append(e))
+    d.write(frame(TYPE_CHANGE, b"\x18\x01"))  # missing required fields
+    assert d.destroyed and isinstance(errs[0], ProtocolError)
+
+
+def test_header_too_long_is_protocol_error():
+    d = protocol.decode()
+    errs = []
+    d.on_error(lambda e: errs.append(e))
+    d.write(b"\xff" * 11)
+    assert d.destroyed and isinstance(errs[0], ProtocolError)
+
+
+def test_end_mid_frame_is_protocol_error():
+    d = protocol.decode()
+    errs = []
+    d.on_error(lambda e: errs.append(e))
+    d.write(frame(TYPE_CHANGE, encode_change(Change(key="k", change=1, from_=0, to=1)))[:-2])
+    d.end()
+    assert d.destroyed and isinstance(errs[0], ProtocolError)
+
+
+def test_finalize_callback_order():
+    # finalize must run after all frames are consumed, before finish
+    # (reference: decode.js:124-142)
+    e = protocol.encode()
+    d = protocol.decode()
+    order = []
+    d.change(lambda c, done: (order.append("change"), done()))
+    d.finalize(lambda done: (order.append("finalize"), done()))
+    d.on_finish(lambda: order.append("finish"))
+
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    e.finalize(lambda: order.append("enc-flushed"))
+    protocol.pipe(e, d)
+
+    # encoder-side flush fires when bytes are *pulled* (the reference times it
+    # to the Readable drain, encode.js:147-151), so it precedes the decoder's
+    # handler; finalize runs after all frames, before finish.
+    assert order == ["enc-flushed", "change", "finalize", "finish"]
+
+
+def test_decoder_default_handlers_never_deadlock():
+    # reference: decode.js:50-61 — nothing registered: changes dropped,
+    # blobs drained, finalize auto-acked.
+    e = protocol.encode()
+    d = protocol.decode()
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    b = e.blob(5)
+    b.end(b"12345")
+    e.finalize()
+    protocol.pipe(e, d)
+    assert d.finished
+    assert d.changes == 1 and d.blobs == 1
+
+
+def test_deferred_done_backpressure_and_drain():
+    """A held `done` must stall the decoder (write -> False) and parsing must
+    resume exactly where it stopped when released (reference: decode.js:87-99,168)."""
+    e = protocol.encode()
+    d = protocol.decode()
+    got = []
+    held = []
+
+    d.change(lambda c, done: (got.append(c.key), held.append(done)))
+
+    for i in range(3):
+        e.change({"key": f"k{i}", "change": i, "from": 0, "to": 1})
+    e.finalize()
+    data = bytearray()
+    while (chunk := e.read()) not in (None, b""):
+        data += chunk
+
+    assert d.write(data) is False  # stalled on first change's done
+    assert got == ["k0"]
+    held.pop()()  # release first
+    assert got == ["k0", "k1"]
+    held.pop()()
+    assert got == ["k0", "k1", "k2"]
+    d.end()
+    assert not d.finished  # still one outstanding
+    held.pop()()
+    assert d.finished
+
+
+def test_blob_pause_resume_backpressure():
+    e = protocol.encode()
+    d = protocol.decode()
+    chunks = []
+    readers = []
+
+    def on_blob(blob, done):
+        readers.append(blob)
+        blob.on_data(lambda c: (chunks.append(c), blob.pause()))
+        blob.on_end(done)
+
+    d.blob(on_blob)
+    b = e.blob(6)
+    b.write(b"ab")
+    b.write(b"cd")
+    b.end(b"ef")
+    e.finalize()
+    p = protocol.pipe(e, d, chunk_size=2)
+    # paused after first delivered chunk
+    assert chunks and not d.finished
+    while not d.finished:
+        readers[0].resume()
+        p.pump()
+    assert b"".join(chunks) == b"abcdef"
+
+
+def test_encoder_flush_callbacks_fire_on_pull():
+    e = protocol.encode()
+    fired = []
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1}, on_flush=lambda: fired.append("change"))
+    b = e.blob(3, on_flush=lambda: fired.append("blob"))
+    b.end(b"xyz")
+    assert fired == []  # nothing pulled yet
+    e.read()
+    assert fired == ["change", "blob"]
+
+
+def test_changes_parked_behind_all_open_blobs():
+    """Changes submitted while two blobs are open arrive after BOTH."""
+    e = protocol.encode()
+    d = protocol.decode()
+    order = []
+    d.blob(lambda blob, done: blob.collect(lambda x: (order.append(x), done())))
+    d.change(lambda c, done: (order.append(c.key), done()))
+
+    b1 = e.blob(1)
+    b2 = e.blob(1)
+    e.change({"key": "parked", "change": 1, "from": 0, "to": 1})
+    b1.end(b"a")
+    e.change({"key": "parked2", "change": 2, "from": 0, "to": 1})  # b2 still open
+    b2.end(b"b")
+    e.finalize()
+    protocol.pipe(e, d)
+    assert order == [b"a", b"b", "parked", "parked2"]
+
+
+def test_blob_fifo_wire_order_with_interleaved_writes():
+    e = protocol.encode()
+    b1 = e.blob(4)
+    b2 = e.blob(4)
+    b2.write(b"BB")
+    b1.write(b"aa")
+    b2.end(b"BB")
+    b1.end(b"aa")
+    e.finalize()
+    d = protocol.decode()
+    got = []
+    d.blob(lambda blob, done: blob.collect(lambda x: (got.append(x), done())))
+    protocol.pipe(e, d)
+    assert got == [b"aaaa", b"BBBB"]  # creation order, not completion order
+
+
+def test_blob_overflow_destroys_session():
+    e = protocol.encode()
+    b = e.blob(3)
+    with pytest.raises(BlobLengthError):
+        b.write(b"toolong")
+    assert e.destroyed
+
+
+def test_blob_short_end_destroys_session():
+    e = protocol.encode()
+    b = e.blob(10)
+    b.write(b"abc")
+    with pytest.raises(BlobLengthError):
+        b.end()
+    assert e.destroyed
+
+
+def test_blob_zero_length_rejected_at_encoder():
+    # reference throws on falsy length (reference: encode.js:79)
+    e = protocol.encode()
+    with pytest.raises(ValueError):
+        e.blob(0)
+
+
+def test_destroy_cascades_encoder():
+    e = protocol.encode()
+    errs = []
+    e.on_error(lambda err: errs.append(err))
+    b1 = e.blob(5)
+    b2 = e.blob(5)
+    b1.destroy(RuntimeError("boom"))
+    assert e.destroyed and b2.destroyed
+    assert isinstance(errs[0], RuntimeError)
+
+
+def test_destroy_cascades_decoder_blob():
+    e = protocol.encode()
+    d = protocol.decode()
+    readers = []
+    d.blob(lambda blob, done: readers.append(blob))
+    b = e.blob(4)
+    b.write(b"ab")
+    # feed header + partial payload so a reader exists
+    d.write(e.read())
+    readers[0].destroy(RuntimeError("boom"))
+    assert d.destroyed
+
+
+def test_counters_match_both_sides():
+    # counters parity (reference: encode.js:51-53, decode.js:68-70)
+    e = protocol.encode()
+    d = protocol.decode()
+    d.blob(lambda blob, done: blob.on_end(done))
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    blob = e.blob(8)
+    blob.end(b"01234567")
+    e.change({"key": "k2", "change": 2, "from": 1, "to": 2})
+    e.finalize()
+    protocol.pipe(e, d)
+    assert e.changes == d.changes == 2
+    assert e.blobs == d.blobs == 1
+    assert e.bytes == d.bytes > 0
+
+
+def test_write_after_finalize_raises():
+    e = protocol.encode()
+    e.finalize()
+    with pytest.raises(Exception):
+        e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+
+
+def test_finalize_with_open_blob_raises():
+    e = protocol.encode()
+    e.blob(3)
+    with pytest.raises(Exception):
+        e.finalize()
+
+
+def test_many_frames_stress_roundtrip():
+    e = protocol.encode(high_water=1 << 20)
+    d = protocol.decode()
+    got = []
+    d.change(lambda c, done: (got.append(c), done()))
+    d.blob(lambda blob, done: blob.collect(lambda x: (got.append(x), done())))
+
+    import random
+
+    rng = random.Random(1234)
+    sent = []
+    p = protocol.pipe(e, d, chunk_size=777)
+    for i in range(500):
+        if rng.random() < 0.3:
+            n = rng.randrange(1, 2000)
+            payload = rng.randbytes(n)
+            b = e.blob(n)
+            # write in random slices
+            j = 0
+            while j < n:
+                step = rng.randrange(1, n - j + 1)
+                b.write(payload[j : j + step])
+                j += step
+            b.end()
+            sent.append(payload)
+        else:
+            c = Change(
+                key=f"key-{i}",
+                change=i,
+                from_=i,
+                to=i + 1,
+                value=rng.randbytes(rng.randrange(0, 64)),
+                subset="" if rng.random() < 0.5 else f"s{i}",
+            )
+            sent.append(c)
+            e.change(c)
+    e.finalize()
+    p.pump()
+    assert d.finished
+    # decoded changes have ''/b'' defaults; encoded with subset='' roundtrips
+    norm = [
+        Change(c.key, c.change, c.from_, c.to, c.value or b"", c.subset or "")
+        if isinstance(c, Change)
+        else c
+        for c in sent
+    ]
+    assert got == norm

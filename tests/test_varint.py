@@ -1,0 +1,56 @@
+import pytest
+
+from dat_replication_protocol_tpu.wire.varint import (
+    NeedMoreData,
+    decode_uvarint,
+    encode_uvarint,
+    uvarint_length,
+)
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        (0, b"\x00"),
+        (1, b"\x01"),
+        (127, b"\x7f"),
+        (128, b"\x80\x01"),
+        (300, b"\xac\x02"),
+        (16384, b"\x80\x80\x01"),
+        (2**32 - 1, b"\xff\xff\xff\xff\x0f"),
+        (2**64 - 1, b"\xff" * 9 + b"\x01"),
+    ],
+)
+def test_known_encodings(value, expected):
+    assert encode_uvarint(value) == expected
+    got, used = decode_uvarint(expected)
+    assert (got, used) == (value, len(expected))
+    assert uvarint_length(value) == len(expected)
+
+
+def test_roundtrip_sweep():
+    for v in list(range(0, 4097)) + [2**k for k in range(63)] + [2**k - 1 for k in range(1, 64)]:
+        enc = encode_uvarint(v)
+        got, used = decode_uvarint(enc)
+        assert got == v and used == len(enc)
+
+
+def test_decode_with_offset_and_trailing():
+    buf = b"\xff" + encode_uvarint(300) + b"tail"
+    got, used = decode_uvarint(buf, 1)
+    assert got == 300 and used == 2
+
+
+def test_truncated_raises_needmoredata():
+    with pytest.raises(NeedMoreData):
+        decode_uvarint(b"\x80")
+
+
+def test_overlong_rejected():
+    with pytest.raises(ValueError):
+        decode_uvarint(b"\x80" * 10 + b"\x01")
+
+
+def test_negative_rejected():
+    with pytest.raises(ValueError):
+        encode_uvarint(-1)
