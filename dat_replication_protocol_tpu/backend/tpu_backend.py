@@ -225,10 +225,13 @@ class TpuEncoder(Encoder):
             def end(data=None, on_flush=None):
                 # a final chunk routes through BlobWriter.end -> self.write,
                 # which is the wrapped write above — it records `parts` there.
+                was_ended = ws._ended
                 orig_end(data, on_flush)
-                self._pipeline.submit(
-                    b"".join(parts), lambda d, s=seq: self._emit_digest("blob", s, d)
-                )
+                if not was_ended:  # double end() must not duplicate the digest
+                    self._pipeline.submit(
+                        b"".join(parts),
+                        lambda d, s=seq: self._emit_digest("blob", s, d),
+                    )
 
             ws.write = write
             ws.end = end

@@ -1,0 +1,251 @@
+"""Batched BLAKE2b on device (JAX/XLA, TPU-first).
+
+The reference does no hashing at all; content-addressing lives above it in
+dat core.  The TPU-native framework pulls it into the data plane
+(BASELINE.json north star: "batched BLAKE2b ... thousands of blobs per XLA
+dispatch").  Design:
+
+* 64-bit words are (hi, lo) uint32 lane pairs (:mod:`.u64`) — byte-exact
+  RFC 7693 BLAKE2b without 64-bit integer lanes.
+* The batch dim is the vector dim: state is ``(B, 8)`` word pairs, message
+  blocks ``(B, 16)`` word pairs.  Every G mixes 4 lanes of all B items at
+  once; the 12 rounds are Python-unrolled (static) so XLA sees one straight
+  fused elementwise pipeline per block.
+* Variable lengths inside one padded batch: a `lax.scan` over the padded
+  block axis with per-item ``active`` / ``final`` masks and byte counters —
+  no data-dependent shapes, no recompiles across batches of the same padded
+  shape.
+* Host edge: :func:`blake2b_batch` packs ``list[bytes]`` into padded uint32
+  arrays (bucketed by power-of-two block count to bound padding waste and
+  compile count) and unpacks digests, preserving submit order — the
+  completion-queue contract the session backend relies on
+  (reference semantics: decode.js:87-99 pending accounting).
+
+Per-item payloads are limited to < 2 GiB (byte counters carried in uint32;
+larger streams go through the Rabin chunker first, mirroring the
+reference's "blobs are streamed, never materialized" discipline,
+reference: README.md:73).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .u64 import U32, add64_3, ror64
+
+DIGEST_SIZE = 32  # BLAKE2b-256 default, dat's content-hash size
+BLOCK_BYTES = 128
+
+_IV = (
+    0x6A09E667F3BCC908,
+    0xBB67AE8584CAA73B,
+    0x3C6EF372FE94F82B,
+    0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1,
+    0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B,
+    0x5BE0CD19137E2179,
+)
+_IV_HI = np.array([w >> 32 for w in _IV], dtype=np.uint32)
+_IV_LO = np.array([w & 0xFFFFFFFF for w in _IV], dtype=np.uint32)
+
+_SIGMA = np.array(
+    [
+        [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
+        [14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3],
+        [11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4],
+        [7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8],
+        [9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13],
+        [2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9],
+        [12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11],
+        [13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10],
+        [6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5],
+        [10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0],
+    ],
+    dtype=np.int32,
+)
+# rounds 10, 11 reuse schedules 0, 1
+_ROUND_SIGMA = [_SIGMA[r % 10] for r in range(12)]
+
+# column then diagonal lane groups for the vectorized quad-G
+_COL = (
+    np.array([0, 1, 2, 3]),
+    np.array([4, 5, 6, 7]),
+    np.array([8, 9, 10, 11]),
+    np.array([12, 13, 14, 15]),
+)
+_DIAG = (
+    np.array([0, 1, 2, 3]),
+    np.array([5, 6, 7, 4]),
+    np.array([10, 11, 8, 9]),
+    np.array([15, 12, 13, 14]),
+)
+
+
+def _quad_g(vh, vl, lanes, xh, xl, yh, yl):
+    """One vectorized G over 4 disjoint lanes of all batch items.
+
+    vh/vl: (B, 16); xh/xl/yh/yl: (B, 4) message words for these lanes.
+    """
+    ai, bi, ci, di = lanes
+    ah, al = vh[:, ai], vl[:, ai]
+    bh, bl = vh[:, bi], vl[:, bi]
+    ch, cl = vh[:, ci], vl[:, ci]
+    dh, dl = vh[:, di], vl[:, di]
+
+    ah, al = add64_3(ah, al, bh, bl, xh, xl)
+    dh, dl = ror64(dh ^ ah, dl ^ al, 32)
+    ch, cl = add64_3(ch, cl, dh, dl, jnp.zeros_like(ch), jnp.zeros_like(cl))
+    bh, bl = ror64(bh ^ ch, bl ^ cl, 24)
+    ah, al = add64_3(ah, al, bh, bl, yh, yl)
+    dh, dl = ror64(dh ^ ah, dl ^ al, 16)
+    ch, cl = add64_3(ch, cl, dh, dl, jnp.zeros_like(ch), jnp.zeros_like(cl))
+    bh, bl = ror64(bh ^ ch, bl ^ cl, 63)
+
+    vh = vh.at[:, ai].set(ah).at[:, bi].set(bh).at[:, ci].set(ch).at[:, di].set(dh)
+    vl = vl.at[:, ai].set(al).at[:, bi].set(bl).at[:, ci].set(cl).at[:, di].set(dl)
+    return vh, vl
+
+
+def compress(hh, hl, mh, ml, t_lo, is_final):
+    """One BLAKE2b compression: state (B,8) pairs, block (B,16) pairs.
+
+    ``t_lo``: (B,) uint32 byte counter after this block (items < 2 GiB, so
+    the high counter words t0_hi/t1 are constant zero).  ``is_final``: (B,)
+    bool last-block flags.
+    """
+    B = hh.shape[0]
+    iv_h = jnp.broadcast_to(jnp.asarray(_IV_HI), (B, 8))
+    iv_l = jnp.broadcast_to(jnp.asarray(_IV_LO), (B, 8))
+    vh = jnp.concatenate([hh, iv_h], axis=1)
+    vl = jnp.concatenate([hl, iv_l], axis=1)
+
+    vl = vl.at[:, 12].set(vl[:, 12] ^ t_lo)
+    f = jnp.where(is_final, U32(0xFFFFFFFF), U32(0))
+    vh = vh.at[:, 14].set(vh[:, 14] ^ f)
+    vl = vl.at[:, 14].set(vl[:, 14] ^ f)
+
+    for sigma in _ROUND_SIGMA:
+        cx, cy = sigma[0:8:2], sigma[1:8:2]
+        dx, dy = sigma[8:16:2], sigma[9:16:2]
+        vh, vl = _quad_g(vh, vl, _COL, mh[:, cx], ml[:, cx], mh[:, cy], ml[:, cy])
+        vh, vl = _quad_g(vh, vl, _DIAG, mh[:, dx], ml[:, dx], mh[:, dy], ml[:, dy])
+
+    return hh ^ vh[:, :8] ^ vh[:, 8:], hl ^ vl[:, :8] ^ vl[:, 8:]
+
+
+def initial_state(batch: int, digest_size: int = DIGEST_SIZE):
+    """h0 = IV ^ parameter block (sequential mode, no key)."""
+    hh = jnp.broadcast_to(jnp.asarray(_IV_HI), (batch, 8))
+    hl = jnp.broadcast_to(jnp.asarray(_IV_LO), (batch, 8))
+    param_lo = U32(0x01010000 ^ digest_size)  # digest | key<<8 | fanout | depth
+    hl = hl.at[:, 0].set(hl[:, 0] ^ param_lo)
+    return hh, hl
+
+
+@functools.partial(jax.jit, static_argnames=("digest_size",))
+def blake2b_packed(mh, ml, lengths, digest_size: int = DIGEST_SIZE):
+    """Hash a padded batch: mh/ml (B, nblocks, 16) uint32, lengths (B,).
+
+    Padding bytes in the final partial block MUST be zero (the host packer
+    guarantees this).  Returns digest words as (hh, hl), each (B, 8).
+    """
+    B, nblocks, _ = mh.shape
+    hh, hl = initial_state(B, digest_size)
+    lengths = lengths.astype(U32)
+    # ceil(len/128), minimum 1: an empty message still compresses one block
+    item_blocks = jnp.maximum((lengths + U32(127)) >> U32(7), U32(1))
+
+    def step(carry, xs):
+        hh, hl = carry
+        bmh, bml, k = xs
+        active = k < item_blocks
+        final = k == item_blocks - U32(1)
+        t_lo = jnp.minimum(lengths, (k + U32(1)) << U32(7))
+        nh, nl = compress(hh, hl, bmh, bml, t_lo, final)
+        keep = active[:, None]
+        return (jnp.where(keep, nh, hh), jnp.where(keep, nl, hl)), None
+
+    ks = jnp.arange(nblocks, dtype=jnp.uint32)
+    (hh, hl), _ = jax.lax.scan(
+        step, (hh, hl), (mh.swapaxes(0, 1), ml.swapaxes(0, 1), ks)
+    )
+    return hh, hl
+
+
+# ---------------------------------------------------------------------------
+# host edge: bytes <-> padded uint32 batches
+# ---------------------------------------------------------------------------
+
+
+def pack_payloads(payloads, nblocks: int | None = None):
+    """Pack byte strings into padded (B, nblocks, 16) hi/lo uint32 arrays.
+
+    Little-endian 64-bit message words: u32-word index 2k is word k's low
+    half, 2k+1 its high half.  Zero padding satisfies the blake2b_packed
+    contract.
+    """
+    B = len(payloads)
+    max_len = max((len(p) for p in payloads), default=0)
+    need = max(1, -(-max_len // BLOCK_BYTES))
+    if nblocks is None:
+        nblocks = need
+    elif nblocks < need:
+        raise ValueError(f"nblocks={nblocks} < required {need}")
+    buf = np.zeros((B, nblocks * BLOCK_BYTES), dtype=np.uint8)
+    lengths = np.empty((B,), dtype=np.uint32)
+    for i, p in enumerate(payloads):
+        if len(p) >= 1 << 31:
+            raise ValueError("per-item payload limit is < 2 GiB; chunk first")
+        buf[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+        lengths[i] = len(p)
+    words = buf.view("<u4").reshape(B, nblocks, 32)
+    return words[:, :, 1::2].copy(), words[:, :, 0::2].copy(), lengths
+
+
+def digests_to_bytes(hh, hl, digest_size: int = DIGEST_SIZE) -> list[bytes]:
+    """Interleave (hi, lo) word pairs back into little-endian digest bytes."""
+    hh = np.asarray(hh, dtype=np.uint32)
+    hl = np.asarray(hl, dtype=np.uint32)
+    B = hh.shape[0]
+    out = np.empty((B, 16), dtype=np.uint32)
+    out[:, 0::2] = hl
+    out[:, 1::2] = hh
+    raw = out.astype("<u4").view(np.uint8).reshape(B, 64)
+    return [raw[i, :digest_size].tobytes() for i in range(B)]
+
+
+def _bucket_nblocks(n: int) -> int:
+    """Round a block count up to a power of two to bound compile count."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def blake2b_batch(payloads, digest_size: int = DIGEST_SIZE) -> list[bytes]:
+    """Hash a list of byte strings on device; digests in submit order.
+
+    Items are grouped into power-of-two block-count buckets; each bucket is
+    one padded XLA dispatch.  This is the ``hash_batch`` engine the
+    ``backend='tpu'`` session pipeline plugs in.
+    """
+    if not payloads:
+        return []
+    buckets: dict[int, list[int]] = {}
+    for i, p in enumerate(payloads):
+        nb = _bucket_nblocks(max(1, -(-len(p) // BLOCK_BYTES)))
+        buckets.setdefault(nb, []).append(i)
+    out: list[bytes | None] = [None] * len(payloads)
+    for nb, idxs in buckets.items():
+        mh, ml, lengths = pack_payloads([payloads[i] for i in idxs], nblocks=nb)
+        hh, hl = blake2b_packed(
+            jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths), digest_size
+        )
+        for i, d in zip(idxs, digests_to_bytes(hh, hl, digest_size)):
+            out[i] = d
+    return out  # type: ignore[return-value]

@@ -25,6 +25,7 @@ counter instead of Node Writable plumbing:
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Callable, Optional
 
 from ..wire.change_codec import Change, decode_change
@@ -154,7 +155,7 @@ class Decoder:
         # flow control
         self._pending = 0
         self._paused_readers = 0
-        self._overflow: list[memoryview] = []  # unparsed input, in order
+        self._overflow: deque[memoryview] = deque()  # unparsed input, in order
         self._write_cbs: list[Callable[[], None]] = []
         self._end_queued = False
         self._end_cb: OnDone = None
@@ -264,14 +265,13 @@ class Decoder:
         return done
 
     def _resume(self) -> None:
-        if self.destroyed or self._stalled():
+        # While _consume is live on the stack, the outer loop may hold a
+        # chunk's unparsed remainder in a local — it will keep going (pending
+        # just dropped) and run the drained notifications itself, so a nested
+        # resume must be a no-op rather than observe a falsely-empty overflow.
+        if self.destroyed or self._stalled() or self._consuming:
             return
         self._consume()
-        if not self._overflow and not self._stalled():
-            cbs, self._write_cbs = self._write_cbs, []
-            for cb in cbs:
-                cb()
-            self._maybe_finalize()
 
     def _maybe_finalize(self) -> None:
         if (
@@ -280,6 +280,7 @@ class Decoder:
             or self.destroyed
             or self._overflow
             or self._stalled()
+            or self._consuming  # drained-check at the end of _consume re-runs this
         ):
             return
         if self._state != TYPE_HEADER or self._header:
@@ -317,14 +318,23 @@ class Decoder:
         self._consuming = True
         try:
             while self._overflow and not self._stalled() and not self.destroyed:
-                chunk = self._overflow.pop(0)
+                chunk = self._overflow.popleft()
                 rest = self._consume_chunk(chunk)
                 if self.destroyed:
                     return
                 if rest is not None and len(rest):
-                    self._overflow.insert(0, rest)
+                    self._overflow.appendleft(rest)
         finally:
             self._consuming = False
+        # Fully drained and nothing outstanding: release parked writers and
+        # run a queued finalization. This lives here (not in _resume) so a
+        # handler acking synchronously mid-loop cannot finalize while the
+        # loop still holds unparsed bytes in a local.
+        if not self.destroyed and not self._overflow and not self._stalled():
+            cbs, self._write_cbs = self._write_cbs, []
+            for cb in cbs:
+                cb()
+            self._maybe_finalize()
 
     def _consume_chunk(self, chunk: memoryview) -> memoryview | None:
         if self._state == TYPE_HEADER:

@@ -323,6 +323,12 @@ class Encoder:
         self._parked_changes.clear()
         for cb in self._error_cbs:
             cb(err)
+        # Release parked drain callbacks so a producer gated on the drain
+        # signal wakes up and observes the destroyed state (mirrors the
+        # decoder releasing its parked write callbacks on destroy).
+        cbs, self._drain_cbs = self._drain_cbs, []
+        for cb in cbs:
+            cb()
 
     # -- internal -----------------------------------------------------------
 

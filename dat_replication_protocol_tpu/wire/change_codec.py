@@ -165,8 +165,12 @@ def decode_change(buf) -> Change:
                 elif tag == _TAG_VALUE:
                     value = raw
             elif wire_type == 5:  # fixed32 (unknown field skip)
+                if i + 4 > n:
+                    raise NeedMoreData("truncated fixed32 field")
                 i += 4
             elif wire_type == 1:  # fixed64 (unknown field skip)
+                if i + 8 > n:
+                    raise NeedMoreData("truncated fixed64 field")
                 i += 8
             else:
                 raise ValueError(f"unsupported protobuf wire type {wire_type}")

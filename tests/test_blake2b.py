@@ -1,0 +1,68 @@
+"""Device BLAKE2b vs the host reference implementation (hashlib).
+
+SURVEY.md §7 step 3: "validate digests against a host reference
+implementation". Covers empty input, sub-block, exact-block, multi-block,
+variable lengths in one padded batch, and non-default digest sizes.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from dat_replication_protocol_tpu.ops import blake2b as b2
+
+
+def host(p: bytes, n: int = 32) -> bytes:
+    return hashlib.blake2b(p, digest_size=n).digest()
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        b"",
+        b"abc",
+        b"a" * 127,
+        b"b" * 128,
+        b"c" * 129,
+        b"d" * 256,
+        bytes(range(256)) * 17,  # multi-block, non-uniform bytes
+    ],
+    ids=["empty", "abc", "127", "128", "129", "256", "4352"],
+)
+def test_single_payload_matches_hashlib(payload):
+    assert b2.blake2b_batch([payload]) == [host(payload)]
+
+
+def test_mixed_lengths_one_batch():
+    rng = random.Random(7)
+    payloads = [
+        bytes(rng.getrandbits(8) for _ in range(rng.choice([0, 1, 63, 128, 200, 1000])))
+        for _ in range(32)
+    ]
+    assert b2.blake2b_batch(payloads) == [host(p) for p in payloads]
+
+
+def test_digest_sizes():
+    for n in (16, 20, 32, 48, 64):
+        assert b2.blake2b_batch([b"hello world"], digest_size=n) == [
+            host(b"hello world", n)
+        ]
+
+
+def test_large_payload_multiblock():
+    p = bytes(range(256)) * 4096  # 1 MiB
+    assert b2.blake2b_batch([p]) == [host(p)]
+
+
+def test_order_preserved_across_buckets():
+    # items alternate between very different sizes -> different buckets,
+    # output order must still match submit order
+    payloads = [b"x" * (1 if i % 2 else 5000) for i in range(10)]
+    assert b2.blake2b_batch(payloads) == [host(p) for p in payloads]
+
+
+def test_packing_roundtrip_shapes():
+    mh, ml, lengths = b2.pack_payloads([b"abc", b"y" * 130])
+    assert mh.shape == (2, 2, 16) and ml.shape == (2, 2, 16)
+    assert list(lengths) == [3, 130]

@@ -157,3 +157,93 @@ def test_tpu_encoder_digests_match_decoder():
     d.on_digest(lambda kind, seq, dg: dec_digests.append((kind, seq, dg)))
     protocol.pipe(e, d)
     assert sorted(enc_digests) == sorted(dec_digests)
+
+
+def _wire_of(build):
+    e = protocol.encode()
+    build(e)
+    e.finalize()
+    wire = bytearray()
+    while (c := e.read()) not in (None, b""):
+        wire += c
+    return bytes(wire)
+
+
+def test_deferred_done_does_not_finalize_past_unparsed_remainder():
+    """Review: releasing a deferred done() while the outer _consume loop holds
+    a chunk remainder in a local must not run finalize/finish before all
+    frames are consumed, nor deliver frames after finished=True."""
+    wire = _wire_of(
+        lambda e: [
+            e.change({"key": f"k{i}", "change": i, "from": 0, "to": 1})
+            for i in range(3)
+        ]
+    )
+    d = protocol.decode()
+    events = []
+    held = []
+
+    def on_change(c, done):
+        events.append(("change", c.key))
+        if c.key == "k0":
+            held.append(done)
+        else:
+            done()
+
+    d.change(on_change)
+    d.finalize(lambda done: (events.append(("finalize",)), done()))
+    d.on_finish(lambda: events.append(("finish",)))
+    # one write containing all three frames, then end
+    d.write(wire)
+    d.end()
+    assert events == [("change", "k0")]
+    held[0]()
+    assert events == [
+        ("change", "k0"),
+        ("change", "k1"),
+        ("change", "k2"),
+        ("finalize",),
+        ("finish",),
+    ], events
+    assert d.finished
+
+
+def test_tpu_blob_double_end_single_digest():
+    """Review: double end() on a tpu-backend blob writer must not duplicate
+    the digest."""
+    enc = protocol.encode(backend="tpu")
+    digests = []
+    enc.on_digest(lambda k, s, d: digests.append((k, s)))
+    ws = enc.blob(3)
+    ws.write(b"abc")
+    ws.end()
+    ws.end()
+    enc.finalize()
+    assert digests == [("blob", 0)]
+
+
+def test_encoder_destroy_releases_drain_callbacks():
+    """Review: a producer parked on on_drain must wake on destroy instead of
+    hanging forever (mirrors decoder releasing parked write callbacks)."""
+    e = protocol.encode(high_water=8)
+    ws = e.blob(100)
+    ws.write(b"x" * 50)  # above high water
+    fired = []
+    e.on_drain(lambda: fired.append(True))
+    assert fired == []
+    e.destroy(RuntimeError("boom"))
+    assert fired == [True]
+
+
+def test_truncated_fixed_width_fields_raise():
+    """Review: a Change payload truncated mid fixed32/fixed64 unknown field
+    must raise like every other truncation path."""
+    from dat_replication_protocol_tpu.wire.change_codec import decode_change
+
+    base = encode_change({"key": "k", "change": 1, "from": 0, "to": 1})
+    for wire_type, nbytes in ((1, 8), (5, 4)):
+        bad = base + bytes([(7 << 3) | wire_type]) + b"\x00\x00"  # 2 of n bytes
+        with pytest.raises(ValueError):
+            decode_change(bad)
+        ok = base + bytes([(7 << 3) | wire_type]) + b"\x00" * nbytes
+        decode_change(ok)  # fully-present unknown field still skips cleanly
