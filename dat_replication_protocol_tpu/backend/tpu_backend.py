@@ -60,20 +60,29 @@ class DigestPipeline:
         self,
         hash_batch: Callable[[list[bytes]], list[bytes]] | None = None,
         max_batch: int = 1024,
+        max_batch_bytes: int = 1 << 30,
     ):
         if hash_batch is None:
             hash_batch = _device_hash_batch_factory() or _host_hash_batch
         self._hash_batch = hash_batch
         self._max_batch = max_batch
+        # byte cap bounds device/HBM footprint per dispatch — the item cap
+        # alone would admit e.g. 1024 x 8 MiB blobs in one batch
+        self._max_batch_bytes = max_batch_bytes
         self._payloads: list[bytes] = []
         self._cbs: list[Callable[[bytes], None]] = []
+        self._pending_bytes = 0
         self.dispatches = 0
         self.hashed_bytes = 0
 
     def submit(self, payload: bytes, on_digest: Callable[[bytes], None]) -> None:
         self._payloads.append(payload)
         self._cbs.append(on_digest)
-        if len(self._payloads) >= self._max_batch:
+        self._pending_bytes += len(payload)
+        if (
+            len(self._payloads) >= self._max_batch
+            or self._pending_bytes >= self._max_batch_bytes
+        ):
             self.flush()
 
     def flush(self) -> None:
@@ -82,6 +91,7 @@ class DigestPipeline:
             return
         payloads, self._payloads = self._payloads, []
         cbs, self._cbs = self._cbs, []
+        self._pending_bytes = 0
         self.dispatches += 1
         self.hashed_bytes += sum(len(p) for p in payloads)
         digests = self._hash_batch(payloads)

@@ -7,10 +7,12 @@ dispatch").  Design:
 
 * 64-bit words are (hi, lo) uint32 lane pairs (:mod:`.u64`) — byte-exact
   RFC 7693 BLAKE2b without 64-bit integer lanes.
-* The batch dim is the vector dim: state is ``(B, 8)`` word pairs, message
-  blocks ``(B, 16)`` word pairs.  Every G mixes 4 lanes of all B items at
-  once; the 12 rounds are Python-unrolled (static) so XLA sees one straight
-  fused elementwise pipeline per block.
+* The batch dim is the vector dim, in SoA layout: the 16 working-vector
+  lanes are 16 separate (hi, lo) pairs of ``(B,)`` vectors, selected by
+  Python indexing.  Every 64-bit op is a full-width elementwise VPU op
+  over all B items; there are no gathers or dynamic-update-slices in the
+  round function.  The 12 rounds are Python-unrolled (static) so XLA sees
+  one straight fused elementwise pipeline per block.
 * Variable lengths inside one padded batch: a `lax.scan` over the padded
   block axis with per-item ``active`` / ``final`` masks and byte counters —
   no data-dependent shapes, no recompiles across batches of the same padded
@@ -35,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .u64 import U32, add64_3, ror64
+from .u64 import U32, add64, add64_3, ror64
 
 DIGEST_SIZE = 32  # BLAKE2b-256 default, dat's content-hash size
 BLOCK_BYTES = 128
@@ -71,71 +73,136 @@ _SIGMA = np.array(
 # rounds 10, 11 reuse schedules 0, 1
 _ROUND_SIGMA = [_SIGMA[r % 10] for r in range(12)]
 
-# column then diagonal lane groups for the vectorized quad-G
-_COL = (
-    np.array([0, 1, 2, 3]),
-    np.array([4, 5, 6, 7]),
-    np.array([8, 9, 10, 11]),
-    np.array([12, 13, 14, 15]),
+# the 8 G applications per round: (a, b, c, d) working-vector lane indices,
+# columns then diagonals (RFC 7693 §3.2)
+_G_LANES = (
+    (0, 4, 8, 12),
+    (1, 5, 9, 13),
+    (2, 6, 10, 14),
+    (3, 7, 11, 15),
+    (0, 5, 10, 15),
+    (1, 6, 11, 12),
+    (2, 7, 8, 13),
+    (3, 4, 9, 14),
 )
-_DIAG = (
-    np.array([0, 1, 2, 3]),
-    np.array([5, 6, 7, 4]),
-    np.array([10, 11, 8, 9]),
-    np.array([15, 12, 13, 14]),
-)
 
 
-def _quad_g(vh, vl, lanes, xh, xl, yh, yl):
-    """One vectorized G over 4 disjoint lanes of all batch items.
-
-    vh/vl: (B, 16); xh/xl/yh/yl: (B, 4) message words for these lanes.
+def _g(v, a, b, c, d, x, y):
+    """One G mix on SoA state: ``v`` is a list of 16 (hi, lo) pairs of (B,)
+    vectors; lane selection is Python indexing, so the whole mix lowers to
+    full-width elementwise VPU ops — no gathers, no dynamic-update-slices.
+    (The earlier (B, 16) array-of-struct layout spent its time in per-lane
+    scatter updates and 16-wide minor-dim padding; SoA is ~3 orders of
+    magnitude faster on the VPU.)
     """
-    ai, bi, ci, di = lanes
-    ah, al = vh[:, ai], vl[:, ai]
-    bh, bl = vh[:, bi], vl[:, bi]
-    ch, cl = vh[:, ci], vl[:, ci]
-    dh, dl = vh[:, di], vl[:, di]
+    (ah, al), (bh, bl), (ch, cl), (dh, dl) = v[a], v[b], v[c], v[d]
+    xh, xl = x
+    yh, yl = y
 
     ah, al = add64_3(ah, al, bh, bl, xh, xl)
     dh, dl = ror64(dh ^ ah, dl ^ al, 32)
-    ch, cl = add64_3(ch, cl, dh, dl, jnp.zeros_like(ch), jnp.zeros_like(cl))
+    ch, cl = add64(ch, cl, dh, dl)
     bh, bl = ror64(bh ^ ch, bl ^ cl, 24)
     ah, al = add64_3(ah, al, bh, bl, yh, yl)
     dh, dl = ror64(dh ^ ah, dl ^ al, 16)
-    ch, cl = add64_3(ch, cl, dh, dl, jnp.zeros_like(ch), jnp.zeros_like(cl))
+    ch, cl = add64(ch, cl, dh, dl)
     bh, bl = ror64(bh ^ ch, bl ^ cl, 63)
 
-    vh = vh.at[:, ai].set(ah).at[:, bi].set(bh).at[:, ci].set(ch).at[:, di].set(dh)
-    vl = vl.at[:, ai].set(al).at[:, bi].set(bl).at[:, ci].set(cl).at[:, di].set(dl)
-    return vh, vl
+    v[a], v[b], v[c], v[d] = (ah, al), (bh, bl), (ch, cl), (dh, dl)
+
+
+def _rounds_unrolled(v, m):
+    """All 12 rounds Python-unrolled: one straight ~5k-op elementwise DAG.
+
+    Best runtime on TPU (XLA fuses the whole chain, zero loop or gather
+    overhead) but pathological to *compile* on the CPU backend's LLVM
+    pipeline — hence the scanned variant below for host runs.
+    """
+    for sigma in _ROUND_SIGMA:
+        for gi, (a, b, c, d) in enumerate(_G_LANES):
+            _g(v, a, b, c, d, m[sigma[2 * gi]], m[sigma[2 * gi + 1]])
+    return v
+
+
+def _rounds_scanned(v, m, sigma=None):
+    """The 12 rounds as a lax.scan with runtime sigma gathers.
+
+    ~12x smaller HLO than the unrolled form: the body is one round (8 G
+    mixes) and the per-round message schedule is a 16-row gather from the
+    stacked message words.  Used on the CPU backend where compile time,
+    not VPU throughput, is the binding constraint (tests, virtual-mesh
+    dry runs).  ``sigma`` overrides the (12, 16) schedule table — pallas
+    kernels must pass it in as an input (no closure constants allowed).
+    """
+    vh = jnp.stack([p[0] for p in v])
+    vl = jnp.stack([p[1] for p in v])
+    mh = jnp.stack([p[0] for p in m])
+    ml = jnp.stack([p[1] for p in m])
+    sig = jnp.asarray(np.stack(_ROUND_SIGMA)) if sigma is None else sigma
+
+    def round_body(carry, sig_r):
+        vh, vl = carry
+        xh = jnp.take(mh, sig_r, axis=0)
+        xl = jnp.take(ml, sig_r, axis=0)
+        vv = [(vh[i], vl[i]) for i in range(16)]
+        for gi, (a, b, c, d) in enumerate(_G_LANES):
+            _g(vv, a, b, c, d, (xh[2 * gi], xl[2 * gi]), (xh[2 * gi + 1], xl[2 * gi + 1]))
+        return (
+            jnp.stack([p[0] for p in vv]),
+            jnp.stack([p[1] for p in vv]),
+        ), None
+
+    (vh, vl), _ = jax.lax.scan(round_body, (vh, vl), sig)
+    return [(vh[i], vl[i]) for i in range(16)]
+
+
+def compress_soa(h, m, t_lo, is_final, unroll: bool | None = None, sigma=None):
+    """One BLAKE2b compression in SoA layout.
+
+    ``h``: list of 8 (hi, lo) pairs of (B,) uint32 vectors; ``m``: list of
+    16 such pairs (message words); ``t_lo``: (B,) uint32 byte counter after
+    this block (items < 2 GiB, so counter words t0_hi/t1 are constant
+    zero); ``is_final``: (B,) bool last-block flags.  Returns the new h.
+
+    ``unroll=None`` picks per backend: unrolled rounds on accelerators,
+    scanned rounds on CPU (see the two round helpers).  Both are
+    byte-exact RFC 7693.
+    """
+    if unroll is None:
+        unroll = jax.default_backend() != "cpu"
+    shape = t_lo.shape  # any batch shape: (B,) under scan, (8, B/8) in pallas
+    iv = [
+        (jnp.full(shape, _IV_HI[i], U32), jnp.full(shape, _IV_LO[i], U32))
+        for i in range(8)
+    ]
+    v = list(h) + iv
+    v[12] = (v[12][0], v[12][1] ^ t_lo)
+    f = jnp.where(is_final, U32(0xFFFFFFFF), U32(0))
+    v[14] = (v[14][0] ^ f, v[14][1] ^ f)
+
+    v = _rounds_unrolled(v, m) if unroll else _rounds_scanned(v, m, sigma)
+
+    return [
+        (hh ^ v[i][0] ^ v[i + 8][0], hl ^ v[i][1] ^ v[i + 8][1])
+        for i, (hh, hl) in enumerate(h)
+    ]
 
 
 def compress(hh, hl, mh, ml, t_lo, is_final):
-    """One BLAKE2b compression: state (B,8) pairs, block (B,16) pairs.
+    """Array-of-struct wrapper over :func:`compress_soa`.
 
-    ``t_lo``: (B,) uint32 byte counter after this block (items < 2 GiB, so
-    the high counter words t0_hi/t1 are constant zero).  ``is_final``: (B,)
-    bool last-block flags.
+    state (B, 8) hi/lo pairs, block (B, 16) pairs — the layout the packers
+    and the Merkle level op exchange.  Unpacking to SoA costs 24 strided
+    slices + 2 stacks per block, negligible against the ~4k elementwise ops
+    of the 12 rounds.
     """
-    B = hh.shape[0]
-    iv_h = jnp.broadcast_to(jnp.asarray(_IV_HI), (B, 8))
-    iv_l = jnp.broadcast_to(jnp.asarray(_IV_LO), (B, 8))
-    vh = jnp.concatenate([hh, iv_h], axis=1)
-    vl = jnp.concatenate([hl, iv_l], axis=1)
-
-    vl = vl.at[:, 12].set(vl[:, 12] ^ t_lo)
-    f = jnp.where(is_final, U32(0xFFFFFFFF), U32(0))
-    vh = vh.at[:, 14].set(vh[:, 14] ^ f)
-    vl = vl.at[:, 14].set(vl[:, 14] ^ f)
-
-    for sigma in _ROUND_SIGMA:
-        cx, cy = sigma[0:8:2], sigma[1:8:2]
-        dx, dy = sigma[8:16:2], sigma[9:16:2]
-        vh, vl = _quad_g(vh, vl, _COL, mh[:, cx], ml[:, cx], mh[:, cy], ml[:, cy])
-        vh, vl = _quad_g(vh, vl, _DIAG, mh[:, dx], ml[:, dx], mh[:, dy], ml[:, dy])
-
-    return hh ^ vh[:, :8] ^ vh[:, 8:], hl ^ vl[:, :8] ^ vl[:, 8:]
+    h = [(hh[:, i], hl[:, i]) for i in range(8)]
+    m = [(mh[:, i], ml[:, i]) for i in range(16)]
+    h = compress_soa(h, m, t_lo, is_final)
+    return (
+        jnp.stack([p[0] for p in h], axis=1),
+        jnp.stack([p[1] for p in h], axis=1),
+    )
 
 
 def initial_state(batch: int, digest_size: int = DIGEST_SIZE):
@@ -160,21 +227,32 @@ def blake2b_packed(mh, ml, lengths, digest_size: int = DIGEST_SIZE):
     # ceil(len/128), minimum 1: an empty message still compresses one block
     item_blocks = jnp.maximum((lengths + U32(127)) >> U32(7), U32(1))
 
+    # carry in SoA layout — 16 flat (B,) vectors — so the scan body is a
+    # pure elementwise DAG with no per-block stack/unstack
+    carry0 = tuple(hh[:, i] for i in range(8)) + tuple(hl[:, i] for i in range(8))
+
+    # message words to (nblocks, 16, B): each word a contiguous (B,) row in
+    # the lane dim (the (B, 16) minor-dim layout pads 16 -> 128 lanes and
+    # turns every per-word slice into a strided read)
+    mh = jnp.transpose(mh, (1, 2, 0))
+    ml = jnp.transpose(ml, (1, 2, 0))
+
     def step(carry, xs):
-        hh, hl = carry
+        h = [(carry[i], carry[i + 8]) for i in range(8)]
         bmh, bml, k = xs
+        m = [(bmh[i], bml[i]) for i in range(16)]
         active = k < item_blocks
         final = k == item_blocks - U32(1)
         t_lo = jnp.minimum(lengths, (k + U32(1)) << U32(7))
-        nh, nl = compress(hh, hl, bmh, bml, t_lo, final)
-        keep = active[:, None]
-        return (jnp.where(keep, nh, hh), jnp.where(keep, nl, hl)), None
+        nh = compress_soa(h, m, t_lo, final)
+        out = tuple(
+            jnp.where(active, nh[i][0], h[i][0]) for i in range(8)
+        ) + tuple(jnp.where(active, nh[i][1], h[i][1]) for i in range(8))
+        return out, None
 
     ks = jnp.arange(nblocks, dtype=jnp.uint32)
-    (hh, hl), _ = jax.lax.scan(
-        step, (hh, hl), (mh.swapaxes(0, 1), ml.swapaxes(0, 1), ks)
-    )
-    return hh, hl
+    carry, _ = jax.lax.scan(step, carry0, (mh, ml, ks))
+    return jnp.stack(carry[:8], axis=1), jnp.stack(carry[8:], axis=1)
 
 
 # ---------------------------------------------------------------------------
@@ -227,23 +305,44 @@ def _bucket_nblocks(n: int) -> int:
     return b
 
 
-def blake2b_batch(payloads, digest_size: int = DIGEST_SIZE) -> list[bytes]:
+# below this bucket size the pallas kernel's pad-to-1024-items overhead
+# outweighs its throughput edge over the XLA-scan path
+_PALLAS_MIN_ITEMS = 512
+
+
+def blake2b_batch(
+    payloads, digest_size: int = DIGEST_SIZE, use_pallas: bool | None = None
+) -> list[bytes]:
     """Hash a list of byte strings on device; digests in submit order.
 
     Items are grouped into power-of-two block-count buckets; each bucket is
     one padded XLA dispatch.  This is the ``hash_batch`` engine the
     ``backend='tpu'`` session pipeline plugs in.
+
+    ``use_pallas=None`` selects, per bucket, the Pallas kernel on TPU
+    backends when the bucket is large enough to amortize its 1024-item
+    tile padding, and the portable XLA-scan path otherwise.
     """
     if not payloads:
         return []
+    on_tpu = jax.default_backend() == "tpu"
     buckets: dict[int, list[int]] = {}
     for i, p in enumerate(payloads):
         nb = _bucket_nblocks(max(1, -(-len(p) // BLOCK_BYTES)))
         buckets.setdefault(nb, []).append(i)
     out: list[bytes | None] = [None] * len(payloads)
     for nb, idxs in buckets.items():
+        pallas_bucket = (
+            use_pallas
+            if use_pallas is not None
+            else on_tpu and len(idxs) >= _PALLAS_MIN_ITEMS
+        )
+        if pallas_bucket:
+            from .blake2b_pallas import blake2b_packed_pallas as packed_fn
+        else:
+            packed_fn = blake2b_packed
         mh, ml, lengths = pack_payloads([payloads[i] for i in idxs], nblocks=nb)
-        hh, hl = blake2b_packed(
+        hh, hl = packed_fn(
             jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths), digest_size
         )
         for i, d in zip(idxs, digests_to_bytes(hh, hl, digest_size)):

@@ -1,1 +1,19 @@
+"""Multi-chip parallelism: mesh construction + sharded data-plane steps."""
 
+from .mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    digest_root_step,
+    make_mesh,
+    replicated,
+    sharded_diff,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "batch_sharding",
+    "digest_root_step",
+    "make_mesh",
+    "replicated",
+    "sharded_diff",
+]

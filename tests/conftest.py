@@ -3,13 +3,26 @@
 Multi-chip hardware is unavailable in CI; sharding tests run against
 ``--xla_force_host_platform_device_count=8`` on the CPU backend, which
 exercises the same mesh/collective code paths XLA uses on real ICI.
+
+This must *override* (not just default) JAX_PLATFORMS: the dev image sets
+``JAX_PLATFORMS=axon`` (one tunneled TPU chip), which cannot host the
+8-way mesh tests and pays a real-hardware compile per parametrized case.
+Set ``DAT_TPU_TESTS=1`` to opt back into running the suite on the real
+chip (single-device tests only).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if not os.environ.get("DAT_TPU_TESTS"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    # the dev image's sitecustomize re-forces JAX_PLATFORMS=axon after the
+    # environment is read; jax.config wins over both
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

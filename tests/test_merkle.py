@@ -1,0 +1,98 @@
+"""Merkle tree build + tree-guided diff vs the host hashlib reference.
+
+Mirrors the reference's testing philosophy (SURVEY.md §4: real objects,
+loopback, exact-value asserts) at the kernel layer: every device result is
+checked byte-exactly against an independent hashlib implementation.
+"""
+
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+from dat_replication_protocol_tpu.ops import merkle
+
+
+def _digest(data: bytes) -> bytes:
+    return hashlib.blake2b(data, digest_size=32).digest()
+
+
+def _leaves(n: int, seed: int = 0) -> list[bytes]:
+    rng = random.Random(seed)
+    return [_digest(rng.randbytes(24)) for _ in range(n)]
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 16, 64])
+def test_root_matches_host(n):
+    leaves = _leaves(n)
+    hh, hl = merkle.digests_to_device(leaves)
+    rhh, rhl = merkle.root(hh, hl)
+    (dev_root,) = merkle.digests_from_device(rhh, rhl)
+    assert dev_root == merkle.host_tree(leaves)[-1][0]
+
+
+def test_build_tree_all_levels_match_host():
+    leaves = _leaves(32, seed=3)
+    hh, hl = merkle.digests_to_device(leaves)
+    hhs, hls = merkle.build_tree(hh, hl)
+    host_levels = merkle.host_tree(leaves)
+    assert len(hhs) == len(host_levels)
+    for lvl_hh, lvl_hl, host_lvl in zip(hhs, hls, host_levels):
+        assert merkle.digests_from_device(lvl_hh, lvl_hl) == host_lvl
+
+
+def test_build_tree_rejects_non_power_of_two():
+    leaves = _leaves(3)
+    hh, hl = merkle.digests_to_device(leaves)
+    with pytest.raises(ValueError, match="power of two"):
+        merkle.build_tree(hh, hl)
+
+
+def test_diff_identical_snapshots_is_empty():
+    leaves = _leaves(64, seed=1)
+    assert merkle.diff_leaves(leaves, list(leaves)) == []
+
+
+@pytest.mark.parametrize("changed", [[0], [63], [5, 17, 40], list(range(64))])
+def test_diff_finds_exactly_changed_leaves(changed):
+    a = _leaves(64, seed=2)
+    b = list(a)
+    for i in changed:
+        b[i] = _digest(b"changed-%d" % i)
+    assert merkle.diff_leaves(a, b) == sorted(changed)
+    assert merkle.diff_leaves(a, b) == merkle.host_diff(a, b)
+
+
+def test_diff_non_power_of_two_padding():
+    a = _leaves(13, seed=4)
+    b = list(a)
+    b[12] = _digest(b"x")
+    b[0] = _digest(b"y")
+    assert merkle.diff_leaves(a, b) == [0, 12]
+
+
+def test_diff_random_against_host_reference():
+    rng = random.Random(7)
+    a = _leaves(128, seed=5)
+    b = list(a)
+    changed = sorted(rng.sample(range(128), 9))
+    for i in changed:
+        b[i] = _digest(b"r%d" % i)
+    assert merkle.diff_leaves(a, b) == changed == merkle.host_diff(a, b)
+
+
+def test_diff_mismatched_lengths_raise():
+    with pytest.raises(ValueError, match="equal leaf counts"):
+        merkle.diff_leaves(_leaves(4), _leaves(8))
+
+
+def test_diff_empty():
+    assert merkle.diff_leaves([], []) == []
+
+
+def test_pad_leaves_sentinel_stability():
+    # padding with zero digests must not create phantom diffs
+    a = _leaves(5, seed=6)
+    assert merkle.diff_leaves(a, list(a)) == []
