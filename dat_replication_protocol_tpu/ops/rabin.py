@@ -1,0 +1,250 @@
+"""Content-defined chunking: gear rolling hash over tiled streams.
+
+The reference streams blobs in O(chunk) memory but never content-chunks
+them (chunking lives above the wire protocol in dat core; reference:
+README.md:73 "blobs are streamed, never buffered").  The TPU framework
+adds content-defined chunking as a device kernel per BASELINE.json
+config 4 ("Rabin rolling-hash content-defined chunking over 10 GiB
+blob").
+
+Algorithm (designed for SPMD, not translated from anything):
+
+* **Gear-style rolling hash** ``h_{i} = (h_{i-1} << 1) + g(b_i)`` over a
+  64-bit state carried as (hi, lo) uint32 lane pairs.  A byte's
+  contribution is shifted out after 64 positions, so the hash at any
+  position depends only on the trailing 64-byte window — which makes the
+  stream *tileable*: tiles recompute a 64-byte overlap instead of
+  serializing (SURVEY.md §7 hard part (b)).
+* ``g(b) = ((b+1) * C1, (b+1) * C2)`` — a table-free multiplicative
+  scramble (two 32-bit odd constants), chosen over the classic 256-entry
+  gear table because TPU vector lanes have no cheap gather; two u32
+  multiplies replace a table lookup.
+* A position is a **candidate boundary** when the top hash word masked by
+  ``(1 << avg_bits) - 1`` is zero → average chunk size 2**avg_bits.
+* The kernel scans byte groups (outer `lax.scan`, inner unrolled; the
+  Pallas variant in :mod:`.rabin_pallas` for TPU) over all tiles in
+  parallel and emits **packed bitmasks** (1 bit per byte, 1/8 the input
+  volume); candidate positions are recovered on the host with
+  ``np.unpackbits`` + ``nonzero`` over the sparse mask.
+* Min/max chunk-size constraints are applied by a greedy host pass over
+  the candidates (sequential by nature, but over ~1/2**avg_bits of the
+  data).  `max_size` inserts forced cuts when no candidate lands in
+  range.
+
+Memory discipline: tiles stream through the device; a 10 GiB blob is
+processed in bounded slabs (`chunk_stream`), never resident at once —
+the device-scale analogue of the reference's O(chunk) streaming.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .u64 import U32
+
+WINDOW = 64  # bytes: contributions shift out of the 64-bit state after this
+_C1 = np.uint32(0x9E3779B1)  # golden-ratio odd constants
+_C2 = np.uint32(0x85EBCA77)
+
+PACK = 32  # bytes per packed output word
+GROUP = 256  # bytes per outer scan step: large enough that per-step scan
+# overhead (xs slicing, carry threading — ~30us/step through XLA) is
+# amortized against the ~12 ops/byte of hash work
+
+
+def _gear_step(hh, hl, byte_u32):
+    """One rolling-hash update on (T,) lanes; returns new (hh, hl)."""
+    v = byte_u32 + U32(1)
+    gl = v * _C1
+    gh = v * _C2
+    # h = (h << 1) + g  (64-bit via lane pairs)
+    sh = (hh << U32(1)) | (hl >> U32(31))
+    sl = hl << U32(1)
+    lo = sl + gl
+    carry = (lo < sl).astype(U32)
+    hi = sh + gh + carry
+    return hi, lo
+
+
+@functools.partial(jax.jit, static_argnames=("avg_bits",))
+def gear_candidates_tiled(words, avg_bits: int = 13):
+    """Candidate-boundary bitmask for tiled byte streams.
+
+    ``words``: (T, S/4) uint32 — T tiles of S bytes, little-endian packed
+    (byte j of a tile is ``(words[t, j//4] >> (8*(j%4))) & 0xFF``).  The
+    caller arranges tiles so each one carries the previous tile's last
+    ``WINDOW`` bytes as a prefix (overlap); bits for those positions are
+    reported like any other and must be dropped by the host wrapper.
+
+    Returns ``bits``: (T, S/PACK) uint32 — bit ``j%32`` of word ``j//32``
+    set iff position j is a candidate (hash top word & mask == 0, hash
+    state seeded from zero at tile start).
+    """
+    T, nwords = words.shape
+    if (nwords * 4) % GROUP:
+        raise ValueError(f"tile bytes must be a multiple of {GROUP}")
+    mask = U32((1 << avg_bits) - 1)
+
+    groups = words.reshape(T, (nwords * 4) // GROUP, GROUP // 4)
+    groups = jnp.transpose(groups, (1, 0, 2))  # (ngroups, T, GROUP/4)
+
+    def group_step(carry, grp):
+        hh, hl = carry
+        packed = []
+        acc = jnp.zeros((T,), dtype=U32)
+        bit = 0
+        for w in range(GROUP // 4):
+            word = grp[:, w]
+            for s in range(4):
+                byte = (word >> U32(8 * s)) & U32(0xFF)
+                hh, hl = _gear_step(hh, hl, byte)
+                hit = (hh & mask) == U32(0)
+                acc = acc | (hit.astype(U32) << U32(bit))
+                bit += 1
+                if bit == PACK:
+                    packed.append(acc)
+                    acc = jnp.zeros((T,), dtype=U32)
+                    bit = 0
+        return (hh, hl), jnp.stack(packed, axis=1)  # (T, GROUP/PACK)
+
+    h0 = (jnp.zeros((T,), U32), jnp.zeros((T,), U32))
+    _, bits = jax.lax.scan(group_step, h0, groups)  # (ngroups, T, GROUP/PACK)
+    return jnp.transpose(bits, (1, 0, 2)).reshape(T, -1)
+
+
+# ---------------------------------------------------------------------------
+# host edge
+# ---------------------------------------------------------------------------
+
+
+def _greedy_select(candidates: np.ndarray, length: int, min_size: int,
+                   max_size: int) -> list[int]:
+    """Sequential min/max pass over sorted candidate byte offsets.
+
+    Returns chunk end-offsets (exclusive), always ending with ``length``.
+    A cut is taken at the first candidate >= min_size after the previous
+    cut; if none lands before max_size, a forced cut at max_size.
+    """
+    out: list[int] = []
+    start = 0
+    i = 0
+    n = len(candidates)
+    while length - start > max_size:
+        # skip candidates before the min-size horizon
+        lo = start + min_size
+        hi = start + max_size
+        while i < n and candidates[i] < lo:
+            i += 1
+        if i < n and candidates[i] <= hi:
+            cut = int(candidates[i])
+            i += 1
+        else:
+            cut = hi
+        out.append(cut)
+        start = cut
+    out.append(length)
+    return out
+
+
+def host_candidates(data: bytes, avg_bits: int = 13) -> list[int]:
+    """Pure-Python reference for the device candidate kernel (tests)."""
+    mask = (1 << avg_bits) - 1
+    h = 0
+    out = []
+    for j, b in enumerate(data):
+        g = ((b + 1) * int(_C1) & 0xFFFFFFFF) | (
+            ((b + 1) * int(_C2) & 0xFFFFFFFF) << 32
+        )
+        h = ((h << 1) + g) & 0xFFFFFFFFFFFFFFFF
+        if (h >> 32) & mask == 0:
+            out.append(j)
+    return out
+
+
+def chunk_stream(
+    data,
+    avg_bits: int = 13,
+    min_size: int | None = None,
+    max_size: int | None = None,
+    tile_bytes: int = 1 << 17,
+    slab_tiles: int = 8192,
+) -> list[int]:
+    """Content-defined chunk end-offsets for a byte stream.
+
+    ``data``: bytes or uint8 numpy array.  Processes ``slab_tiles`` tiles
+    of ``tile_bytes`` per device dispatch (bounded memory regardless of
+    blob size).  Tiles overlap by ``WINDOW`` bytes so every position sees
+    its full 64-byte context except the first WINDOW bytes of the stream,
+    matching :func:`host_candidates` exactly.
+    """
+    if min_size is None:
+        min_size = 1 << (avg_bits - 2)
+    if max_size is None:
+        max_size = 1 << (avg_bits + 2)
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)
+    ) else np.asarray(data, dtype=np.uint8)
+    length = len(buf)
+    if length == 0:
+        return []
+
+    candidates = _device_candidates(buf, avg_bits, tile_bytes, slab_tiles)
+    return _greedy_select(candidates, length, min_size, max_size)
+
+
+def _device_candidates(buf: np.ndarray, avg_bits: int, tile_bytes: int,
+                       slab_tiles: int) -> np.ndarray:
+    """All candidate positions (sorted, absolute) via tiled device scans.
+
+    The device returns the packed bitmask (1/8 of the input volume); bit
+    positions are recovered on the host with ``np.unpackbits`` — the
+    candidate set is sparse, the bitmask transfer is the only volume.
+    On TPU backends the Pallas kernel does the scan; elsewhere the
+    portable XLA path (:func:`gear_candidates_tiled`).
+    """
+    length = len(buf)
+    stride = tile_bytes  # payload bytes per tile (excluding overlap)
+    ntiles = -(-length // stride)
+    use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        from .rabin_pallas import gear_candidates_pallas
+    out: list[np.ndarray] = []
+    for slab_start in range(0, ntiles, slab_tiles):
+        rows = []
+        bases = []
+        for t in range(slab_start, min(slab_start + slab_tiles, ntiles)):
+            begin = t * stride
+            lead = WINDOW if begin >= WINDOW else begin
+            seg = buf[begin - lead : begin + stride]
+            # [warm-up prefix | payload] at row start, zero pad at the
+            # TAIL only: the hash is causal, so tail zeros are harmless,
+            # while a zero *prefix* would corrupt the warm-up of the
+            # stream's first tile (host seeds h=0 with no prefix at all)
+            width = -(-(WINDOW + stride) // GROUP) * GROUP
+            row = np.zeros(width, dtype=np.uint8)
+            row[: len(seg)] = seg
+            rows.append(row)
+            bases.append((begin, lead, min(stride, length - begin)))
+        block = np.stack(rows)  # (rows, width) u8
+        words = jnp.asarray(block.view("<u4"))
+        if use_pallas:
+            bits = gear_candidates_pallas(words, avg_bits)
+        else:
+            bits = gear_candidates_tiled(words, avg_bits)
+        bits_np = np.ascontiguousarray(np.asarray(bits))
+        for r, (begin, lead, valid) in enumerate(bases):
+            dense = np.nonzero(
+                np.unpackbits(bits_np[r].view(np.uint8), bitorder="little")
+            )[0]
+            # positions are tile-local: [0, lead) is the warm-up prefix
+            # (already reported by the previous tile), then the payload
+            local = dense - lead
+            keep = (local >= 0) & (local < valid)
+            out.append((local[keep] + begin).astype(np.int64))
+    if not out:
+        return np.empty((0,), dtype=np.int64)
+    return np.concatenate(out)

@@ -75,20 +75,22 @@ def _merge_roots(root_hh, root_hl):
     return merkle.root(g_hh, g_hl)
 
 
-def digest_root_step(mesh: Mesh, mh, ml, lengths):
-    """The sharded full step: padded payload batch in -> digests + root.
+def _check_shard(mesh: Mesh, B: int, what: str) -> None:
+    n = mesh.devices.size
+    per = B // n if n and B % n == 0 else None
+    if per is None or per & (per - 1) or per == 0:
+        raise ValueError(
+            f"{what}: batch size {B} over {n} devices needs a power-of-two "
+            f"per-chip shard (got {B}/{n}); pad the batch first"
+        )
 
-    Inputs follow the :func:`..ops.blake2b.blake2b_packed` layout —
-    ``mh/ml`` (B, nblocks, 16) uint32 message words, ``lengths`` (B,) —
-    with B divisible by the mesh size.  Per chip: hash the local shard,
-    fold the local digests into a subtree root.  Cross-chip: gather the
-    per-chip roots, finish the top tree, psum the byte counter.
 
-    Returns ``(leaf_hh, leaf_hl, root_hh, root_hl, total_bytes)`` where the
-    leaf digests stay sharded over the batch axis and the root/counter are
-    replicated.  ``total_bytes`` is an exact Python int (recombined from
-    16-bit partial sums, immune to uint32 wrap for batches up to 2**16
-    items of any size).
+@functools.lru_cache(maxsize=None)
+def _digest_root_program(mesh: Mesh):
+    """Jitted sharded digest step, cached per mesh.
+
+    Built once per mesh so repeated per-batch calls hit jax's jit cache
+    (a fresh closure per call would retrace and recompile every time).
     """
 
     def step(mh, ml, lengths):
@@ -105,16 +107,64 @@ def digest_root_step(mesh: Mesh, mh, ml, lengths):
 
     sharded = P(DATA_AXIS)
     rep = P()
-    fn = shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(sharded, sharded, sharded),
-        out_specs=(sharded, sharded, rep, rep, rep, rep),
-        check_vma=False,
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(sharded, sharded, sharded),
+            out_specs=(sharded, sharded, rep, rep, rep, rep),
+            check_vma=False,
+        )
     )
-    leaf_hh, leaf_hl, root_hh, root_hl, hi, lo = jax.jit(fn)(mh, ml, lengths)
+
+
+def digest_root_step(mesh: Mesh, mh, ml, lengths):
+    """The sharded full step: padded payload batch in -> digests + root.
+
+    Inputs follow the :func:`..ops.blake2b.blake2b_packed` layout —
+    ``mh/ml`` (B, nblocks, 16) uint32 message words, ``lengths`` (B,) —
+    with B divisible by the mesh size and a power-of-two per-chip shard
+    (the local Merkle fold is a binary tree).  Per chip: hash the local
+    shard, fold the local digests into a subtree root.  Cross-chip:
+    gather the per-chip roots, finish the top tree, psum the byte
+    counter.
+
+    Returns ``(leaf_hh, leaf_hl, root_hh, root_hl, total_bytes)`` where the
+    leaf digests stay sharded over the batch axis and the root/counter are
+    replicated.  ``total_bytes`` is an exact Python int (recombined from
+    16-bit partial sums, immune to uint32 wrap for batches up to 2**16
+    items of any size).
+    """
+    _check_shard(mesh, mh.shape[0], "digest_root_step")
+    fn = _digest_root_program(mesh)
+    leaf_hh, leaf_hl, root_hh, root_hl, hi, lo = fn(mh, ml, lengths)
     total = (int(hi) << 16) + int(lo)
     return leaf_hh, leaf_hl, root_hh, root_hl, total
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_diff_program(mesh: Mesh):
+    """Jitted sharded diff, cached per mesh (see _digest_root_program)."""
+
+    def step(a_hh, a_hl, b_hh, b_hl):
+        mask, (lra_hh, lra_hl), (lrb_hh, lrb_hl) = merkle.diff_root_guided(
+            a_hh, a_hl, b_hh, b_hl
+        )
+        ra = _merge_roots(lra_hh, lra_hl)
+        rb = _merge_roots(lrb_hh, lrb_hl)
+        return mask, ra[0], ra[1], rb[0], rb[1]
+
+    sharded = P(DATA_AXIS)
+    rep = P()
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(sharded, sharded, sharded, sharded),
+            out_specs=(sharded, rep, rep, rep, rep),
+            check_vma=False,
+        )
+    )
 
 
 def sharded_diff(mesh: Mesh, a_hh, a_hl, b_hh, b_hl):
@@ -128,23 +178,7 @@ def sharded_diff(mesh: Mesh, a_hh, a_hl, b_hh, b_hl):
     Returns ``(mask, a_root, b_root)`` with ``mask`` sharded like the
     leaves and each root a replicated ``((1,4),(1,4))`` hi/lo pair.
     """
-
-    def step(a_hh, a_hl, b_hh, b_hl):
-        mask, (lra_hh, lra_hl), (lrb_hh, lrb_hl) = merkle.diff_root_guided(
-            a_hh, a_hl, b_hh, b_hl
-        )
-        ra = _merge_roots(lra_hh, lra_hl)
-        rb = _merge_roots(lrb_hh, lrb_hl)
-        return mask, ra[0], ra[1], rb[0], rb[1]
-
-    sharded = P(DATA_AXIS)
-    rep = P()
-    fn = shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(sharded, sharded, sharded, sharded),
-        out_specs=(sharded, rep, rep, rep, rep),
-        check_vma=False,
-    )
-    mask, ra_hh, ra_hl, rb_hh, rb_hl = jax.jit(fn)(a_hh, a_hl, b_hh, b_hl)
+    _check_shard(mesh, a_hh.shape[0], "sharded_diff")
+    fn = _sharded_diff_program(mesh)
+    mask, ra_hh, ra_hl, rb_hh, rb_hl = fn(a_hh, a_hl, b_hh, b_hl)
     return mask, (ra_hh, ra_hl), (rb_hh, rb_hl)

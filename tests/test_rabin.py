@@ -1,0 +1,102 @@
+"""Gear CDC kernel vs the pure-Python reference.
+
+Small tile sizes force multi-tile paths so the 64-byte overlap warm-up,
+first-tile seeding, and slab iteration are all exercised (SURVEY.md §7
+hard part (b): rolling-hash tile boundaries).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from dat_replication_protocol_tpu.ops import rabin
+
+
+def _data(n, seed=0):
+    return random.Random(seed).randbytes(n)
+
+
+def _device_candidates(data, avg_bits=8, tile_bytes=1 << 12, slab_tiles=4):
+    return rabin._device_candidates(
+        np.frombuffer(data, dtype=np.uint8), avg_bits, tile_bytes, slab_tiles
+    ).tolist()
+
+
+def test_candidates_match_host_single_tile():
+    data = _data(2000, seed=1)
+    assert _device_candidates(data) == rabin.host_candidates(data, 8)
+
+
+def test_candidates_match_host_multi_tile_and_slab():
+    # 5 tiles of 4 KiB across 2 slabs; non-multiple tail
+    data = _data(5 * 4096 - 123, seed=2)
+    assert _device_candidates(data) == rabin.host_candidates(data, 8)
+
+
+def test_candidates_first_window_of_stream():
+    # the stream head has no 64-byte context; device must match host there
+    data = _data(4096, seed=3)
+    got = _device_candidates(data)
+    exp = rabin.host_candidates(data, 8)
+    assert [p for p in got if p < 64] == [p for p in exp if p < 64]
+    assert got == exp
+
+
+def test_tile_boundary_positions_identical():
+    # candidates in the WINDOW bytes around a tile edge must be identical
+    # to a single-tile run over the same data
+    data = _data(8192, seed=4)
+    multi = _device_candidates(data, tile_bytes=1 << 12)
+    single = _device_candidates(data, tile_bytes=1 << 13)
+    assert multi == single
+
+
+def test_greedy_select_min_max():
+    # candidates at 10,20,30,... ; min 15 skips near ones, max 25 forces
+    cands = np.array([10, 20, 30, 50, 90])
+    cuts = rabin._greedy_select(cands, 100, min_size=15, max_size=25)
+    # from 0: first cand >=15 and <=25 -> 20; from 20: >=35,<=45 -> none
+    # in [30..] within? 30<35 skip, 50>45 -> forced 45; from 45: >=60,<=70
+    # -> none (50<60, 90>70) -> forced 70; from 70: >=85,<=95 -> 90; rest
+    assert cuts == [20, 45, 70, 90, 100]
+
+
+def test_chunk_stream_end_to_end():
+    data = _data(100_000, seed=5)
+    cuts = rabin.chunk_stream(data, avg_bits=8, tile_bytes=1 << 13)
+    assert cuts[-1] == len(data)
+    assert cuts == sorted(set(cuts))
+    sizes = np.diff([0] + cuts)
+    assert (sizes >= 1).all() and (sizes <= 1 << 10).all()
+    # every non-final cut is either a true candidate or a forced max cut
+    cands = set(rabin.host_candidates(data, 8))
+    min_size, max_size = 1 << 6, 1 << 10
+    start = 0
+    for c in cuts[:-1]:
+        assert (c in cands) or (c - start == max_size)
+        assert c - start >= min_size or c - start == max_size
+        start = c
+
+
+def test_chunk_stream_empty_and_tiny():
+    assert rabin.chunk_stream(b"") == []
+    assert rabin.chunk_stream(b"abc") == [3]
+
+
+def test_pallas_kernel_matches_scan_path_interpret():
+    import jax.numpy as jnp
+
+    from dat_replication_protocol_tpu.ops.rabin_pallas import (
+        gear_candidates_pallas,
+    )
+
+    data = _data(3 * 1024, seed=9)
+    words = jnp.asarray(
+        np.frombuffer(data, dtype=np.uint8).reshape(3, 1024).view("<u4")
+    )
+    scan_bits = np.asarray(rabin.gear_candidates_tiled(words, 8))
+    pallas_bits = np.asarray(
+        gear_candidates_pallas(words, 8, interpret=True)
+    )
+    assert np.array_equal(scan_bits, pallas_bits)
