@@ -1,0 +1,183 @@
+// Native runtime for dat_replication_protocol_tpu: the host-side hot loops.
+//
+// The reference's hot receive path is a byte-at-a-time varint scan and
+// per-frame dispatch in JS (reference: decode.js:144-169, 251-262).  The
+// TPU-native framework needs the same parsing at change-log-replay scale
+// (BASELINE.json config 2: 1M-row replay) where per-record Python costs
+// ~1us each; this translation unit provides the two tight loops behind a
+// plain C ABI (loaded via ctypes — no pybind11 in the image):
+//
+//   dat_split_frames    multibuffer framing: varint(len+1) | id | payload
+//   dat_decode_changes  proto2 `Change` records -> columnar arrays
+//                       (zero-copy: strings/bytes become (offset, len)
+//                       views into the log buffer — the layout the device
+//                       feed packs from directly)
+//
+// Build: g++ -O3 -shared -fPIC (runtime/native.py does this on demand and
+// caches the .so; every entry point has a pure-Python fallback).
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+// Decode one unsigned LEB128 varint at buf[i..len).  Returns the number of
+// bytes consumed (0 = truncated, -1 = overlong/>10 bytes).
+inline int read_uvarint(const uint8_t* buf, int64_t i, int64_t len,
+                        uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  for (int k = 0; k < 10; ++k) {
+    if (i + k >= len) return 0;
+    uint8_t b = buf[i + k];
+    v |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return k + 1;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Error codes shared by both entry points.
+enum {
+  DAT_ERR_TRUNCATED = -1,
+  DAT_ERR_CAPACITY = -2,
+  DAT_ERR_BAD_VARINT = -3,
+  DAT_ERR_BAD_RECORD = -4,
+};
+
+// Split a multibuffer stream into frames.
+//
+// On success returns the frame count (<= cap) and fills, per frame:
+//   starts[f]  byte offset of the payload (after the id byte)
+//   lens[f]    payload length (framed length minus the id byte)
+//   ids[f]     the 1-byte type id (unvalidated; policy lives above)
+// `consumed` gets the offset one past the last complete frame (a partial
+// trailing frame is not an error — streaming callers re-feed the tail).
+// Negative return = error code above.
+int64_t dat_split_frames(const uint8_t* buf, int64_t len, int64_t* starts,
+                         int64_t* lens, uint8_t* ids, int64_t cap,
+                         int64_t* consumed) {
+  int64_t i = 0;
+  int64_t n = 0;
+  *consumed = 0;
+  while (i < len) {
+    uint64_t framed;
+    int used = read_uvarint(buf, i, len, &framed);
+    if (used == 0) break;  // partial header at tail
+    if (used < 0) return DAT_ERR_BAD_VARINT;
+    if (framed == 0) return DAT_ERR_BAD_RECORD;  // must include the id byte
+    int64_t payload = static_cast<int64_t>(framed) - 1;
+    int64_t frame_end = i + used + 1 + payload;
+    if (frame_end > len) break;  // partial frame at tail
+    if (n >= cap) return DAT_ERR_CAPACITY;
+    ids[n] = buf[i + used];
+    starts[n] = i + used + 1;
+    lens[n] = payload;
+    ++n;
+    i = frame_end;
+    *consumed = i;
+  }
+  return n;
+}
+
+// Proto2 tags for the Change message (reference: messages/schema.proto:1-8).
+enum {
+  TAG_SUBSET = (1 << 3) | 2,
+  TAG_KEY = (2 << 3) | 2,
+  TAG_CHANGE = (3 << 3) | 0,
+  TAG_FROM = (4 << 3) | 0,
+  TAG_TO = (5 << 3) | 0,
+  TAG_VALUE = (6 << 3) | 2,
+};
+
+// Decode n Change payloads into columnar arrays.
+//
+// Absent optional fields get len -1 (host maps to ''/b'').  Unknown fields
+// are skipped per proto2.  Returns 0, or a negative error with err_index
+// set to the offending record.
+int64_t dat_decode_changes(const uint8_t* buf, const int64_t* starts,
+                           const int64_t* lens, int64_t n, uint32_t* change,
+                           uint32_t* from_v, uint32_t* to_v, int64_t* key_off,
+                           int64_t* key_len, int64_t* sub_off,
+                           int64_t* sub_len, int64_t* val_off,
+                           int64_t* val_len, int64_t* err_index) {
+  for (int64_t r = 0; r < n; ++r) {
+    int64_t i = starts[r];
+    const int64_t end = i + lens[r];
+    bool has_key = false, has_change = false, has_from = false, has_to = false;
+    sub_len[r] = -1;
+    val_len[r] = -1;
+    sub_off[r] = 0;
+    val_off[r] = 0;
+    while (i < end) {
+      uint64_t tag;
+      int used = read_uvarint(buf, i, end, &tag);
+      if (used <= 0) goto bad;
+      i += used;
+      switch (tag & 7) {
+        case 0: {  // varint
+          uint64_t v;
+          used = read_uvarint(buf, i, end, &v);
+          if (used <= 0) goto bad;
+          i += used;
+          if (tag == TAG_CHANGE) {
+            change[r] = static_cast<uint32_t>(v);
+            has_change = true;
+          } else if (tag == TAG_FROM) {
+            from_v[r] = static_cast<uint32_t>(v);
+            has_from = true;
+          } else if (tag == TAG_TO) {
+            to_v[r] = static_cast<uint32_t>(v);
+            has_to = true;
+          }
+          break;
+        }
+        case 2: {  // length-delimited
+          uint64_t ln;
+          used = read_uvarint(buf, i, end, &ln);
+          if (used <= 0) goto bad;
+          i += used;
+          if (i + static_cast<int64_t>(ln) > end) goto bad;
+          if (tag == TAG_SUBSET) {
+            sub_off[r] = i;
+            sub_len[r] = static_cast<int64_t>(ln);
+          } else if (tag == TAG_KEY) {
+            key_off[r] = i;
+            key_len[r] = static_cast<int64_t>(ln);
+            has_key = true;
+          } else if (tag == TAG_VALUE) {
+            val_off[r] = i;
+            val_len[r] = static_cast<int64_t>(ln);
+          }
+          i += static_cast<int64_t>(ln);
+          break;
+        }
+        case 5:  // fixed32 (unknown field)
+          if (i + 4 > end) goto bad;
+          i += 4;
+          break;
+        case 1:  // fixed64 (unknown field)
+          if (i + 8 > end) goto bad;
+          i += 8;
+          break;
+        default:
+          goto bad;
+      }
+    }
+    if (!has_key || !has_change || !has_from || !has_to) goto bad;
+    continue;
+  bad:
+    *err_index = r;
+    return DAT_ERR_BAD_RECORD;
+  }
+  return 0;
+}
+
+}  // extern "C"

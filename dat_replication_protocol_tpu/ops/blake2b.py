@@ -188,7 +188,7 @@ def compress_soa(h, m, t_lo, is_final, unroll: bool | None = None, sigma=None):
     ]
 
 
-def compress(hh, hl, mh, ml, t_lo, is_final):
+def compress(hh, hl, mh, ml, t_lo, is_final, unroll: bool | None = None):
     """Array-of-struct wrapper over :func:`compress_soa`.
 
     state (B, 8) hi/lo pairs, block (B, 16) pairs — the layout the packers
@@ -198,7 +198,7 @@ def compress(hh, hl, mh, ml, t_lo, is_final):
     """
     h = [(hh[:, i], hl[:, i]) for i in range(8)]
     m = [(mh[:, i], ml[:, i]) for i in range(16)]
-    h = compress_soa(h, m, t_lo, is_final)
+    h = compress_soa(h, m, t_lo, is_final, unroll=unroll)
     return (
         jnp.stack([p[0] for p in h], axis=1),
         jnp.stack([p[1] for p in h], axis=1),

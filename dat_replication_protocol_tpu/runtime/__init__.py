@@ -1,0 +1,17 @@
+"""Host runtime: native (C++) parsing loops + change-log replay engine."""
+
+from .replay import (
+    ChangeColumns,
+    FrameIndex,
+    decode_change_columns,
+    replay_log,
+    split_frames,
+)
+
+__all__ = [
+    "ChangeColumns",
+    "FrameIndex",
+    "decode_change_columns",
+    "replay_log",
+    "split_frames",
+]
