@@ -1,24 +1,28 @@
-"""Benchmark harness — headline: batched BLAKE2b blob-hash throughput.
+"""Benchmark harness — all five BASELINE.json configs.
 
-Runs BASELINE.json config 3 ("10k x 1 MiB blob stream BLAKE2b
-content-hashing (batched)") on the default JAX backend and prints exactly
-ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly ONE JSON line on stdout:
 
-``vs_baseline`` is measured GiB/s divided by the 50 GiB/s north-star
-target (the reference itself publishes no numbers — BASELINE.md).
+    {"metric": "blake2b_batched_blob_hash_throughput", "value": N,
+     "unit": "GiB/s", "vs_baseline": N, "backend": ..., "configs": {...}}
 
-The payload batch is generated directly on device in the packed layout
-consumed by the hash kernel — the bench measures the device kernel, not
-host byte-shuffling (the host feed path is benched separately by the
-replay-engine config).  On TPU this is the Pallas kernel
-(:mod:`dat_replication_protocol_tpu.ops.blake2b_pallas`); on CPU the
-portable XLA-scan path, on much smaller defaults.  HBM is bounded by
-hashing a resident chunk of items repeatedly until the config's total
-volume is reached.
+The headline metric is config 3 (the 50 GiB/s north-star target);
+``configs`` carries one result object per BASELINE config:
 
-Env knobs: BENCH_ITEMS (default 10240), BENCH_ITEM_MIB (default 1),
-BENCH_CHUNK (items resident at once, default 4096 on TPU; rounded to the
-Pallas kernel's 1024-item tile there).
+  1 roundtrip     sessions/sec of the test/basic.js encode->decode flow
+  2 replay        rows/sec of 1M-row change-log replay (native engine)
+  3 hash          GiB/s of batched BLAKE2b blob hashing   (target 50)
+  4 cdc           GiB/s of content-defined chunking incl. host select
+  5 merkle_diff   entries/sec of two-snapshot tree diff    (target 10M)
+
+Robustness (round-1 failure was a backend-init crash that cost the round
+its only perf artifact): device-backend init is retried with backoff and
+falls back to CPU, recording the error; each config runs in its own
+try/except so one failure cannot blank the others; ``--quick`` is small
+on every backend (<30 s on CPU).
+
+Env knobs: BENCH_ITEMS / BENCH_ITEM_MIB / BENCH_CHUNK (config 3),
+BENCH_REPLAY_ROWS, BENCH_CDC_MIB / BENCH_CDC_REPS, BENCH_MERKLE_LOG2,
+BENCH_ROUNDTRIPS, BENCH_CONFIGS (comma list, default "1,2,3,4,5").
 """
 
 from __future__ import annotations
@@ -27,55 +31,227 @@ import json
 import os
 import sys
 import time
+import traceback
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def _probe_backend(platform: str | None, timeout: float) -> tuple[str | None, str | None]:
+    """Initialize JAX in a THROWAWAY subprocess and report its backend.
+
+    Round 1 died on "Unable to initialize backend 'axon': UNAVAILABLE";
+    worse, a wedged device tunnel can make ``jax.devices()`` hang forever
+    (observed: >300 s with no exception).  A subprocess probe turns both
+    failure modes into something the parent can retry or route around —
+    the parent only initializes a platform the probe verified.
+    """
+    import subprocess
+
+    code = "import jax\n"
+    if platform:
+        code += f"jax.config.update('jax_platforms', {platform!r})\n"
+    code += "print('PROBE', jax.default_backend(), len(jax.devices()))\n"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init hung (> {timeout:.0f}s)"
+    if r.returncode == 0:
+        for line in r.stdout.splitlines():
+            if line.startswith("PROBE "):
+                return line.split()[1], None
+        return None, "probe produced no backend line"
+    tail = [ln for ln in r.stderr.strip().splitlines() if ln.strip()]
+    return None, (tail[-1] if tail else f"probe exited {r.returncode}")
+
+
+def init_backend(retries: int = 3, probe_timeout: float = 90.0) -> tuple[str, str | None]:
+    """Pick the JAX backend, with retry/backoff and CPU fallback.
+
+    A degraded CPU number beats no number (round 1 captured nothing).
+    ``BENCH_PLATFORM`` overrides the platform (the dev image's
+    sitecustomize re-forces JAX_PLATFORMS after env vars are read;
+    ``jax.config`` wins over both — same trick as tests/conftest).
+    """
+    import jax
+
+    # persistent compile cache: repeat runs (and driver re-runs) skip the
+    # multi-minute cold XLA compiles that dominate --quick wall time.
+    # Scoped per machine + jax version: XLA AOT artifacts from a different
+    # host can SIGILL (observed warnings from a shared cache dir).
+    try:
+        import hashlib
+        import platform
+
+        scope = hashlib.blake2b(
+            f"{platform.platform()}-{platform.processor()}-{jax.__version__}".encode(),
+            digest_size=6,
+        ).hexdigest()
+        cache_dir = os.environ.get(
+            "BENCH_COMPILE_CACHE", f"/tmp/dat_jax_cache-{scope}"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:
+        log(f"bench: compile cache unavailable ({e})")
+
+    force = os.environ.get("BENCH_PLATFORM") or None
+    err: str | None = None
+    for attempt in range(retries):
+        backend, err = _probe_backend(force, probe_timeout)
+        if backend is not None:
+            if force:
+                jax.config.update("jax_platforms", force)
+            # no jax.devices() here: the tunnel could wedge between probe
+            # and now, and a parent-side hang has no fallback (the
+            # watchdog in main() is the last line of defense)
+            log(f"bench: backend={backend} (probed)")
+            return backend, None
+        if attempt < retries - 1:
+            wait = 3.0 * 2**attempt
+            log(f"bench: backend probe failed ({err}); retry in {wait:.0f}s")
+            time.sleep(wait)
+    log(f"bench: device backend unavailable ({err}); falling back to CPU")
+    jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+    return "cpu", err
+
+
+# ---------------------------------------------------------------------------
+# config 1: test/basic.js-shaped roundtrip (reference: test/basic.js:1-127)
+# ---------------------------------------------------------------------------
+
+
+def bench_roundtrip(quick: bool, backend: str) -> dict:
+    import dat_replication_protocol_tpu as protocol
+
+    n = _env_int("BENCH_ROUNDTRIPS", 200 if quick else 2000)
+
+    def one_session():
+        got = []
+        enc = protocol.encode()
+        dec = protocol.decode()
+        dec.change(lambda ch, done: (got.append(ch.key), done()))
+        dec.blob(lambda blob, done: blob.collect(lambda b: (got.append(b), done())))
+        enc.change({"key": "a", "change": 1, "from_": 0, "to": 1, "value": b"v"})
+        ws = enc.blob(12)
+        ws.write(b"hello ")
+        ws.end(b"world!")
+        enc.change({"key": "b", "change": 2, "from_": 1, "to": 2})
+        enc.finalize()
+        protocol.pipe(enc, dec)
+        assert got == ["a", b"hello world!", "b"], got
+
+    one_session()  # correctness gate + warmup
+    t0 = time.perf_counter()
+    for _ in range(n):
+        one_session()
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "session_roundtrip_rate",
+        "value": round(n / dt, 1),
+        "unit": "sessions/s",
+        "vs_baseline": None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 2: 1M-row change-log replay (native framing + proto decode)
+# ---------------------------------------------------------------------------
+
+
+def bench_replay(quick: bool, backend: str) -> dict:
+    import numpy as np
+
+    from dat_replication_protocol_tpu.runtime import native, replay
+    from dat_replication_protocol_tpu.wire.change_codec import Change, encode_change
+    from dat_replication_protocol_tpu.wire.framing import TYPE_CHANGE, frame
+
+    rows = _env_int("BENCH_REPLAY_ROWS", 20_000 if quick else 1_000_000)
+    # build the log from a repeated block of distinct records: encoding
+    # 1M rows one-by-one in Python would dominate setup time
+    block_n = min(rows, 4096)
+    block = b"".join(
+        frame(
+            TYPE_CHANGE,
+            encode_change(
+                Change(
+                    key=f"key-{i:07d}",
+                    change=i,
+                    from_=i,
+                    to=i + 1,
+                    value=b"v" * (i % 48),
+                    subset="s" if i % 3 else None,
+                )
+            ),
+        )
+        for i in range(block_n)
+    )
+    reps = -(-rows // block_n)
+    log_buf = np.frombuffer(block * reps, dtype=np.uint8)
+    total_rows = block_n * reps
+
+    t0 = time.perf_counter()
+    cols, frames = replay.replay_log(log_buf)
+    dt = time.perf_counter() - t0
+    assert len(cols) == total_rows
+    return {
+        "metric": "change_log_replay_rate",
+        "value": round(total_rows / dt, 0),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "native": native.available(),
+        "rows": total_rows,
+        "log_mib": round(log_buf.nbytes / (1 << 20), 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 3: batched BLAKE2b blob hashing (headline; target >= 50 GiB/s)
+# ---------------------------------------------------------------------------
+
+
+def bench_hash(quick: bool, backend: str) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from dat_replication_protocol_tpu.ops.blake2b import (
-        BLOCK_BYTES,
-        blake2b_packed,
-    )
+    from dat_replication_protocol_tpu.ops.blake2b import BLOCK_BYTES, blake2b_packed
 
-    backend = jax.default_backend()
-    use_pallas = backend == "tpu"
-    quick = "--quick" in sys.argv
-
+    use_pallas = backend in ("tpu", "axon")
     if quick:
-        d_items, d_mib, d_chunk = 2048, 0.125, 2048
+        d_items, d_mib, d_chunk = (2048, 1, 2048) if use_pallas else (32, 0.125, 32)
     elif use_pallas:
         d_items, d_mib, d_chunk = 10240, 1, 4096
     else:
         d_items, d_mib, d_chunk = 64, 0.125, 32
-    items = int(os.environ.get("BENCH_ITEMS", d_items))
+    items = _env_int("BENCH_ITEMS", d_items)
     item_mib = float(os.environ.get("BENCH_ITEM_MIB", d_mib))
-    chunk = int(os.environ.get("BENCH_CHUNK", d_chunk))
-    chunk = min(chunk, items)
+    chunk = min(_env_int("BENCH_CHUNK", d_chunk), items)
     if use_pallas:
-        # the pallas kernel tiles the batch in 1024-item blocks
-        chunk = max(1024, chunk // 1024 * 1024)
+        chunk = max(1024, chunk // 1024 * 1024)  # pallas tiles 1024 items
 
-    item_bytes = int(item_mib * (1 << 20))
-    nblocks = max(1, item_bytes // BLOCK_BYTES)
-    item_bytes = nblocks * BLOCK_BYTES
+    item_bytes = max(BLOCK_BYTES, int(item_mib * (1 << 20)) // BLOCK_BYTES * BLOCK_BYTES)
+    nblocks = item_bytes // BLOCK_BYTES
     reps = max(1, items // chunk)
-
     log(
-        f"bench: backend={backend} pallas={use_pallas} "
-        f"items={reps * chunk} x {item_bytes} B (chunk={chunk}, reps={reps})"
+        f"bench[hash]: pallas={use_pallas} items={reps * chunk} x {item_bytes} B "
+        f"(chunk={chunk}, reps={reps})"
     )
 
     kh, kl = jax.random.split(jax.random.PRNGKey(0))
     if use_pallas:
-        from dat_replication_protocol_tpu.ops.blake2b_pallas import (
-            blake2b_native,
-        )
+        from dat_replication_protocol_tpu.ops.blake2b_pallas import blake2b_native
 
         shape = (nblocks, 16, 8, chunk // 8)
         mh = jax.random.bits(kh, shape, dtype=jnp.uint32)
@@ -90,35 +266,231 @@ def main() -> None:
         run = lambda: blake2b_packed(mh, ml, lengths)  # noqa: E731
     jax.block_until_ready((mh, ml))
 
-    # warmup / compile
     t0 = time.perf_counter()
     np.asarray(run()[0])
-    log(f"bench: compile+first-run {time.perf_counter() - t0:.1f}s")
+    log(f"bench[hash]: compile+first-run {time.perf_counter() - t0:.1f}s")
 
-    # time via host transfer of the (tiny) digest outputs: on the tunneled
-    # axon platform block_until_ready returns before execution completes,
-    # so fetching the digests is the reliable completion barrier
+    # host transfer of the (tiny) digests is the completion barrier: on the
+    # tunneled axon platform block_until_ready returns before execution ends
     t0 = time.perf_counter()
     outs = [run() for _ in range(reps)]
     for hh, hl in outs:
         np.asarray(hh)
         np.asarray(hl)
-    elapsed = time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    total = reps * chunk * item_bytes
+    gib_s = total / dt / (1 << 30)
+    log(f"bench[hash]: {total / (1 << 30):.1f} GiB in {dt:.3f}s = {gib_s:.2f} GiB/s")
+    return {
+        "metric": "blake2b_batched_blob_hash_throughput",
+        "value": round(gib_s, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(gib_s / 50.0, 4),
+    }
 
-    total_bytes = reps * chunk * item_bytes
-    gib_s = total_bytes / elapsed / (1 << 30)
-    log(f"bench: {total_bytes / (1 << 30):.1f} GiB in {elapsed:.3f}s")
 
-    print(
-        json.dumps(
-            {
-                "metric": "blake2b_batched_blob_hash_throughput",
-                "value": round(gib_s, 3),
-                "unit": "GiB/s",
-                "vs_baseline": round(gib_s / 50.0, 4),
-            }
-        )
+# ---------------------------------------------------------------------------
+# config 4: content-defined chunking over a large blob (10 GiB volume)
+# ---------------------------------------------------------------------------
+
+
+def bench_cdc(quick: bool, backend: str) -> dict:
+    import numpy as np
+
+    from dat_replication_protocol_tpu.ops.rabin import chunk_stream
+
+    on_tpu = backend in ("tpu", "axon")
+    if quick:
+        slab_mib, reps = (64, 2) if on_tpu else (2, 2)
+    elif on_tpu:
+        slab_mib, reps = 1024, 10  # 10 GiB total volume via a 1 GiB slab
+    else:
+        slab_mib, reps = 8, 2
+    slab_mib = _env_int("BENCH_CDC_MIB", slab_mib)
+    reps = _env_int("BENCH_CDC_REPS", reps)
+    slab = np.random.default_rng(0).integers(
+        0, 256, size=slab_mib << 20, dtype=np.uint8
     )
+
+    cuts = chunk_stream(slab)  # warmup/compile
+    nchunks = len(cuts)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        chunk_stream(slab)
+    dt = time.perf_counter() - t0
+    total = reps * slab.nbytes
+    gib_s = total / dt / (1 << 30)
+    log(
+        f"bench[cdc]: {total / (1 << 30):.1f} GiB in {dt:.3f}s = {gib_s:.2f} GiB/s "
+        f"({nchunks} chunks/slab)"
+    )
+    return {
+        "metric": "cdc_chunking_throughput",
+        "value": round(gib_s, 3),
+        "unit": "GiB/s",
+        "vs_baseline": None,
+        "volume_gib": round(total / (1 << 30), 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 5: Merkle diff of two snapshots (target >= 10M entries/sec)
+# ---------------------------------------------------------------------------
+
+
+def bench_merkle(quick: bool, backend: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dat_replication_protocol_tpu.ops.merkle import diff_root_guided
+
+    on_tpu = backend in ("tpu", "axon")
+    if quick:
+        log2 = 10  # compile time scales with level count on CPU
+    else:
+        log2 = 20 if on_tpu else 16
+    log2 = _env_int("BENCH_MERKLE_LOG2", log2)
+    n = 1 << log2
+
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    a_hh = jax.random.bits(keys[0], (n, 4), dtype=jnp.uint32)
+    a_hl = jax.random.bits(keys[1], (n, 4), dtype=jnp.uint32)
+    # b differs from a in ~1% of leaves
+    flip = jax.random.bernoulli(keys[2], 0.01, (n, 1))
+    b_hh = jnp.where(flip, a_hh ^ 1, a_hh)
+    b_hl = a_hl
+    jax.block_until_ready((a_hh, a_hl, b_hh, b_hl))
+
+    def run():
+        mask, _, _ = diff_root_guided(a_hh, a_hl, b_hh, b_hl)
+        # honest end-to-end: mask transfer + host index extraction included
+        return np.nonzero(np.asarray(mask))[0]
+
+    idx = run()  # warmup/compile
+    reps = 3 if quick else 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run()
+    dt = time.perf_counter() - t0
+    rate = reps * n / dt
+    log(
+        f"bench[merkle]: {log2}-level diff x{reps} in {dt:.3f}s = "
+        f"{rate / 1e6:.2f} M entries/s ({len(idx)} differing leaves)"
+    )
+    return {
+        "metric": "merkle_diff_rate",
+        "value": round(rate, 0),
+        "unit": "entries/s",
+        "vs_baseline": round(rate / 10e6, 4),
+        "leaves": n,
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+BENCHES = {
+    "1": ("roundtrip", bench_roundtrip),
+    "2": ("replay", bench_replay),
+    "3": ("hash", bench_hash),
+    "4": ("cdc", bench_cdc),
+    "5": ("merkle_diff", bench_merkle),
+}
+
+
+_state: dict = {"configs": {}, "backend": None, "backend_error": None}
+_emitted = False
+
+
+def _emit() -> None:
+    """Print the one JSON artifact line from whatever has completed.
+
+    Idempotent; also called by the deadline watchdog, so even a wedged
+    device call mid-run leaves a parseable artifact (round 1 left none).
+    """
+    global _emitted
+    if _emitted:
+        return
+    _emitted = True
+    configs = _state["configs"]
+    headline = configs.get("hash", {})
+    out = {
+        "metric": "blake2b_batched_blob_hash_throughput",
+        # null, not 0.0, when the headline config produced no number — a
+        # fake zero is indistinguishable from a measured failure downstream
+        "value": headline.get("value"),
+        "unit": "GiB/s",
+        "vs_baseline": headline.get("vs_baseline"),
+        "backend": _state["backend"],
+        "configs": configs,
+    }
+    if "error" in headline:
+        out["error"] = headline["error"]
+    if _state["backend_error"]:
+        out["backend_error"] = _state["backend_error"]
+    print(json.dumps(out), flush=True)
+
+
+def main() -> None:
+    import threading
+
+    quick = "--quick" in sys.argv
+    which = [
+        k.strip()
+        for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
+        if k.strip() in BENCHES
+    ]
+
+    # hard deadline: emit whatever completed and exit 0 — a wedged device
+    # call (observed: jax.devices() hanging >300 s) must not blank the run
+    deadline = float(os.environ.get("BENCH_DEADLINE", 600 if quick else 1800))
+    watchdog = threading.Timer(
+        deadline, lambda: (log(f"bench: deadline {deadline:.0f}s hit"), _emit(),
+                           os._exit(0)),
+    )
+    watchdog.daemon = True
+    watchdog.start()
+
+    def run_config(key: str, backend: str) -> None:
+        name, fn = BENCHES[key]
+        t0 = time.perf_counter()
+        try:
+            res = fn(quick, backend)
+            res["seconds"] = round(time.perf_counter() - t0, 2)
+            _state["configs"][name] = res
+            log(f"bench: config {key} ({name}) ok in {res['seconds']}s")
+        except Exception as e:
+            log(f"bench: config {key} ({name}) FAILED: {e}")
+            traceback.print_exc(file=sys.stderr)
+            _state["configs"][name] = {"error": f"{type(e).__name__}: {e}"}
+
+    # configs 1-2 need no JAX: run them before any backend init so a
+    # wedged/broken device stack cannot cost their numbers
+    for key in which:
+        if key in ("1", "2"):
+            run_config(key, "host")
+
+    device_keys = [k for k in which if k not in ("1", "2")]
+    if device_keys:
+        try:
+            backend, backend_err = init_backend(
+                retries=2 if quick else 3, probe_timeout=60 if quick else 90
+            )
+        except Exception as e:  # e.g. jax import failure
+            backend, backend_err = None, f"{type(e).__name__}: {e}"
+            log(f"bench: backend init failed outright: {e}")
+        _state["backend"] = backend
+        _state["backend_error"] = backend_err
+        if backend is not None:
+            for key in device_keys:
+                run_config(key, backend)
+        else:
+            for key in device_keys:
+                _state["configs"][BENCHES[key][0]] = {"error": backend_err}
+
+    watchdog.cancel()
+    _emit()
 
 
 if __name__ == "__main__":

@@ -30,6 +30,9 @@ inline int read_uvarint(const uint8_t* buf, int64_t i, int64_t len,
   for (int k = 0; k < 10; ++k) {
     if (i + k >= len) return 0;
     uint8_t b = buf[i + k];
+    // 10th byte may only contribute bit 63: anything else encodes a
+    // value >= 2^64 (overlong — matches the Python decoder's rejection).
+    if (k == 9 && (b & 0x7F) > 1) return -1;
     v |= static_cast<uint64_t>(b & 0x7F) << shift;
     if (!(b & 0x80)) {
       *out = v;
@@ -73,9 +76,14 @@ int64_t dat_split_frames(const uint8_t* buf, int64_t len, int64_t* starts,
     if (used == 0) break;  // partial header at tail
     if (used < 0) return DAT_ERR_BAD_VARINT;
     if (framed == 0) return DAT_ERR_BAD_RECORD;  // must include the id byte
+    // Unsigned compare BEFORE any int64 cast: a hostile length >= 2^63
+    // must not wrap negative and walk the cursor backwards.  Anything
+    // larger than the bytes on hand is a partial tail (streaming callers
+    // re-feed), matching the Python fallback's NeedMoreData behavior.
+    uint64_t remaining = static_cast<uint64_t>(len - i) - used;
+    if (framed > remaining) break;  // partial frame at tail
     int64_t payload = static_cast<int64_t>(framed) - 1;
     int64_t frame_end = i + used + 1 + payload;
-    if (frame_end > len) break;  // partial frame at tail
     if (n >= cap) return DAT_ERR_CAPACITY;
     ids[n] = buf[i + used];
     starts[n] = i + used + 1;
@@ -144,7 +152,9 @@ int64_t dat_decode_changes(const uint8_t* buf, const int64_t* starts,
           used = read_uvarint(buf, i, end, &ln);
           if (used <= 0) goto bad;
           i += used;
-          if (i + static_cast<int64_t>(ln) > end) goto bad;
+          // Unsigned compare before the cast: ln >= 2^63 would go
+          // negative as int64 and slip past the bounds check below.
+          if (ln > static_cast<uint64_t>(end - i)) goto bad;
           if (tag == TAG_SUBSET) {
             sub_off[r] = i;
             sub_len[r] = static_cast<int64_t>(ln);

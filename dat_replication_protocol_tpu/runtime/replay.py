@@ -131,6 +131,10 @@ def _split_native(lib, buf):
         raise ProtocolError("malformed varint in frame header")
     if n == native.ERR_BAD_RECORD:
         raise ProtocolError("framed length 0 (must include the id byte)")
+    if n == native.ERR_CAPACITY:
+        raise ProtocolError(
+            f"frame count exceeds capacity estimate ({cap})"
+        )
     if n < 0:
         raise ProtocolError(f"frame split failed (code {n})")
     return int(n), starts, lens, ids, int(consumed.value)

@@ -356,7 +356,11 @@ class Decoder:
             # varint terminated iff the *previous* byte had its MSB clear and
             # we now also hold the id byte.
             if len(self._header) >= 2 and not (self._header[-2] & 0x80):
-                framed_len, _ = decode_uvarint(self._header)
+                try:
+                    framed_len, _ = decode_uvarint(self._header)
+                except ValueError as e:  # e.g. varint exceeds 64 bits
+                    self.destroy(ProtocolError(str(e)))
+                    return None
                 type_id = self._header[-1]
                 self._header.clear()
                 self._missing = framed_len - 1  # length counts the id byte

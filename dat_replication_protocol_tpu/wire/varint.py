@@ -47,6 +47,8 @@ def decode_uvarint(buf, offset: int = 0) -> tuple[int, int]:
         i += 1
         value |= (b & 0x7F) << shift
         if not (b & 0x80):
+            if value >= 1 << 64:
+                raise ValueError("varint exceeds 64 bits")
             return value, i - offset
         shift += 7
         if i - offset >= MAX_VARINT_LEN:

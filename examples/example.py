@@ -1,0 +1,48 @@
+"""Canonical end-to-end wiring of both session ends in one process.
+
+Python analogue of the reference's example (reference: example.js:1-53):
+two changes, an 11-byte blob written in two chunks, a third change whose
+flush callback fires when the consumer pulls it, and a decoder printing
+everything it receives.  Run with::
+
+    python examples/example.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import dat_replication_protocol_tpu as protocol
+
+encode = protocol.encode()
+decode = protocol.decode()
+
+encode.change({"key": "lol1", "change": 1, "from_": 0, "to": 1, "value": b"val"})
+encode.change({"key": "lol", "change": 1, "from_": 0, "to": 1, "value": b"val"})
+
+b1 = encode.blob(11, on_flush=lambda: print("blob was flushed"))
+b1.write(b"hello ")
+b1.end(b"world")
+
+encode.change(
+    {"key": "lol", "change": 1, "from_": 0, "to": 1, "value": b"val"},
+    on_flush=lambda: print("change was flushed"),
+)
+
+
+def on_change(change, done):
+    print(change)
+    done()
+
+
+def on_blob(blob, done):
+    blob.on_data(lambda data: print(data))
+    blob.on_end(done)
+
+
+decode.change(on_change)
+decode.blob(on_blob)
+
+encode.finalize()
+protocol.pipe(encode, decode)

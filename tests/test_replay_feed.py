@@ -122,6 +122,44 @@ def test_replay_empty_framed_length_raises(native_mode):
         replay.split_frames(b"\x00")
 
 
+def test_replay_hostile_huge_frame_length(native_mode):
+    # 10-byte varint encoding 2^63: must not wrap negative in the native
+    # splitter and walk backwards (OOB read).  Treated as a partial tail
+    # in streaming mode, truncation error in strict mode — both paths.
+    from dat_replication_protocol_tpu.wire.varint import encode_uvarint
+
+    hostile = encode_uvarint(1 << 63) + bytes([TYPE_CHANGE]) + b"x" * 16
+    with pytest.raises(ProtocolError, match="truncated"):
+        replay.split_frames(hostile)
+    idx = replay.split_frames(hostile, allow_partial_tail=True)
+    assert len(idx) == 0 and idx.consumed == 0
+
+
+def test_replay_hostile_huge_record_field_length(native_mode):
+    # Change record whose `value` field claims a 2^63-byte length: the
+    # native decoder must reject it (unsigned bounds check), not read OOB.
+    from dat_replication_protocol_tpu.wire.varint import encode_uvarint
+
+    payload = (
+        bytes([(2 << 3) | 2, 1]) + b"k"  # key = "k"
+        + bytes([(3 << 3) | 0, 1])  # change = 1
+        + bytes([(4 << 3) | 0, 0])  # from = 0
+        + bytes([(5 << 3) | 0, 1])  # to = 1
+        + bytes([(6 << 3) | 2]) + encode_uvarint(1 << 63)  # value: huge len
+    )
+    log = frame(TYPE_CHANGE, payload)
+    with pytest.raises(ProtocolError, match="corrupt Change record at index 0"):
+        replay.replay_log(log)
+
+
+def test_replay_overlong_varint_rejected(native_mode):
+    # 10-byte varint whose 10th byte encodes bits >= 2^64: malformed on
+    # both paths (native returns BAD_VARINT, Python raises ValueError).
+    hostile = b"\x80" * 9 + b"\x7f" + bytes([TYPE_CHANGE]) + b"x"
+    with pytest.raises(ProtocolError):
+        replay.split_frames(hostile, allow_partial_tail=True)
+
+
 def test_native_and_python_agree():
     if not native.available():
         pytest.skip("no native toolchain")

@@ -72,6 +72,27 @@ def test_unknown_type_id_is_protocol_error():
     assert "unknown type" in str(errs[0])
 
 
+def test_overlong_header_varint_is_protocol_error():
+    # a 10-byte varint encoding >= 2^64 must destroy with ProtocolError,
+    # not leak ValueError out of write()
+    d = protocol.decode()
+    errs = []
+    d.on_error(lambda e: errs.append(e))
+    d.write(b"\x80" * 9 + b"\x7f" + bytes([TYPE_CHANGE]))
+    assert d.destroyed
+    assert isinstance(errs[0], ProtocolError)
+
+
+def test_huge_frame_length_waits_for_data():
+    # 2^63-byte claimed frame: the streaming decoder just waits for more
+    # bytes (never crashes, never goes negative)
+    from dat_replication_protocol_tpu.wire.varint import encode_uvarint
+
+    d = protocol.decode()
+    d.write(encode_uvarint(1 << 63) + bytes([TYPE_CHANGE]) + b"x" * 64)
+    assert not d.destroyed and not d.finished
+
+
 def test_corrupt_change_payload_is_protocol_error():
     d = protocol.decode()
     errs = []
