@@ -37,23 +37,57 @@ def _host_hash_batch(payloads: list[bytes]) -> list[bytes]:
     ]
 
 
-def _device_hash_batch_factory() -> Callable[[list[bytes]], list[bytes]] | None:
+def _device_hash_begin_factory():
     try:
-        from ..ops.blake2b import blake2b_batch  # noqa: PLC0415
+        from ..ops.blake2b import blake2b_batch_begin  # noqa: PLC0415
 
-        return blake2b_batch
+        return blake2b_batch_begin
     except Exception:
         return None
 
 
+# blobs at least this long hash incrementally instead of being joined in
+# host RAM for the batch path
+DEFAULT_STREAM_THRESHOLD = 8 << 20
+
+
+class _HostStream:
+    """hashlib-backed incremental fallback (JAX-less hosts)."""
+
+    def __init__(self):
+        self._h = hashlib.blake2b(digest_size=DIGEST_SIZE)
+        self.length = 0
+
+    def update(self, data) -> "_HostStream":
+        data = bytes(data)
+        self._h.update(data)
+        self.length += len(data)
+        return self
+
+    def digest(self) -> bytes:
+        return self._h.digest()
+
+
+def _make_stream():
+    try:
+        from ..ops.blake2b import Blake2bStream  # noqa: PLC0415
+
+        return Blake2bStream()
+    except Exception:
+        return _HostStream()
+
+
 class DigestPipeline:
-    """Accumulates payloads into batches and dispatches them to the hash
-    engine, mapping batch slots back to per-item completion callbacks.
+    """Accumulates payloads into batches, dispatches them asynchronously,
+    and maps batch slots back to per-item completion callbacks.
 
     This is the completion-queue pattern SURVEY §7 calls out as the hard
     part: per-message callback ordering is preserved while the device sees
-    large batches. Bounded in-flight work (``max_batch``) is the
-    backpressure analogue of the reference's pending counter.
+    large batches.  Dispatch is **asynchronous**: when a batch fills, the
+    device starts hashing while the host keeps parsing; digests are
+    collected (oldest batch first, entries in submit order within each)
+    when ``max_inflight`` batches are outstanding — the backpressure bound
+    — or at ``flush()``, which drains everything (the finalize barrier).
     """
 
     def __init__(
@@ -61,47 +95,98 @@ class DigestPipeline:
         hash_batch: Callable[[list[bytes]], list[bytes]] | None = None,
         max_batch: int = 1024,
         max_batch_bytes: int = 1 << 30,
+        max_inflight: int = 2,
+        hash_begin=None,
     ):
-        if hash_batch is None:
-            hash_batch = _device_hash_batch_factory() or _host_hash_batch
-        self._hash_batch = hash_batch
+        # engines: ``hash_begin(payloads) -> collect()`` is the async
+        # interface; a plain ``hash_batch`` callable (tests, custom
+        # engines) is wrapped to compute eagerly at dispatch time
+        if hash_begin is None:
+            if hash_batch is not None:
+                hash_begin = lambda ps: (lambda out=hash_batch(ps): out)  # noqa: E731
+            else:
+                hash_begin = _device_hash_begin_factory() or (
+                    lambda ps: (lambda out=_host_hash_batch(ps): out)
+                )
+        self._hash_begin = hash_begin
         self._max_batch = max_batch
         # byte cap bounds device/HBM footprint per dispatch — the item cap
         # alone would admit e.g. 1024 x 8 MiB blobs in one batch
         self._max_batch_bytes = max_batch_bytes
-        self._payloads: list[bytes] = []
-        self._cbs: list[Callable[[bytes], None]] = []
+        self._max_inflight = max(1, max_inflight)
+        # ordered queue of ("payload", bytes, cb) | ("stream", stream, cb):
+        # payload entries batch into one device dispatch; stream entries
+        # were already hashed incrementally (their bytes never queue here)
+        # and only finalize at delivery, preserving submit-order delivery
+        self._entries: list[tuple] = []
         self._pending_bytes = 0
+        self._inflight: list[tuple[list[tuple], Callable[[], list[bytes]]]] = []
         self.dispatches = 0
         self.hashed_bytes = 0
 
     def submit(self, payload: bytes, on_digest: Callable[[bytes], None]) -> None:
-        self._payloads.append(payload)
-        self._cbs.append(on_digest)
+        self._entries.append(("payload", payload, on_digest))
         self._pending_bytes += len(payload)
         if (
-            len(self._payloads) >= self._max_batch
+            len(self._entries) >= self._max_batch
             or self._pending_bytes >= self._max_batch_bytes
         ):
-            self.flush()
+            self.dispatch()
 
-    def flush(self) -> None:
-        """Dispatch everything queued; digests delivered in submit order."""
-        if not self._payloads:
+    def submit_stream(self, stream, on_digest: Callable[[bytes], None]) -> None:
+        """Queue a finished incremental hash (:class:`..ops.blake2b.
+        Blake2bStream`-shaped: ``.digest()``/``.length``) for in-order
+        digest delivery alongside batched payloads."""
+        self._entries.append(("stream", stream, on_digest))
+        if len(self._entries) >= self._max_batch:
+            self.dispatch()
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    def dispatch(self) -> None:
+        """Start hashing everything queued WITHOUT waiting for results.
+
+        If more than ``max_inflight`` batches would be outstanding, the
+        oldest is collected first — bounded in-flight work is the
+        device-side analogue of the reference's pending counter.
+        """
+        if not self._entries:
             return
-        payloads, self._payloads = self._payloads, []
-        cbs, self._cbs = self._cbs, []
+        entries, self._entries = self._entries, []
         self._pending_bytes = 0
         self.dispatches += 1
-        self.hashed_bytes += sum(len(p) for p in payloads)
-        digests = self._hash_batch(payloads)
-        if len(digests) != len(payloads):
+        payloads = [e[1] for e in entries if e[0] == "payload"]
+        collect = self._hash_begin(payloads) if payloads else (lambda: [])
+        self._inflight.append((entries, collect))
+        while len(self._inflight) > self._max_inflight:
+            self._deliver_oldest()
+
+    def _deliver_oldest(self) -> None:
+        entries, collect = self._inflight.pop(0)
+        payload_count = sum(1 for e in entries if e[0] == "payload")
+        digest_list = collect()
+        if len(digest_list) != payload_count:
             raise RuntimeError(
-                f"hash backend returned {len(digests)} digests for "
-                f"{len(payloads)} payloads"
+                f"hash backend returned {len(digest_list)} digests for "
+                f"{payload_count} payloads"
             )
-        for cb, digest in zip(cbs, digests):
-            cb(bytes(digest))
+        digests = iter(digest_list)
+        for kind, item, cb in entries:
+            if kind == "payload":
+                self.hashed_bytes += len(item)
+                cb(bytes(next(digests)))
+            else:
+                self.hashed_bytes += item.length
+                cb(item.digest())
+
+    def flush(self) -> None:
+        """Dispatch anything queued and deliver ALL outstanding digests in
+        submit order — the flush-before-finalize barrier."""
+        self.dispatch()
+        while self._inflight:
+            self._deliver_oldest()
 
 
 class TpuDecoder(Decoder):
@@ -116,13 +201,18 @@ class TpuDecoder(Decoder):
       runs (flush-before-finalize).
     """
 
-    def __init__(self, pipeline: DigestPipeline | None = None, **kwargs):
+    def __init__(self, pipeline: DigestPipeline | None = None,
+                 stream_threshold: int = DEFAULT_STREAM_THRESHOLD, **kwargs):
         super().__init__(**kwargs)
         self._pipeline = pipeline if pipeline is not None else DigestPipeline()
         self._digest_cbs: list[OnDigest] = []
         self._change_seq = 0
         self._blob_seq = 0
         self._blob_parts: dict[int, list[bytes]] = {}
+        # blobs at least this long hash incrementally (O(segment) memory,
+        # no < 2 GiB cap) instead of joining chunks for the batch path
+        self._stream_threshold = stream_threshold
+        self._blob_streams: dict[int, object] = {}
 
     def on_digest(self, cb: OnDigest) -> "TpuDecoder":
         self._digest_cbs.append(cb)
@@ -149,21 +239,33 @@ class TpuDecoder(Decoder):
 
     def _open_blob_if_ready(self) -> None:
         if self._digest_cbs:
-            self._blob_parts[self._blob_seq] = []
+            # self._missing is the blob's wire length at header time
+            if self._missing >= self._stream_threshold:
+                self._blob_streams[self._blob_seq] = _make_stream()
+            else:
+                self._blob_parts[self._blob_seq] = []
         self._blob_seq += 1
         super()._open_blob_if_ready()
 
     def _blob_data(self, chunk):
         seq = self._blob_seq - 1
         take = min(len(chunk), self._missing)
-        if self._digest_cbs and seq in self._blob_parts:
-            self._blob_parts[seq].append(bytes(chunk[:take]))
+        if self._digest_cbs:
+            if seq in self._blob_streams:
+                self._blob_streams[seq].update(chunk[:take])
+            elif seq in self._blob_parts:
+                self._blob_parts[seq].append(bytes(chunk[:take]))
         return super()._blob_data(chunk)
 
     def _end_blob(self) -> None:
         seq = self._blob_seq - 1
         parts = self._blob_parts.pop(seq, None)
-        if parts is not None:
+        stream = self._blob_streams.pop(seq, None)
+        if stream is not None:
+            self._pipeline.submit_stream(
+                stream, lambda d, s=seq: self._emit_digest("blob", s, d)
+            )
+        elif parts is not None:
             self._pipeline.submit(
                 b"".join(parts), lambda d, s=seq: self._emit_digest("blob", s, d)
             )
@@ -190,12 +292,14 @@ class TpuEncoder(Encoder):
     change payload and completed blob are delivered via ``on_digest``.
     """
 
-    def __init__(self, pipeline: DigestPipeline | None = None, **kwargs):
+    def __init__(self, pipeline: DigestPipeline | None = None,
+                 stream_threshold: int = DEFAULT_STREAM_THRESHOLD, **kwargs):
         super().__init__(**kwargs)
         self._pipeline = pipeline if pipeline is not None else DigestPipeline()
         self._digest_cbs: list[OnDigest] = []
         self._change_seq = 0
         self._blob_seq = 0
+        self._stream_threshold = stream_threshold
 
     def on_digest(self, cb: OnDigest) -> "TpuEncoder":
         self._digest_cbs.append(cb)
@@ -222,26 +326,36 @@ class TpuEncoder(Encoder):
         ws = super().blob(length, on_flush)
         if self._digest_cbs:
             seq = self._blob_seq
-            parts: list[bytes] = []
+            streaming = length >= self._stream_threshold
+            sink = _make_stream() if streaming else []
             orig_write = ws.write
             orig_end = ws.end
 
             def write(data, on_flush=None):
                 if isinstance(data, str):
                     data = data.encode("utf-8")
-                parts.append(bytes(data))
+                if streaming:
+                    sink.update(data)
+                else:
+                    sink.append(bytes(data))
                 return orig_write(data, on_flush)
 
             def end(data=None, on_flush=None):
                 # a final chunk routes through BlobWriter.end -> self.write,
-                # which is the wrapped write above — it records `parts` there.
+                # which is the wrapped write above — it records `sink` there.
                 was_ended = ws._ended
                 orig_end(data, on_flush)
                 if not was_ended:  # double end() must not duplicate the digest
-                    self._pipeline.submit(
-                        b"".join(parts),
-                        lambda d, s=seq: self._emit_digest("blob", s, d),
-                    )
+                    if streaming:
+                        self._pipeline.submit_stream(
+                            sink,
+                            lambda d, s=seq: self._emit_digest("blob", s, d),
+                        )
+                    else:
+                        self._pipeline.submit(
+                            b"".join(sink),
+                            lambda d, s=seq: self._emit_digest("blob", s, d),
+                        )
 
             ws.write = write
             ws.end = end

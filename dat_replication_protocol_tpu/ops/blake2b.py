@@ -156,13 +156,15 @@ def _rounds_scanned(v, m, sigma=None):
     return [(vh[i], vl[i]) for i in range(16)]
 
 
-def compress_soa(h, m, t_lo, is_final, unroll: bool | None = None, sigma=None):
+def compress_soa(h, m, t_lo, is_final, unroll: bool | None = None, sigma=None,
+                 t_hi=None):
     """One BLAKE2b compression in SoA layout.
 
     ``h``: list of 8 (hi, lo) pairs of (B,) uint32 vectors; ``m``: list of
     16 such pairs (message words); ``t_lo``: (B,) uint32 byte counter after
-    this block (items < 2 GiB, so counter words t0_hi/t1 are constant
-    zero); ``is_final``: (B,) bool last-block flags.  Returns the new h.
+    this block; ``t_hi``: optional (B,) high counter word for streams past
+    4 GiB (None = zero, the single-dispatch case); ``is_final``: (B,) bool
+    last-block flags.  Returns the new h.
 
     ``unroll=None`` picks per backend: unrolled rounds on accelerators,
     scanned rounds on CPU (see the two round helpers).  Both are
@@ -176,7 +178,8 @@ def compress_soa(h, m, t_lo, is_final, unroll: bool | None = None, sigma=None):
         for i in range(8)
     ]
     v = list(h) + iv
-    v[12] = (v[12][0], v[12][1] ^ t_lo)
+    v12_hi = v[12][0] if t_hi is None else v[12][0] ^ t_hi
+    v[12] = (v12_hi, v[12][1] ^ t_lo)
     f = jnp.where(is_final, U32(0xFFFFFFFF), U32(0))
     v[14] = (v[14][0] ^ f, v[14][1] ^ f)
 
@@ -255,6 +258,136 @@ def blake2b_packed(mh, ml, lengths, digest_size: int = DIGEST_SIZE):
     return jnp.stack(carry[:8], axis=1), jnp.stack(carry[8:], axis=1)
 
 
+@jax.jit
+def blake2b_update(hh, hl, t_hi, t_lo, mh, ml, seg_lengths, is_last):
+    """Advance chaining states over one packed segment per item.
+
+    The resumable core of streaming hashing: a message is split into
+    segments dispatched one at a time, so a blob of any size is hashed in
+    bounded device memory — the device-scale analogue of the reference's
+    "blobs are streamed, never materialized" (reference: README.md:73).
+
+    ``hh``/``hl``: (B, 8) chaining state; ``t_hi``/``t_lo``: (B,) uint32
+    pair = bytes already compressed (a multiple of 128 per RFC 7693
+    block chaining); ``mh``/``ml``: (B, nblocks, 16) packed segment
+    words; ``seg_lengths``: (B,) bytes in this segment — non-final
+    segments must be full-block multiples; ``is_last``: (B,) bool.
+
+    Returns ``(hh, hl, t_hi, t_lo)`` advanced past the segment.  The
+    empty-message case (zero-length last segment with zero counter)
+    compresses the mandatory single zero block.
+    """
+    B, nblocks, _ = mh.shape
+    seg_lengths = seg_lengths.astype(U32)
+    is_last = is_last.astype(bool)
+    raw_blocks = (seg_lengths + U32(127)) >> U32(7)
+    t_zero = (t_hi == U32(0)) & (t_lo == U32(0))
+    item_blocks = jnp.where(
+        is_last & (raw_blocks == U32(0)) & t_zero, U32(1), raw_blocks
+    )
+
+    carry0 = tuple(hh[:, i] for i in range(8)) + tuple(hl[:, i] for i in range(8))
+    mh_t = jnp.transpose(mh, (1, 2, 0))
+    ml_t = jnp.transpose(ml, (1, 2, 0))
+
+    def step(carry, xs):
+        h = [(carry[i], carry[i + 8]) for i in range(8)]
+        bmh, bml, k = xs
+        m = [(bmh[i], bml[i]) for i in range(16)]
+        active = k < item_blocks
+        final = is_last & (k == item_blocks - U32(1))
+        inc = jnp.minimum(seg_lengths, (k + U32(1)) << U32(7))
+        bt_hi, bt_lo = add64(t_hi, t_lo, jnp.zeros_like(inc), inc)
+        nh = compress_soa(h, m, bt_lo, final, t_hi=bt_hi)
+        out = tuple(
+            jnp.where(active, nh[i][0], h[i][0]) for i in range(8)
+        ) + tuple(jnp.where(active, nh[i][1], h[i][1]) for i in range(8))
+        return out, None
+
+    ks = jnp.arange(nblocks, dtype=jnp.uint32)
+    carry, _ = jax.lax.scan(step, carry0, (mh_t, ml_t, ks))
+    nt_hi, nt_lo = add64(t_hi, t_lo, jnp.zeros_like(seg_lengths), seg_lengths)
+    return (
+        jnp.stack(carry[:8], axis=1),
+        jnp.stack(carry[8:], axis=1),
+        nt_hi,
+        nt_lo,
+    )
+
+
+class Blake2bStream:
+    """Incremental BLAKE2b over bounded device dispatches (one stream).
+
+    ``update(bytes)`` buffers until a full segment is available, then
+    advances the on-device (h, t) chaining state via
+    :func:`blake2b_update`; ``digest()`` flushes the tail.  Peak host
+    memory is O(segment_bytes) regardless of stream length, and the
+    64-bit byte counter supports streams past 4 GiB — this removes the
+    session backend's whole-blob host buffering and the < 2 GiB item cap.
+
+    Middle segments all share one padded shape (one XLA compile); the
+    final partial segment is bucketed to a power-of-two block count.
+    """
+
+    def __init__(self, digest_size: int = DIGEST_SIZE,
+                 segment_bytes: int = 1 << 22, max_inflight: int = 2):
+        if segment_bytes % BLOCK_BYTES:
+            raise ValueError(f"segment_bytes must be a multiple of {BLOCK_BYTES}")
+        self._digest_size = digest_size
+        self._seg = segment_bytes
+        self._max_inflight = max(1, max_inflight)
+        self._since_barrier = 0
+        hh, hl = initial_state(1, digest_size)
+        z = jnp.zeros((1,), U32)
+        self._state = (hh, hl, z, z)
+        self._pending = bytearray()
+        self._digest: bytes | None = None
+        self.length = 0
+
+    def update(self, data) -> "Blake2bStream":
+        if self._digest is not None:
+            raise RuntimeError("update() after digest()")
+        self._pending += bytes(data)
+        self.length += len(data)
+        # strictly '>' — the final block must go out WITH the final flag,
+        # so when pending lands exactly on a segment boundary it is held
+        # for digest() (an empty non-final segment can't set the flag)
+        while len(self._pending) > self._seg:
+            seg = bytes(self._pending[: self._seg])
+            del self._pending[: self._seg]
+            self._advance(seg, last=False)
+        return self
+
+    def _advance(self, seg: bytes, last: bool) -> None:
+        hh, hl, thi, tlo = self._state
+        nblocks = max(1, -(-len(seg) // BLOCK_BYTES))
+        if last:
+            nblocks = _bucket_nblocks(nblocks)  # bound tail-shape compiles
+        mh, ml, lengths = pack_payloads([seg], nblocks=nblocks)
+        self._state = blake2b_update(
+            hh, hl, thi, tlo,
+            jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths),
+            jnp.asarray([last]),
+        )
+        # bounded async dispatch: without a periodic barrier the host can
+        # outrun the device and queue every segment's message arrays in
+        # RAM — the O(chunk) discipline would silently become O(blob).
+        # Fetching the (tiny) counter word is the completion barrier that
+        # works on platforms where block_until_ready returns early.
+        self._since_barrier += 1
+        if self._since_barrier >= self._max_inflight:
+            np.asarray(self._state[3])
+            self._since_barrier = 0
+
+    def digest(self) -> bytes:
+        if self._digest is None:
+            self._advance(bytes(self._pending), last=True)
+            self._pending.clear()
+            hh, hl, _, _ = self._state
+            self._digest = digests_to_bytes(hh, hl, self._digest_size)[0]
+        return self._digest
+
+
 # ---------------------------------------------------------------------------
 # host edge: bytes <-> padded uint32 batches
 # ---------------------------------------------------------------------------
@@ -310,27 +443,28 @@ def _bucket_nblocks(n: int) -> int:
 _PALLAS_MIN_ITEMS = 512
 
 
-def blake2b_batch(
+def blake2b_batch_begin(
     payloads, digest_size: int = DIGEST_SIZE, use_pallas: bool | None = None
-) -> list[bytes]:
-    """Hash a list of byte strings on device; digests in submit order.
+):
+    """Dispatch batched hashing; return a zero-arg ``collect()`` closure.
 
-    Items are grouped into power-of-two block-count buckets; each bucket is
-    one padded XLA dispatch.  This is the ``hash_batch`` engine the
-    ``backend='tpu'`` session pipeline plugs in.
+    JAX dispatch is asynchronous: the device starts compressing as soon
+    as this returns, while the host goes back to parsing.  ``collect()``
+    blocks on the transfers and yields digests in submit order — the
+    split the async DigestPipeline uses to overlap parse and hash.
 
-    ``use_pallas=None`` selects, per bucket, the Pallas kernel on TPU
-    backends when the bucket is large enough to amortize its 1024-item
-    tile padding, and the portable XLA-scan path otherwise.
+    Items are grouped into power-of-two block-count buckets; each bucket
+    is one padded XLA dispatch.  ``use_pallas=None`` selects, per bucket,
+    the Pallas kernel on TPU backends when the bucket is large enough to
+    amortize its 1024-item tile padding, and the portable XLA-scan path
+    otherwise.
     """
-    if not payloads:
-        return []
     on_tpu = jax.default_backend() == "tpu"
     buckets: dict[int, list[int]] = {}
     for i, p in enumerate(payloads):
         nb = _bucket_nblocks(max(1, -(-len(p) // BLOCK_BYTES)))
         buckets.setdefault(nb, []).append(i)
-    out: list[bytes | None] = [None] * len(payloads)
+    handles = []
     for nb, idxs in buckets.items():
         pallas_bucket = (
             use_pallas
@@ -345,6 +479,22 @@ def blake2b_batch(
         hh, hl = packed_fn(
             jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths), digest_size
         )
-        for i, d in zip(idxs, digests_to_bytes(hh, hl, digest_size)):
-            out[i] = d
-    return out  # type: ignore[return-value]
+        handles.append((idxs, hh, hl))
+
+    def collect() -> list[bytes]:
+        out: list[bytes | None] = [None] * len(payloads)
+        for idxs, hh, hl in handles:
+            for i, d in zip(idxs, digests_to_bytes(hh, hl, digest_size)):
+                out[i] = d
+        return out  # type: ignore[return-value]
+
+    return collect
+
+
+def blake2b_batch(
+    payloads, digest_size: int = DIGEST_SIZE, use_pallas: bool | None = None
+) -> list[bytes]:
+    """Hash a list of byte strings on device; digests in submit order."""
+    if not payloads:
+        return []
+    return blake2b_batch_begin(payloads, digest_size, use_pallas)()

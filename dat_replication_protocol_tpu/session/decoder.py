@@ -126,7 +126,13 @@ class BlobReader:
 
 
 def _drain_blob(blob: BlobReader, done: Callable[[], None]) -> None:
-    """Default blob handler: consume and discard (reference: decode.js:58-61)."""
+    """Default blob handler: consume and discard (reference: decode.js:58-61).
+
+    The discarding data callback matters: without one, BlobReader buffers
+    every chunk for later replay and an unconsumed blob accumulates whole
+    in host RAM — the opposite of draining.
+    """
+    blob.on_data(lambda _chunk: None)
     blob.on_end(done)
 
 

@@ -1,0 +1,199 @@
+"""Device-offloaded session backend: DigestPipeline + streaming hashing.
+
+Covers the streaming large-blob path added after round 1: blobs past
+``stream_threshold`` hash incrementally in O(segment) memory (no host
+join, no < 2 GiB cap) while digests still arrive in submit order and
+before finalize.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.backend.tpu_backend import (
+    DigestPipeline,
+    TpuDecoder,
+    TpuEncoder,
+    _HostStream,
+)
+from dat_replication_protocol_tpu.ops.blake2b import Blake2bStream
+
+
+def _h(data: bytes) -> bytes:
+    return hashlib.blake2b(data, digest_size=32).digest()
+
+
+# ---------------------------------------------------------------------------
+# DigestPipeline mixed-entry ordering
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_orders_streams_between_payloads():
+    pl = DigestPipeline(max_batch=100)
+    got = []
+    pl.submit(b"aa", lambda d: got.append(("p0", d)))
+    s = Blake2bStream(segment_bytes=128).update(b"s" * 300)
+    pl.submit_stream(s, lambda d: got.append(("s1", d)))
+    pl.submit(b"bb", lambda d: got.append(("p2", d)))
+    pl.flush()
+    assert [g[0] for g in got] == ["p0", "s1", "p2"]
+    assert got[0][1] == _h(b"aa")
+    assert got[1][1] == _h(b"s" * 300)
+    assert got[2][1] == _h(b"bb")
+    assert pl.hashed_bytes == 2 + 300 + 2
+
+
+def test_pipeline_stream_only_flush():
+    pl = DigestPipeline()
+    got = []
+    pl.submit_stream(_HostStream().update(b"xyz"), got.append)
+    pl.flush()
+    assert got == [_h(b"xyz")]
+
+
+def test_pipeline_byte_cap_autodispatches():
+    pl = DigestPipeline(max_batch=1000, max_batch_bytes=100)
+    got = []
+    pl.submit(b"z" * 60, got.append)
+    assert pl.dispatches == 0
+    pl.submit(b"z" * 60, got.append)
+    assert pl.dispatches == 1  # device work started, delivery deferred
+    pl.flush()
+    assert len(got) == 2
+
+
+def test_pipeline_item_cap_counts_streams():
+    pl = DigestPipeline(max_batch=2)
+    got = []
+    pl.submit_stream(_HostStream().update(b"1"), got.append)
+    assert pl.dispatches == 0
+    pl.submit_stream(_HostStream().update(b"2"), got.append)
+    assert pl.dispatches == 1
+    pl.flush()
+    assert got == [_h(b"1"), _h(b"2")]
+
+
+def test_pipeline_async_overlap_and_bounded_inflight():
+    # fake async engine: records when batches are dispatched vs collected,
+    # proving submit/dispatch never blocks on results and that at most
+    # max_inflight batches ride uncollected
+    events = []
+
+    def begin(payloads):
+        events.append(("dispatch", len(payloads)))
+
+        def collect():
+            events.append(("collect", len(payloads)))
+            return [_h(p) for p in payloads]
+
+        return collect
+
+    pl = DigestPipeline(hash_begin=begin, max_batch=2, max_inflight=2)
+    got = []
+    for i in range(8):
+        pl.submit(b"%d" % i, got.append)
+    # 4 batches dispatched; only 4 - max_inflight collected so far
+    assert events.count(("dispatch", 2)) == 4
+    assert events.count(("collect", 2)) == 2
+    assert got == [_h(b"%d" % i) for i in range(4)]  # oldest-first, in order
+    assert pl.inflight == 2
+    pl.flush()
+    assert events.count(("collect", 2)) == 4
+    assert got == [_h(b"%d" % i) for i in range(8)]
+
+
+def test_pipeline_flush_preserves_order_across_batches():
+    pl = DigestPipeline(max_batch=2, max_inflight=10)
+    got = []
+    payloads = [b"a", b"bb", b"ccc", b"dddd", b"e"]
+    for p in payloads:
+        pl.submit(p, got.append)
+    pl.flush()
+    assert got == [_h(p) for p in payloads]
+
+
+# ---------------------------------------------------------------------------
+# streaming blob digests through the session ends
+# ---------------------------------------------------------------------------
+
+
+def _run_session(enc, dec, blob: bytes, chunk: int):
+    digests = []
+    dec.on_digest(lambda kind, seq, d: digests.append((kind, seq, d)))
+    final = []
+    dec.finalize(lambda done: (final.append(len(digests)), done()))
+    ws = enc.blob(len(blob))
+    p = protocol.pipe(enc, dec)
+    for i in range(0, len(blob), chunk):
+        ws.write(blob[i : i + chunk])
+        p.pump()
+    ws.end()
+    enc.change({"key": "k", "change": 1, "from_": 0, "to": 1})
+    enc.finalize()
+    p.pump()
+    assert p.done
+    return digests, final
+
+
+@pytest.mark.parametrize("threshold", [1, 1 << 30])
+def test_decoder_blob_digest_streamed_vs_batched(threshold):
+    blob = random.Random(1).randbytes(5000)
+    enc = protocol.encode()
+    dec = TpuDecoder(stream_threshold=threshold)
+    digests, final = _run_session(enc, dec, blob, chunk=777)
+    assert ("blob", 0, _h(blob)) in digests
+    # flush-before-finalize: all digests delivered before the hook ran
+    assert final == [len(digests)]
+    if threshold == 1:
+        assert not dec._blob_parts  # nothing joined in host RAM
+
+
+def test_decoder_streaming_bounded_memory():
+    # blob larger than max_batch_bytes flows through without ever being
+    # materialized: neither parts nor pipeline payload bytes hold it
+    blob = random.Random(2).randbytes(300_000)
+    pl = DigestPipeline(max_batch_bytes=10_000)
+    dec = TpuDecoder(pipeline=pl, stream_threshold=100_000)
+    enc = protocol.encode()
+    digests, _ = _run_session(enc, dec, blob, chunk=9999)
+    assert ("blob", 0, _h(blob)) in digests
+    assert pl.hashed_bytes >= len(blob)
+    assert not dec._blob_parts and not dec._blob_streams
+
+
+@pytest.mark.parametrize("threshold", [1, 1 << 30])
+def test_encoder_blob_digest_streamed_vs_batched(threshold):
+    blob = random.Random(3).randbytes(4096)
+    enc = TpuEncoder(stream_threshold=threshold)
+    digests = []
+    enc.on_digest(lambda kind, seq, d: digests.append((kind, seq, d)))
+    dec = protocol.decode()
+    ws = enc.blob(len(blob))
+    ws.write(blob[:1000])
+    ws.end(blob[1000:])
+    enc.finalize()
+    protocol.pipe(enc, dec)
+    assert ("blob", 0, _h(blob)) in digests
+
+
+def test_encoder_streaming_change_and_blob_order():
+    enc = TpuEncoder(stream_threshold=10)
+    got = []
+    enc.on_digest(lambda kind, seq, d: got.append((kind, seq)))
+    enc.change({"key": "a", "change": 1, "from_": 0, "to": 1})
+    ws = enc.blob(64)
+    ws.write(b"x" * 64)
+    ws.end()
+    enc.change({"key": "b", "change": 2, "from_": 1, "to": 2})
+    enc.finalize()
+    protocol.pipe(enc, protocol.decode())
+    assert got == [("change", 0), ("blob", 0), ("change", 1)]
+
+
+def test_host_stream_matches_hashlib():
+    s = _HostStream()
+    s.update(b"abc").update(memoryview(b"def"))
+    assert s.digest() == _h(b"abcdef")
+    assert s.length == 6
