@@ -25,6 +25,7 @@ from typing import Callable, Optional
 
 from ..session.decoder import BlobReader, Decoder
 from ..session.encoder import Encoder
+from ..utils.trace import span
 
 DIGEST_SIZE = 32  # BLAKE2b-256, dat's content-hash size
 
@@ -158,7 +159,8 @@ class DigestPipeline:
         self._pending_bytes = 0
         self.dispatches += 1
         payloads = [e[1] for e in entries if e[0] == "payload"]
-        collect = self._hash_begin(payloads) if payloads else (lambda: [])
+        with span("digest.dispatch"):
+            collect = self._hash_begin(payloads) if payloads else (lambda: [])
         self._inflight.append((entries, collect))
         while len(self._inflight) > self._max_inflight:
             self._deliver_oldest()
@@ -166,7 +168,8 @@ class DigestPipeline:
     def _deliver_oldest(self) -> None:
         entries, collect = self._inflight.pop(0)
         payload_count = sum(1 for e in entries if e[0] == "payload")
-        digest_list = collect()
+        with span("digest.collect"):
+            digest_list = collect()
         if len(digest_list) != payload_count:
             raise RuntimeError(
                 f"hash backend returned {len(digest_list)} digests for "

@@ -57,25 +57,38 @@ enum {
 
 // Split a multibuffer stream into frames.
 //
-// On success returns the frame count (<= cap) and fills, per frame:
+// Returns the count of complete valid frames (<= cap) and fills, per
+// frame:
 //   starts[f]  byte offset of the payload (after the id byte)
 //   lens[f]    payload length (framed length minus the id byte)
 //   ids[f]     the 1-byte type id (unvalidated; policy lives above)
 // `consumed` gets the offset one past the last complete frame (a partial
 // trailing frame is not an error — streaming callers re-feed the tail).
-// Negative return = error code above.
+// A malformed header (overlong varint / zero framed length) STOPS the
+// scan at that frame: the valid prefix is still returned and `err` gets
+// the error code (0 otherwise), so a streaming caller can deliver the
+// prefix and surface the error at exactly the offending frame — the same
+// observable order as the byte-at-a-time scanner.  Only a capacity
+// overflow (caller bug) is a negative return.
 int64_t dat_split_frames(const uint8_t* buf, int64_t len, int64_t* starts,
                          int64_t* lens, uint8_t* ids, int64_t cap,
-                         int64_t* consumed) {
+                         int64_t* consumed, int64_t* err) {
   int64_t i = 0;
   int64_t n = 0;
   *consumed = 0;
+  *err = 0;
   while (i < len) {
     uint64_t framed;
     int used = read_uvarint(buf, i, len, &framed);
     if (used == 0) break;  // partial header at tail
-    if (used < 0) return DAT_ERR_BAD_VARINT;
-    if (framed == 0) return DAT_ERR_BAD_RECORD;  // must include the id byte
+    if (used < 0) {
+      *err = DAT_ERR_BAD_VARINT;
+      break;
+    }
+    if (framed == 0) {  // must include the id byte
+      *err = DAT_ERR_BAD_RECORD;
+      break;
+    }
     // Unsigned compare BEFORE any int64 cast: a hostile length >= 2^63
     // must not wrap negative and walk the cursor backwards.  Anything
     // larger than the bytes on hand is a partial tail (streaming callers
@@ -93,6 +106,34 @@ int64_t dat_split_frames(const uint8_t* buf, int64_t len, int64_t* starts,
     *consumed = i;
   }
   return n;
+}
+
+// Greedy min/max chunk-size pass over sorted candidate byte offsets (the
+// sequential tail of content-defined chunking; ops/rabin.py documents the
+// algorithm).  Writes chunk end-offsets (exclusive), always ending with
+// `length`.  Returns the cut count, or DAT_ERR_CAPACITY.
+int64_t dat_greedy_select(const int64_t* cands, int64_t n, int64_t length,
+                          int64_t min_size, int64_t max_size, int64_t* out,
+                          int64_t cap) {
+  int64_t start = 0, i = 0, m = 0;
+  while (length - start > max_size) {
+    int64_t lo = start + min_size;
+    int64_t hi = start + max_size;
+    while (i < n && cands[i] < lo) ++i;
+    int64_t cut;
+    if (i < n && cands[i] <= hi) {
+      cut = cands[i];
+      ++i;
+    } else {
+      cut = hi;
+    }
+    if (m >= cap) return DAT_ERR_CAPACITY;
+    out[m++] = cut;
+    start = cut;
+  }
+  if (m >= cap) return DAT_ERR_CAPACITY;
+  out[m++] = length;
+  return m;
 }
 
 // Proto2 tags for the Change message (reference: messages/schema.proto:1-8).

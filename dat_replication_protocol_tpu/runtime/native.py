@@ -68,7 +68,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dat_split_frames.restype = ctypes.c_int64
     lib.dat_split_frames.argtypes = [
         _U8P, ctypes.c_int64, _I64P, _I64P, _U8P, ctypes.c_int64,
-        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.dat_greedy_select.restype = ctypes.c_int64
+    lib.dat_greedy_select.argtypes = [
+        _I64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, _I64P, ctypes.c_int64,
     ]
     lib.dat_decode_changes.restype = ctypes.c_int64
     lib.dat_decode_changes.argtypes = [

@@ -124,19 +124,21 @@ def _split_native(lib, buf):
     lens = np.empty(cap, dtype=np.int64)
     ids = np.empty(cap, dtype=np.uint8)
     consumed = ctypes.c_int64(0)
+    err = ctypes.c_int64(0)
     n = lib.dat_split_frames(
-        buf, len(buf), starts, lens, ids, cap, ctypes.byref(consumed)
+        buf, len(buf), starts, lens, ids, cap,
+        ctypes.byref(consumed), ctypes.byref(err),
     )
-    if n == native.ERR_BAD_VARINT:
+    if err.value == native.ERR_BAD_VARINT:
         raise ProtocolError("malformed varint in frame header")
-    if n == native.ERR_BAD_RECORD:
+    if err.value == native.ERR_BAD_RECORD:
         raise ProtocolError("framed length 0 (must include the id byte)")
     if n == native.ERR_CAPACITY:
         raise ProtocolError(
             f"frame count exceeds capacity estimate ({cap})"
         )
-    if n < 0:
-        raise ProtocolError(f"frame split failed (code {n})")
+    if n < 0 or err.value != 0:
+        raise ProtocolError(f"frame split failed (code {n}, err {err.value})")
     return int(n), starts, lens, ids, int(consumed.value)
 
 

@@ -145,12 +145,15 @@ def decode_change(buf) -> Change:
             if wire_type == 0:  # varint
                 v, used = decode_uvarint(buf, i)
                 i += used
+                # proto2 uint32 semantics: a wider varint from a foreign
+                # encoder truncates to the low 32 bits (keeps this path
+                # bit-identical with the native columnar decoder)
                 if tag == _TAG_CHANGE:
-                    change_seq = v
+                    change_seq = v & _UINT32_MAX
                 elif tag == _TAG_FROM:
-                    from_ = v
+                    from_ = v & _UINT32_MAX
                 elif tag == _TAG_TO:
-                    to = v
+                    to = v & _UINT32_MAX
             elif wire_type == 2:  # length-delimited
                 ln, used = decode_uvarint(buf, i)
                 i += used

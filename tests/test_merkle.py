@@ -96,3 +96,34 @@ def test_pad_leaves_sentinel_stability():
     # padding with zero digests must not create phantom diffs
     a = _leaves(5, seed=6)
     assert merkle.diff_leaves(a, list(a)) == []
+
+
+def test_pallas_level_matches_scanned_interpret():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dat_replication_protocol_tpu.ops.merkle_pallas import (
+        merkle_level_pallas,
+    )
+
+    a = _leaves(64, seed=9)
+    hh, hl = merkle.digests_to_device(a)
+    ph, plo = merkle.merkle_level(hh, hl)
+    qh, qlo = merkle_level_pallas(hh, hl, interpret=True)
+    assert np.array_equal(np.asarray(ph), np.asarray(qh))
+    assert np.array_equal(np.asarray(plo), np.asarray(qlo))
+
+
+def test_packed_diff_matches_dense():
+    import numpy as np
+
+    a = _leaves(256, seed=10)
+    b = list(a)
+    for i in (3, 77, 200, 255):
+        b[i] = _digest(b"p%d" % i)
+    a_hh, a_hl = merkle.digests_to_device(a)
+    b_hh, b_hl = merkle.digests_to_device(b)
+    bits, ra, rb = merkle.diff_root_guided_packed(a_hh, a_hl, b_hh, b_hl)
+    dense = np.unpackbits(np.asarray(bits).view(np.uint8), bitorder="little")
+    got = np.nonzero(dense[:256])[0].tolist()
+    assert got == [3, 77, 200, 255]

@@ -84,6 +84,54 @@ def test_chunk_stream_empty_and_tiny():
     assert rabin.chunk_stream(b"abc") == [3]
 
 
+def test_greedy_select_native_matches_python():
+    rng = np.random.default_rng(11)
+    cands = np.sort(rng.choice(1 << 20, size=4000, replace=False)).astype(
+        np.int64
+    )
+    for min_size, max_size in [(256, 4096), (1, 1 << 20), (100, 200)]:
+        native = rabin._greedy_select(cands, 1 << 20, min_size, max_size)
+        py = rabin._greedy_select_py(cands, 1 << 20, min_size, max_size)
+        assert native == py
+
+
+def test_candidates_words_device_path_matches_host():
+    data = _data(10_000, seed=6)
+    buf = np.zeros(-(-len(data) // 4) * 4, dtype=np.uint8)
+    buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    got = rabin.candidates_words(buf.view("<u4"), len(data), avg_bits=8,
+                                 tile_bytes=1 << 12)
+    assert got.tolist() == rabin.host_candidates(data, 8)
+
+
+def test_thinned_candidates_match_host_thin():
+    data = _data(6 * 4096 - 55, seed=7)
+    buf = np.zeros(-(-len(data) // 4) * 4, dtype=np.uint8)
+    buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    for thin in (5, 6, 8):
+        got = rabin.candidates_words(
+            buf.view("<u4"), len(data), avg_bits=8, tile_bytes=1 << 12,
+            thin_bits=thin,
+        )
+        exp = rabin.host_thin(rabin.host_candidates(data, 8), thin)
+        assert got.tolist() == exp, f"thin_bits={thin}"
+
+
+def test_chunk_stream_thinned_cuts_are_candidates():
+    # chunk_stream thins candidates to one per min_size-aligned window;
+    # every non-forced cut must still be a true content candidate
+    data = _data(120_000, seed=8)
+    cuts = rabin.chunk_stream(data, avg_bits=8, tile_bytes=1 << 13)
+    cands = set(rabin.host_candidates(data, 8))
+    min_size, max_size = 1 << 6, 1 << 10
+    start = 0
+    for c in cuts[:-1]:
+        assert (c in cands) or (c - start == max_size)
+        assert min_size <= c - start <= max_size
+        start = c
+    assert cuts[-1] == len(data)
+
+
 def test_pallas_kernel_matches_scan_path_interpret():
     import jax.numpy as jnp
 
@@ -100,3 +148,37 @@ def test_pallas_kernel_matches_scan_path_interpret():
         gear_candidates_pallas(words, 8, interpret=True)
     )
     assert np.array_equal(scan_bits, pallas_bits)
+
+
+def test_first_hit_tiled_matches_bitmask():
+    import jax.numpy as jnp
+
+    data = _data(4 * 1024, seed=10)
+    words = jnp.asarray(
+        np.frombuffer(data, dtype=np.uint8).reshape(4, 1024).view("<u4")
+    )
+    bits = np.asarray(rabin.gear_candidates_tiled(words, 8))
+    firsts = np.asarray(rabin.gear_first_tiled(words, 8))
+    T, ng = firsts.shape
+    for t in range(T):
+        dense = np.nonzero(
+            np.unpackbits(bits[t].view(np.uint8), bitorder="little")
+        )[0]
+        for g in range(ng):
+            in_group = dense[(dense >= g * 256) & (dense < (g + 1) * 256)]
+            exp = in_group[0] - g * 256 if len(in_group) else rabin.NO_HIT
+            assert firsts[t, g] == exp, (t, g)
+
+
+def test_first_hit_pallas_interpret_matches_tiled():
+    import jax.numpy as jnp
+
+    from dat_replication_protocol_tpu.ops.rabin_pallas import gear_first_pallas
+
+    data = _data(2 * 2048, seed=12)
+    words = jnp.asarray(
+        np.frombuffer(data, dtype=np.uint8).reshape(2, 2048).view("<u4")
+    )
+    tiled = np.asarray(rabin.gear_first_tiled(words, 8))
+    pallas = np.asarray(gear_first_pallas(words, 8, interpret=True))
+    assert np.array_equal(tiled, pallas)
