@@ -496,7 +496,10 @@ def bench_merkle(quick: bool, backend: str) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from dat_replication_protocol_tpu.ops.merkle import diff_root_guided_packed
+    from dat_replication_protocol_tpu.ops.merkle import (
+        diff_root_guided_packed,
+        unpack_mask,
+    )
 
     on_tpu = backend in ("tpu", "axon")
     if quick:
@@ -519,9 +522,7 @@ def bench_merkle(quick: bool, backend: str) -> dict:
         bits, _, _ = diff_root_guided_packed(a_hh, a_hl, b_hh, b_hl)
         # honest end-to-end: packed-mask transfer + host bit expansion +
         # index extraction included
-        dense = np.unpackbits(np.asarray(bits).view(np.uint8),
-                              bitorder="little")
-        return np.nonzero(dense[:n])[0]
+        return np.nonzero(unpack_mask(bits, n))[0]
 
     idx = run()  # warmup/compile
     reps = 3 if quick else 10

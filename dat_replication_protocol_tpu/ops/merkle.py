@@ -176,8 +176,8 @@ def diff_root_guided_packed(a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl):
     The D2H transfer is the tail of the diff's critical path (1 bit per
     leaf instead of numpy's byte-per-bool — 8x less wire volume, which
     on a tunneled device link is the difference between the transfer
-    hiding under compute and dominating it).  Host side:
-    ``np.unpackbits(np.asarray(bits).view(np.uint8), bitorder='little')``.
+    hiding under compute and dominating it).  Expand on the host with
+    :func:`unpack_mask`.
     """
     mask, root_a, root_b = diff_root_guided(
         a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl
@@ -195,6 +195,19 @@ def diff_root_guided_packed(a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl):
 # ---------------------------------------------------------------------------
 # host edge
 # ---------------------------------------------------------------------------
+
+
+def unpack_mask(bits, n: int) -> np.ndarray:
+    """Expand a packed device mask (uint32 words, LSB-first) to (n,) bools.
+
+    The single host-side decode for every packed-mask producer
+    (:func:`diff_root_guided_packed`, the reconcile sketch diff, the CDC
+    occupancy transfer): one place owns the bit order.
+    """
+    dense = np.unpackbits(
+        np.asarray(bits, dtype=np.uint32).view(np.uint8), bitorder="little"
+    )
+    return dense[:n]
 
 
 def digests_to_device(digests: list[bytes]):

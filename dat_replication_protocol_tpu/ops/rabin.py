@@ -427,11 +427,12 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
 
         def collect() -> np.ndarray:
             with span("cdc.collect"):
+                from .merkle import unpack_mask
+
                 occ, offs = first
-                dense = np.unpackbits(
-                    np.asarray(occ).view(np.uint8), bitorder="little"
-                )
-                winidx = np.nonzero(dense[: T * stride >> thin_bits])[0]
+                winidx = np.nonzero(
+                    unpack_mask(occ, T * stride >> thin_bits)
+                )[0]
                 cap = cap0
                 while len(winidx) > cap:
                     cap *= 4

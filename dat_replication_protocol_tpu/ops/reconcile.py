@@ -82,7 +82,7 @@ def diff_sketches(table_a, table_b) -> np.ndarray:
     positional tree diff applies directly; the packed-mask variant keeps
     the transfer at 1 bit/cell.
     """
-    from .merkle import diff_root_guided_packed
+    from .merkle import diff_root_guided_packed, unpack_mask
 
     n = table_a.shape[0]
     if table_b.shape[0] != n:
@@ -94,9 +94,8 @@ def diff_sketches(table_a, table_b) -> np.ndarray:
             table_a[:, 1::2], table_a[:, 0::2],
             table_b[:, 1::2], table_b[:, 0::2],
         )
-        dense = np.unpackbits(np.asarray(bits).view(np.uint8),
-                              bitorder="little")
-    return np.nonzero(dense[:n])[0]
+        dense = unpack_mask(bits, n)
+    return np.nonzero(dense)[0]
 
 
 class LogSummary:

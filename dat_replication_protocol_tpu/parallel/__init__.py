@@ -1,10 +1,12 @@
 """Multi-chip parallelism: mesh construction + sharded data-plane steps."""
 
+from .cdc_mesh import sharded_gear_scan
 from .mesh import (
     DATA_AXIS,
     batch_sharding,
     digest_root_step,
     make_mesh,
+    pad_batch,
     replicated,
     sharded_diff,
 )
@@ -14,6 +16,8 @@ __all__ = [
     "batch_sharding",
     "digest_root_step",
     "make_mesh",
+    "pad_batch",
     "replicated",
     "sharded_diff",
+    "sharded_gear_scan",
 ]

@@ -81,8 +81,35 @@ def _check_shard(mesh: Mesh, B: int, what: str) -> None:
     if per is None or per & (per - 1) or per == 0:
         raise ValueError(
             f"{what}: batch size {B} over {n} devices needs a power-of-two "
-            f"per-chip shard (got {B}/{n}); pad the batch first"
+            f"per-chip shard (got {B}/{n}); pad the batch first "
+            f"(:func:`pad_batch` does)"
         )
+
+
+def pad_batch(mesh: Mesh, mh, ml, lengths):
+    """Pad a packed batch so every chip gets a power-of-two shard.
+
+    Padding items are zero-length payloads — valid BLAKE2b inputs whose
+    digests land in the padded tail of the leaf axis.  Both replicas of
+    a comparison must pad with the same policy (this one: smallest
+    ``n_devices * 2**k >= B``) so their Merkle roots stay comparable;
+    the caller slices per-item results with the returned original B.
+
+    Returns ``(mh, ml, lengths, B)``.
+    """
+    n = mesh.devices.size
+    B = mh.shape[0]
+    per = -(-B // n)
+    p = 1
+    while p < per:
+        p <<= 1
+    Bp = n * p
+    if Bp != B:
+        pad = ((0, Bp - B),)
+        mh = jnp.pad(mh, pad + ((0, 0), (0, 0)))
+        ml = jnp.pad(ml, pad + ((0, 0), (0, 0)))
+        lengths = jnp.pad(lengths, (0, Bp - B))
+    return mh, ml, lengths, B
 
 
 @functools.lru_cache(maxsize=None)
