@@ -100,15 +100,29 @@ def hash_extents(buf: np.ndarray, offs, lens,
         use_pallas = jax.default_backend() == "tpu"
     for nb, idx in bucketed_extents(lens).items():
         mh, ml, blens = pack_ragged(buf, offs[idx], lens[idx], nb)
-        if use_pallas and len(idx) >= blake2b._PALLAS_MIN_ITEMS:
+        # pad the batch axis to a power of two: jit specializes per
+        # (B, nblocks) shape, and without bucketing B every distinct
+        # batch size pays a fresh compile (minutes on the CPU backend's
+        # scanned path).  Zero rows are valid empty payloads; their
+        # digests are dropped below.
+        B = len(idx)
+        Bp = blake2b._bucket_nblocks(max(1, B))
+        if Bp != B:
+            pad = ((0, Bp - B),)
+            mh = np.pad(mh, pad + ((0, 0), (0, 0)))
+            ml = np.pad(ml, pad + ((0, 0), (0, 0)))
+            blens = np.pad(blens, (0, Bp - B))
+        if use_pallas and Bp >= blake2b._PALLAS_MIN_ITEMS:
             from ..ops.blake2b_pallas import blake2b_packed_pallas as fn
         else:
             fn = blake2b.blake2b_packed
         hh, hl = fn(jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(blens))
-        raw = np.empty((len(idx), 8), dtype="<u4")
-        raw[:, 0::2] = np.asarray(hl)[:, :4]
-        raw[:, 1::2] = np.asarray(hh)[:, :4]
-        out[idx] = raw.view(np.uint8).reshape(len(idx), 32)
+        raw = np.empty((B, 8), dtype="<u4")
+        # slice on DEVICE before transferring: padding rows and the
+        # unused high word columns would otherwise ride the D2H link
+        raw[:, 0::2] = np.asarray(hl[:B, :4])
+        raw[:, 1::2] = np.asarray(hh[:B, :4])
+        out[idx] = raw.view(np.uint8).reshape(B, 32)
     return out
 
 

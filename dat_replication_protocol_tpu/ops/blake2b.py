@@ -432,10 +432,9 @@ def digests_to_bytes(hh, hl, digest_size: int = DIGEST_SIZE) -> list[bytes]:
 
 def _bucket_nblocks(n: int) -> int:
     """Round a block count up to a power of two to bound compile count."""
-    b = 1
-    while b < n:
-        b <<= 1
-    return b
+    from ..utils.num import next_pow2
+
+    return next_pow2(n)
 
 
 # below this bucket size the pallas kernel's pad-to-1024-items overhead
@@ -475,11 +474,18 @@ def blake2b_batch_begin(
             from .blake2b_pallas import blake2b_packed_pallas as packed_fn
         else:
             packed_fn = blake2b_packed
-        mh, ml, lengths = pack_payloads([payloads[i] for i in idxs], nblocks=nb)
+        # pad the batch axis to a power of two as well: jit specializes
+        # per (B, nblocks), so unbucketed batch sizes recompile every
+        # distinct count (minutes each on the CPU scanned path).  Empty
+        # payloads are valid; their digests are dropped in collect().
+        batch = [payloads[i] for i in idxs]
+        Bp = _bucket_nblocks(len(batch))
+        batch += [b""] * (Bp - len(batch))
+        mh, ml, lengths = pack_payloads(batch, nblocks=nb)
         hh, hl = packed_fn(
             jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths), digest_size
         )
-        handles.append((idxs, hh, hl))
+        handles.append((idxs, hh[: len(idxs)], hl[: len(idxs)]))
 
     def collect() -> list[bytes]:
         out: list[bytes | None] = [None] * len(payloads)

@@ -97,13 +97,11 @@ def pad_batch(mesh: Mesh, mh, ml, lengths):
 
     Returns ``(mh, ml, lengths, B)``.
     """
+    from ..utils.num import next_pow2
+
     n = mesh.devices.size
     B = mh.shape[0]
-    per = -(-B // n)
-    p = 1
-    while p < per:
-        p <<= 1
-    Bp = n * p
+    Bp = n * next_pow2(-(-B // n))
     if Bp != B:
         pad = ((0, Bp - B),)
         mh = jnp.pad(mh, pad + ((0, 0), (0, 0)))

@@ -1,0 +1,50 @@
+"""The reference example over a REAL byte transport.
+
+Same session as examples/example.py (reference: example.js), but the two
+ends talk through an OS socketpair with pump threads — every byte
+crosses the kernel, and backpressure propagates sender <- socket <-
+decoder exactly as the reference's `encode.pipe(socket)` /
+`socket.pipe(decode)` deployment shape (reference: example.js:53,
+README.md:20-33).
+
+Run: python examples/example_transport.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import dat_replication_protocol_tpu as protocol  # noqa: E402
+from dat_replication_protocol_tpu.session import transport  # noqa: E402
+
+
+def main() -> None:
+    enc = protocol.encode()
+    dec = protocol.decode()
+
+    dec.change(lambda change, done: (
+        print(f"change: {change.key} v{change.from_}->v{change.to}"), done()
+    ))
+    dec.blob(lambda blob, done: blob.collect(
+        lambda data: (print(f"blob: {data!r}"), done())
+    ))
+    dec.finalize(lambda done: (print("finalize"), done()))
+
+    sess = transport.session_over_socketpair(enc, dec)
+
+    enc.change({"key": "hello", "change": 1, "from": 0, "to": 1,
+                "value": b"world"})
+    ws = enc.blob(11, lambda: print("blob flushed to the socket"))
+    ws.write(b"hello ")
+    ws.end(b"world")
+    enc.change({"key": "bye", "change": 2, "from": 1, "to": 2})
+    enc.finalize()
+
+    sess.wait()
+    print(f"done: {enc.bytes} bytes through the kernel, "
+          f"{dec.changes} changes, {dec.blobs} blobs")
+
+
+if __name__ == "__main__":
+    main()

@@ -222,3 +222,45 @@ def test_blob_pause_in_handler_defers_payload_in_bulk():
     assert dec.finished
     assert b"".join(got["chunks"]) == b"Z" * 5000
     assert got["keys"][-1] == "after"
+
+
+def test_fuzz_random_chunking_equivalence():
+    # any split of the same wire must produce identical events
+    import random as pyrandom
+
+    rng = pyrandom.Random(42)
+    wire = _wire(n=120, blob_every=4)
+    ref = _drive(wire, len(wire))
+    for trial in range(8):
+        dec = protocol.decode()
+        events = []
+        dec.change(lambda ch, done: (events.append(("c", ch)), done()))
+        dec.blob(lambda blob, done: blob.collect(
+            lambda d: (events.append(("b", d)), done())))
+        off = 0
+        while off < len(wire):
+            step = rng.choice([1, 3, 17, 255, 4096, 9999])
+            dec.write(wire[off : off + step])
+            off += step
+        dec.end()
+        assert dec.finished, trial
+        assert events == ref, trial
+
+
+def test_fuzz_hostile_bytes_never_hang():
+    # random garbage: the decoder must either destroy with ProtocolError
+    # or consume cleanly (if it happens to parse) — never crash or hang
+    import random as pyrandom
+
+    rng = pyrandom.Random(7)
+    for trial in range(20):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 9000)))
+        dec = protocol.decode()
+        errs = []
+        dec.on_error(errs.append)
+        try:
+            dec.write(blob)
+        except Exception as e:  # noqa: BLE001
+            raise AssertionError(f"trial {trial}: decoder raised {e!r}")
+        if dec.destroyed:
+            assert errs, trial

@@ -99,7 +99,7 @@ def test_pad_batch_non_uniform_sizes():
     import jax.numpy as jnp
 
     mesh = pmesh.make_mesh(8)
-    payloads = [b"item-%d" % i * (i + 1) for i in range(21)]  # B=21 -> 24? pad to 8*4=32
+    payloads = [b"item-%d" % i * (i + 1) for i in range(21)]  # B=21 -> 8*4=32
     mh, ml, lengths = blake2b.pack_payloads(payloads)
     mh, ml, lengths, B = pmesh.pad_batch(
         mesh, jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths)
@@ -137,7 +137,6 @@ def test_sharded_gear_scan_matches_single_device():
 
     # single-device reference through the same row layout
     got_cands = []
-    vw0 = rabin.GROUP // 32
     for t in range(T):
         dense = np.nonzero(np.unpackbits(
             bits[t].view(np.uint8), bitorder="little"
