@@ -356,10 +356,13 @@ class Decoder:
                 if (
                     self._state == TYPE_HEADER
                     and not self._header
-                    and (
-                        len(self._overflow) > 1
-                        or len(self._overflow[0]) >= self._NATIVE_MIN
-                    )
+                    # O(chunk-count) size check BEFORE merging: joining
+                    # the backlog costs O(bytes), and when the native
+                    # path is unavailable (_NATIVE_MIN pushed to 2**62)
+                    # an unconditional merge would re-copy the whole
+                    # backlog on every resume — quadratic on the pure-
+                    # Python fallback
+                    and sum(map(len, self._overflow)) >= self._NATIVE_MIN
                 ):
                     merged = self._merged_overflow()
                     if merged is not None and len(merged) >= self._NATIVE_MIN:

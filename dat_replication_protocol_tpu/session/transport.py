@@ -107,7 +107,17 @@ def recv_over(
         except DecoderDestroyedError:
             return
         if not consumed:
-            drained.wait()
+            # bounded-poll instead of a bare wait: a done() ack landing
+            # on another thread between the decoder's stall check and the
+            # callback parking can drain the decoder without firing our
+            # event (the session objects are single-threaded state; the
+            # transport is where cross-thread acks meet them), so
+            # re-check writability on a short period rather than hanging
+            # on a wakeup that may have been lost
+            while not (decoder.writable() or decoder.destroyed
+                       or decoder.finished):
+                drained.wait(0.05)
+                drained.clear()
 
 
 # -- socket / fd bindings ----------------------------------------------------
