@@ -231,14 +231,17 @@ class TpuDecoder(Decoder):
         for cb in self._digest_cbs:
             cb(kind, seq, digest)
 
-    def _finish_change(self, payload) -> None:
+    def _deliver_change(self, change, payload) -> None:
+        # hooked at _deliver_change (not _finish_change) so BOTH parse
+        # paths — the streaming scanner and the native bulk index, which
+        # skips _finish_change's re-parse — hash every change payload
         if self._digest_cbs:
             seq = self._change_seq
             self._pipeline.submit(
                 bytes(payload), lambda d, s=seq: self._emit_digest("change", s, d)
             )
         self._change_seq += 1
-        super()._finish_change(payload)
+        super()._deliver_change(change, payload)
 
     def _open_blob_if_ready(self) -> None:
         if self._digest_cbs:

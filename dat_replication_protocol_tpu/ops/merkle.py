@@ -170,6 +170,41 @@ def diff_root_guided(a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl):
 
 
 @jax.jit
+def update_leaves(levels_hh, levels_hl, idx, new_hh, new_hl):
+    """Incrementally apply K leaf updates to a built tree.
+
+    The replication data plane's steady state is "a small change batch
+    lands on a big snapshot": rebuilding a 2**20-leaf tree for a K-leaf
+    batch wastes N/K of the work.  This op scatters the new leaf digests
+    and recomputes only the K root-paths — K compressions per level,
+    log2(N) levels, all fixed shapes (duplicate parents among the K
+    paths are recomputed redundantly and scattered to the same value, so
+    no host-side dedup or dynamic shapes are needed).
+
+    ``levels_hh/hl``: tuples from :func:`build_tree` (leaves first, root
+    last); ``idx``: (K,) int32 leaf positions; ``new_hh/hl``: (K, 4)
+    replacement digests.  Returns new level tuples.  Cost: O(K log N)
+    vs O(N) rebuild — at K=1024, N=2**20 that is ~50x less hashing.
+    """
+    idx = jnp.asarray(idx, dtype=jnp.int32)
+    new_levels_hh = [levels_hh[0].at[idx].set(new_hh)]
+    new_levels_hl = [levels_hl[0].at[idx].set(new_hl)]
+    for lvl in range(1, len(levels_hh)):
+        child_hh = new_levels_hh[-1]
+        child_hl = new_levels_hl[-1]
+        pidx = idx >> 1
+        left = pidx * 2
+        p_hh, p_hl = merkle_parent(
+            child_hh[left], child_hl[left],
+            child_hh[left + 1], child_hl[left + 1],
+        )
+        new_levels_hh.append(levels_hh[lvl].at[pidx].set(p_hh))
+        new_levels_hl.append(levels_hl[lvl].at[pidx].set(p_hl))
+        idx = pidx
+    return tuple(new_levels_hh), tuple(new_levels_hl)
+
+
+@jax.jit
 def diff_root_guided_packed(a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl):
     """:func:`diff_root_guided` with the leaf mask packed 32 bools/word.
 

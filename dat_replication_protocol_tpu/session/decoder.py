@@ -162,6 +162,7 @@ class Decoder:
         self._pending = 0
         self._paused_readers = 0
         self._overflow: deque[memoryview] = deque()  # unparsed input, in order
+        self._overflow_bytes = 0  # running total (kept in sync with the deque)
         self._bulk: dict | None = None  # parked native frame-index cursor
         self._write_cbs: list[Callable[[], None]] = []
         self._end_queued = False
@@ -208,14 +209,22 @@ class Decoder:
         self.bytes += len(data)
         if len(data):
             self._overflow.append(data)
-        self._consume()
-        if self._overflow or self._bulk is not None or self._stalled():
-            if on_consumed is not None:
-                self._write_cbs.append(on_consumed)
-            return False
+            self._overflow_bytes += len(data)
+        # Park the completion callback BEFORE consuming: _consume's
+        # drained epilogue is the single place parked callbacks fire, so
+        # a done() ack landing on another thread can never slip between
+        # a stall check and the parking (the lost-wakeup TOCTOU).  A
+        # fresh wrapper keeps the parked entry unique per call.
+        entry = None
         if on_consumed is not None:
-            on_consumed()
-        return True
+            entry = lambda cb=on_consumed: cb()  # noqa: E731
+            self._write_cbs.append(entry)
+        self._consume()
+        if entry is not None:
+            return entry not in self._write_cbs  # fired <=> consumed
+        return not (
+            self._overflow or self._bulk is not None or self._stalled()
+        )
 
     def end(self, on_finished: OnDone = None) -> None:
         """Graceful end: after all prior frames are consumed, the finalize
@@ -238,6 +247,7 @@ class Decoder:
         if blob is not None and not blob.destroyed:
             blob.destroyed = True
         self._overflow.clear()
+        self._overflow_bytes = 0
         self._bulk = None
         for cb in self._error_cbs:
             cb(err)
@@ -356,13 +366,12 @@ class Decoder:
                 if (
                     self._state == TYPE_HEADER
                     and not self._header
-                    # O(chunk-count) size check BEFORE merging: joining
-                    # the backlog costs O(bytes), and when the native
-                    # path is unavailable (_NATIVE_MIN pushed to 2**62)
-                    # an unconditional merge would re-copy the whole
-                    # backlog on every resume — quadratic on the pure-
-                    # Python fallback
-                    and sum(map(len, self._overflow)) >= self._NATIVE_MIN
+                    # O(1) size gate BEFORE merging: joining the backlog
+                    # costs O(bytes), and when the native path is
+                    # unavailable (_NATIVE_MIN pushed to 2**62) an
+                    # unconditional merge would re-copy the whole backlog
+                    # on every resume — quadratic on the Python fallback
+                    and self._overflow_bytes >= self._NATIVE_MIN
                 ):
                     merged = self._merged_overflow()
                     if merged is not None and len(merged) >= self._NATIVE_MIN:
@@ -374,15 +383,16 @@ class Decoder:
                         # large blob frame still arriving): fall through
                         # to the streaming scanner so it can enter the
                         # frame and consume payload incrementally
-                        self._overflow.appendleft(merged)
+                        self._ov_appendleft(merged)
                     elif merged is not None:
-                        self._overflow.appendleft(merged)
+                        self._ov_appendleft(merged)
                 chunk = self._overflow.popleft()
+                self._overflow_bytes -= len(chunk)
                 rest = self._consume_chunk(chunk)
                 if self.destroyed:
                     return
                 if rest is not None and len(rest):
-                    self._overflow.appendleft(rest)
+                    self._ov_appendleft(rest)
         finally:
             self._consuming = False
         # Fully drained and nothing outstanding: release parked writers and
@@ -400,14 +410,21 @@ class Decoder:
                 cb()
             self._maybe_finalize()
 
+    def _ov_appendleft(self, mv: memoryview) -> None:
+        self._overflow.appendleft(mv)
+        self._overflow_bytes += len(mv)
+
     def _merged_overflow(self) -> memoryview | None:
         """Pop ALL queued overflow as one contiguous memoryview."""
         if not self._overflow:
             return None
         if len(self._overflow) == 1:
-            return self._overflow.popleft()
+            chunk = self._overflow.popleft()
+            self._overflow_bytes -= len(chunk)
+            return chunk
         chunks = list(self._overflow)
         self._overflow.clear()
+        self._overflow_bytes = 0
         return memoryview(b"".join(chunks))
 
     def _start_indexed(self, buf: memoryview) -> bool:
@@ -541,11 +558,8 @@ class Decoder:
                         self.destroy(ProtocolError(str(e)))
                         return
                     st["row"] = row + 1
-                    self.changes += 1
-                    self._state = TYPE_HEADER
                     self._missing = 0
-                    if self._on_change is not None:
-                        self._on_change(change, self._up())
+                    self._deliver_change(change, buf[start : start + flen])
                 else:
                     st["row"] = row + 1
                     self._state = TYPE_CHANGE
@@ -582,7 +596,7 @@ class Decoder:
         self._bulk = None
         tail = buf[st["consumed"]:]
         if len(tail):
-            self._overflow.appendleft(tail)
+            self._ov_appendleft(tail)
 
     def _consume_chunk(self, chunk: memoryview) -> memoryview | None:
         if self._state == TYPE_HEADER:
@@ -663,6 +677,13 @@ class Decoder:
         except ValueError as e:
             self.destroy(ProtocolError(str(e)))
             return
+        self._deliver_change(change, payload)
+
+    def _deliver_change(self, change: Change, payload) -> None:
+        """Deliver one decoded change: the single hook both parse paths
+        (streaming scanner and native bulk index) funnel through, so
+        subclasses adding per-change work (the TPU backend hashes every
+        payload) override exactly one method."""
         self.changes += 1
         self._state = TYPE_HEADER
         if self._on_change is not None:

@@ -127,3 +127,29 @@ def test_packed_diff_matches_dense():
     dense = np.unpackbits(np.asarray(bits).view(np.uint8), bitorder="little")
     got = np.nonzero(dense[:256])[0].tolist()
     assert got == [3, 77, 200, 255]
+
+
+def test_update_leaves_matches_rebuild():
+    import jax.numpy as jnp
+    import numpy as np
+
+    leaves = _leaves(64, seed=11)
+    hh, hl = merkle.digests_to_device(leaves)
+    levels_hh, levels_hl = merkle.build_tree(hh, hl)
+
+    # update 5 leaves, two sharing a parent (0 and 1)
+    upd = [0, 1, 17, 40, 63]
+    new = [_digest(b"new-%d" % i) for i in upd]
+    n_hh, n_hl = merkle.digests_to_device(new)
+    u_hh, u_hl = merkle.update_leaves(
+        levels_hh, levels_hl, jnp.asarray(upd), n_hh, n_hl
+    )
+
+    changed = list(leaves)
+    for i, d in zip(upd, new):
+        changed[i] = d
+    r_hh, r_hl = merkle.build_tree(*merkle.digests_to_device(changed))
+    for lvl, (a, b) in enumerate(zip(u_hh, r_hh)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f"hh level {lvl}"
+    for lvl, (a, b) in enumerate(zip(u_hl, r_hl)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f"hl level {lvl}"
