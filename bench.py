@@ -56,7 +56,15 @@ def _probe_backend(platform: str | None, timeout: float) -> tuple[str | None, st
     code = "import jax\n"
     if platform:
         code += f"jax.config.update('jax_platforms', {platform!r})\n"
-    code += "print('PROBE', jax.default_backend(), len(jax.devices()))\n"
+    # EXECUTE something and fetch it, not just list devices: a wedged
+    # tunnel (observed round 3: >6h outage) can enumerate devices fine
+    # while every launch hangs — the probe must prove the device RUNS
+    code += (
+        "import numpy as np, jax.numpy as jnp\n"
+        "x = np.asarray(jnp.arange(8) * 2)\n"
+        "assert x[3] == 6\n"
+        "print('PROBE', jax.default_backend(), len(jax.devices()))\n"
+    )
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
