@@ -94,24 +94,10 @@ def init_backend(retries: int = 3, probe_timeout: float = 90.0) -> tuple[str, st
     import jax
 
     # persistent compile cache: repeat runs (and driver re-runs) skip the
-    # multi-minute cold XLA compiles that dominate --quick wall time.
-    # Scoped per machine + jax version: XLA AOT artifacts from a different
-    # host can SIGILL (observed warnings from a shared cache dir).
-    try:
-        import hashlib
-        import platform
+    # multi-minute cold XLA compiles that dominate --quick wall time
+    from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
 
-        scope = hashlib.blake2b(
-            f"{platform.platform()}-{platform.processor()}-{jax.__version__}".encode(),
-            digest_size=6,
-        ).hexdigest()
-        cache_dir = os.environ.get(
-            "BENCH_COMPILE_CACHE", f"/tmp/dat_jax_cache-{scope}"
-        )
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception as e:
-        log(f"bench: compile cache unavailable ({e})")
+    enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
 
     force = os.environ.get("BENCH_PLATFORM") or None
     err: str | None = None

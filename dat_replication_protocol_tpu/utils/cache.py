@@ -1,0 +1,49 @@
+"""Persistent XLA compile-cache setup (one owner for all entry points).
+
+The scanned-BLAKE2b / tree programs take minutes to compile cold on the
+CPU backend and tens of seconds on TPU; a persistent cache turns reruns
+(tests, bench, examples, driver re-runs) into cache hits.  Scope rules:
+
+* keyed by platform + processor + jax version: AOT artifacts from a
+  host with different CPU features can SIGILL when loaded;
+* per-user path under the system temp dir: a predictable world-shared
+  path would let another local user pre-seed attacker-controlled
+  compiled artifacts (deserialized XLA programs execute).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+import tempfile
+
+
+def enable_compile_cache(tag: str, env_var: str | None = None) -> None:
+    """Point jax at a persistent, scoped compile-cache directory.
+
+    ``tag`` separates entry points (tests/bench/examples); ``env_var``
+    optionally names an environment variable that overrides the path.
+    Never raises: the cache is an optimization.
+    """
+    try:
+        import jax
+
+        override = os.environ.get(env_var) if env_var else None
+        if override:
+            path = override
+        else:
+            scope = hashlib.blake2b(
+                f"{platform.platform()}-{platform.processor()}-"
+                f"{jax.__version__}".encode(),
+                digest_size=6,
+            ).hexdigest()
+            user = f"u{os.getuid()}" if hasattr(os, "getuid") else "u0"
+            path = os.path.join(
+                tempfile.gettempdir(),
+                f"dat_jax_cache-{user}-{tag}-{scope}",
+            )
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass

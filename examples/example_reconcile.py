@@ -24,8 +24,11 @@ import jax  # noqa: E402
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 # repeat runs skip the multi-minute cold XLA compiles (CPU scanned path)
-jax.config.update("jax_compilation_cache_dir", "/tmp/dat_jax_cache-examples")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from dat_replication_protocol_tpu.utils.cache import (  # noqa: E402
+    enable_compile_cache,
+)
+
+enable_compile_cache("examples")
 
 from dat_replication_protocol_tpu.ops import reconcile  # noqa: E402
 

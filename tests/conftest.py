@@ -26,3 +26,13 @@ if not os.environ.get("DAT_TPU_TESTS"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+    # persistent compile cache: the CPU backend's scanned-BLAKE2b/tree
+    # programs take minutes to compile cold; cached, suite reruns drop
+    # from ~15 min to ~4 min (measured)
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache("tests", env_var="DAT_TEST_COMPILE_CACHE")
