@@ -1,0 +1,31 @@
+#!/bin/bash
+# Fire the full device measurements the moment the tunnel answers.
+cd /root/repo
+set -x
+# 1) block_items sweep for the hash kernel (the open question)
+timeout 580 python - <<'PY' 2>&1 | grep -v WARNING
+import time, numpy as np, jax, jax.numpy as jnp
+from dat_replication_protocol_tpu.ops.blake2b_pallas import blake2b_native
+from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
+enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
+item_bytes = 1 << 20
+nblocks = item_bytes // 128
+def bench(chunk, block_items, reps=4):
+    kh, kl = jax.random.split(jax.random.PRNGKey(0))
+    shape = (nblocks, 16, 8, chunk // 8)
+    mh = jax.random.bits(kh, shape, dtype=jnp.uint32)
+    ml = jax.random.bits(kl, shape, dtype=jnp.uint32)
+    lengths = jnp.full((8, chunk // 8), item_bytes, dtype=jnp.uint32)
+    run = lambda: blake2b_native(mh, ml, lengths, block_items=block_items)
+    np.asarray(run()[0][:1,:1])
+    t0 = time.perf_counter()
+    outs = [run() for _ in range(reps)]
+    for hh, hl in outs:
+        np.asarray(hh[:1,:1]); np.asarray(hl[:1,:1])
+    dt = time.perf_counter() - t0
+    print(f"chunk={chunk} bi={block_items}: {reps*chunk*item_bytes/dt/(1<<30):.2f} GiB/s", flush=True)
+bench(2048, 1024)
+bench(2048, 2048)
+PY
+# 2) full bench configs 3,4,5
+BENCH_CONFIGS=3,4,5 timeout 1500 python bench.py 2>&1 | grep -v WARNING | tail -6

@@ -261,6 +261,22 @@ def bench_replay(quick: bool, backend: str) -> dict:
     cols, frames = replay.replay_log(log_buf)
     dt = time.perf_counter() - t0
     assert len(cols) == total_rows
+
+    # the inverse path: bulk log construction (native columnar encoder),
+    # measured over enough rows that the interval is timing-stable
+    recs = [
+        {"key": f"key-{i:07d}", "change": i, "from": i, "to": i + 1,
+         "value": b"v" * (i % 48), "subset": "s" if i % 3 else None}
+        for i in range(block_n)
+    ]
+    replay.encode_change_log(recs[:64])  # warm the path
+    enc_reps = max(1, min(total_rows, 100_000) // block_n)
+    big = recs * enc_reps
+    t0 = time.perf_counter()
+    wire = replay.encode_change_log(big)
+    edt = time.perf_counter() - t0
+    assert wire == block * enc_reps
+    enc_rows = len(big)
     return {
         "metric": "change_log_replay_rate",
         "value": round(total_rows / dt, 0),
@@ -269,6 +285,7 @@ def bench_replay(quick: bool, backend: str) -> dict:
         "native": native.available(),
         "rows": total_rows,
         "log_mib": round(log_buf.nbytes / (1 << 20), 1),
+        "encode_rows_s": round(enc_rows / edt, 0),
     }
 
 

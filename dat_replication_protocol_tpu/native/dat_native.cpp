@@ -232,3 +232,85 @@ int64_t dat_decode_changes(const uint8_t* buf, const int64_t* starts,
 }
 
 }  // extern "C"
+
+namespace {
+
+inline int uvarint_size(uint64_t v) {
+  int n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    ++n;
+  }
+  return n;
+}
+
+inline int64_t write_uvarint(uint8_t* dst, int64_t i, uint64_t v) {
+  while (v >= 0x80) {
+    dst[i++] = static_cast<uint8_t>(v) | 0x80;
+    v >>= 7;
+  }
+  dst[i++] = static_cast<uint8_t>(v);
+  return i;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bulk-encode n Change records (columnar, offsets into `src`) as framed
+// wire bytes: varint(len+1) | 0x01 | proto payload, fields in ascending
+// field-number order matching the Python encoder (wire/change_codec.py).
+// sub_len/val_len -1 = absent optional.  Returns bytes written into
+// `dst` (capacity `cap`), or DAT_ERR_CAPACITY.
+int64_t dat_encode_changes(const uint8_t* src, int64_t n,
+                           const uint32_t* change, const uint32_t* from_v,
+                           const uint32_t* to_v, const int64_t* key_off,
+                           const int64_t* key_len, const int64_t* sub_off,
+                           const int64_t* sub_len, const int64_t* val_off,
+                           const int64_t* val_len, uint8_t* dst,
+                           int64_t cap) {
+  int64_t w = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    // payload size
+    int64_t psize = 0;
+    if (sub_len[r] >= 0)
+      psize += 1 + uvarint_size(sub_len[r]) + sub_len[r];
+    psize += 1 + uvarint_size(key_len[r]) + key_len[r];
+    psize += 1 + uvarint_size(change[r]);
+    psize += 1 + uvarint_size(from_v[r]);
+    psize += 1 + uvarint_size(to_v[r]);
+    if (val_len[r] >= 0)
+      psize += 1 + uvarint_size(val_len[r]) + val_len[r];
+    int64_t need = uvarint_size(psize + 1) + 1 + psize;
+    if (w + need > cap) return DAT_ERR_CAPACITY;
+    w = write_uvarint(dst, w, psize + 1);
+    dst[w++] = 1;  // TYPE_CHANGE
+    if (sub_len[r] >= 0) {
+      dst[w++] = TAG_SUBSET;
+      w = write_uvarint(dst, w, sub_len[r]);
+      for (int64_t k = 0; k < sub_len[r]; ++k)
+        dst[w + k] = src[sub_off[r] + k];
+      w += sub_len[r];
+    }
+    dst[w++] = TAG_KEY;
+    w = write_uvarint(dst, w, key_len[r]);
+    for (int64_t k = 0; k < key_len[r]; ++k) dst[w + k] = src[key_off[r] + k];
+    w += key_len[r];
+    dst[w++] = TAG_CHANGE;
+    w = write_uvarint(dst, w, change[r]);
+    dst[w++] = TAG_FROM;
+    w = write_uvarint(dst, w, from_v[r]);
+    dst[w++] = TAG_TO;
+    w = write_uvarint(dst, w, to_v[r]);
+    if (val_len[r] >= 0) {
+      dst[w++] = TAG_VALUE;
+      w = write_uvarint(dst, w, val_len[r]);
+      for (int64_t k = 0; k < val_len[r]; ++k)
+        dst[w + k] = src[val_off[r] + k];
+      w += val_len[r];
+    }
+  }
+  return w;
+}
+
+}  // extern "C"

@@ -82,6 +82,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
         ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.dat_encode_changes.restype = ctypes.c_int64
+    lib.dat_encode_changes.argtypes = [
+        _U8P, ctypes.c_int64,
+        _U32P, _U32P, _U32P,
+        _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
+        _U8P, ctypes.c_int64,
+    ]
     return lib
 
 

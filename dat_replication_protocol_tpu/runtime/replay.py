@@ -254,6 +254,69 @@ def _fallback_locate(cols, r, buf, start, ln, ch):
             i += 8
 
 
+def encode_change_log(records: list[Change | dict]) -> bytes:
+    """Bulk-encode Change records as a framed wire log (replay_log's
+    inverse; the high-rate encode path for log construction at 1M-row
+    scale, where per-record Python framing costs more than everything
+    downstream).  Uses the native columnar encoder when available, the
+    scalar Python codec otherwise — byte-identical output either way
+    (tested)."""
+    from ..wire.change_codec import encode_change
+    from ..wire.framing import frame
+
+    lib = native.get_lib()
+    if lib is None:
+        return b"".join(
+            frame(TYPE_CHANGE, encode_change(r)) for r in records
+        )
+    n = len(records)
+    chg = np.empty(n, np.uint32)
+    frm = np.empty(n, np.uint32)
+    tov = np.empty(n, np.uint32)
+    koff = np.empty(n, np.int64)
+    klen = np.empty(n, np.int64)
+    soff = np.empty(n, np.int64)
+    slen = np.full(n, -1, np.int64)
+    voff = np.empty(n, np.int64)
+    vlen = np.full(n, -1, np.int64)
+    heap = bytearray()
+    for r, rec in enumerate(records):
+        if isinstance(rec, dict):
+            rec = Change.from_dict(rec)
+        if rec.key is None:
+            raise ValueError("Change.key is required")
+        kb = rec.key.encode("utf-8")
+        koff[r], klen[r] = len(heap), len(kb)
+        heap += kb
+        if rec.subset is not None:
+            sb = rec.subset.encode("utf-8")
+            soff[r], slen[r] = len(heap), len(sb)
+            heap += sb
+        else:
+            soff[r] = 0
+        if rec.value is not None:
+            voff[r], vlen[r] = len(heap), len(rec.value)
+            heap += bytes(rec.value)
+        else:
+            voff[r] = 0
+        for name, v in (("change", rec.change), ("from", rec.from_),
+                        ("to", rec.to)):
+            if not isinstance(v, int) or v < 0 or v > 0xFFFFFFFF:
+                raise ValueError(f"Change.{name} must be a uint32, got {v!r}")
+        chg[r], frm[r], tov[r] = rec.change, rec.from_, rec.to
+    src = np.frombuffer(bytes(heap), np.uint8) if heap else np.zeros(1, np.uint8)
+    # capacity bound: header(<=6) + per-field tags/varints(<=1+5 each x6)
+    # + payload bytes
+    cap = int(len(heap) + n * 64 + 64)
+    dst = np.empty(cap, np.uint8)
+    w = lib.dat_encode_changes(
+        src, n, chg, frm, tov, koff, klen, soff, slen, voff, vlen, dst, cap
+    )
+    if w < 0:
+        raise RuntimeError(f"native encode failed (code {w})")
+    return dst[:w].tobytes()
+
+
 def replay_log(data) -> tuple[ChangeColumns, FrameIndex]:
     """Replay a whole change-log buffer: config-2's engine.
 

@@ -22,9 +22,12 @@ import tempfile
 def enable_compile_cache(tag: str, env_var: str | None = None) -> None:
     """Point jax at a persistent, scoped compile-cache directory.
 
-    ``tag`` separates entry points (tests/bench/examples); ``env_var``
-    optionally names an environment variable that overrides the path.
-    Never raises: the cache is an optimization.
+    One shared directory serves every entry point (XLA keys entries per
+    program, so tests warming the cache speeds up bench and vice versa);
+    ``tag`` only labels the fallback log line.  ``env_var`` optionally
+    names an environment variable that overrides the path.  Never
+    raises: the cache is an optimization — but a disabled cache IS
+    logged, because silently losing it costs minutes per cold compile.
     """
     try:
         import jax
@@ -41,9 +44,18 @@ def enable_compile_cache(tag: str, env_var: str | None = None) -> None:
             user = f"u{os.getuid()}" if hasattr(os, "getuid") else "u0"
             path = os.path.join(
                 tempfile.gettempdir(),
-                f"dat_jax_cache-{user}-{tag}-{scope}",
+                f"dat_jax_cache-{user}-{scope}",
             )
+        # create 0700 and verify ownership: a predictable path that
+        # accepted a pre-existing foreign directory would let another
+        # local user feed us attacker-controlled compiled artifacts
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        if hasattr(os, "getuid") and os.stat(path).st_uid != os.getuid():
+            raise PermissionError(f"{path} owned by another user")
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass
+    except Exception as e:
+        import sys
+
+        print(f"{tag}: compile cache disabled ({e}); cold compiles ahead",
+              file=sys.stderr)
