@@ -1,6 +1,6 @@
 #!/bin/bash
 # Fire the full device measurements the moment the tunnel answers.
-cd /root/repo
+cd "$(dirname "$0")"
 set -x
 # 1) block_items sweep for the hash kernel (the open question)
 timeout 580 python - <<'PY' 2>&1 | grep -v WARNING

@@ -45,7 +45,30 @@ _LANE = 128
 _SUBLANE = 8
 
 
-def _kernel(*refs, digest_size: int, unroll: bool = True):
+class _RefWords:
+    """Lazy message-word view: ``m[w]`` issues the VMEM loads at use site.
+
+    The unrolled rounds reference each of the 16 message words twice per
+    round; materializing all 32 hi/lo word tiles up front pins 32 vector
+    registers for the whole block, which together with the 32 state
+    registers overflows the register file and makes the scheduler spill
+    *state* (measured: block_items=2048 halves throughput).  Issuing the
+    loads where the schedule consumes them leaves liveness decisions to
+    Mosaic, which can rematerialize a cheap VMEM load instead of
+    spilling a hot value.
+    """
+
+    def __init__(self, mh_ref, ml_ref):
+        self._mh = mh_ref
+        self._ml = ml_ref
+
+    def __getitem__(self, w):
+        w = int(w)
+        return self._mh[0, w], self._ml[0, w]
+
+
+def _kernel(*refs, digest_size: int, unroll: bool = True,
+            msg_loads: bool = False):
     if unroll:
         len_ref, mh_ref, ml_ref, outh_ref, outl_ref, sth_ref, stl_ref = refs
         sigma = None
@@ -78,7 +101,10 @@ def _kernel(*refs, digest_size: int, unroll: bool = True):
     t_lo = jnp.where(cap < lengths, cap, lengths)
 
     h = [(sth_ref[w], stl_ref[w]) for w in range(8)]
-    m = [(mh_ref[0, w], ml_ref[0, w]) for w in range(16)]
+    if msg_loads and unroll:
+        m = _RefWords(mh_ref, ml_ref)
+    else:
+        m = [(mh_ref[0, w], ml_ref[0, w]) for w in range(16)]
     nh = compress_soa(h, m, t_lo, final, unroll=unroll, sigma=sigma)
     for w in range(8):
         sth_ref[w] = jnp.where(active, nh[w][0], h[w][0])
@@ -92,10 +118,12 @@ def _kernel(*refs, digest_size: int, unroll: bool = True):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("digest_size", "block_items", "interpret")
+    jax.jit,
+    static_argnames=("digest_size", "block_items", "interpret", "msg_loads"),
 )
 def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
-                   block_items: int = 1024, interpret: bool = False):
+                   block_items: int = 1024, interpret: bool = False,
+                   msg_loads: bool = True):
     """Hash in the kernel-native layout.
 
     ``mh``/``ml``: (nblocks, 16, 8, B/8) uint32 message word halves;
@@ -118,7 +146,9 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
     # tests) gets the scanned rounds, whose 12x-smaller graph sidesteps
     # the CPU backend's pathological compile of the unrolled chain
     unroll = not interpret
-    kernel = functools.partial(_kernel, digest_size=digest_size, unroll=unroll)
+    kernel = functools.partial(
+        _kernel, digest_size=digest_size, unroll=unroll, msg_loads=msg_loads
+    )
     in_specs = [
         pl.BlockSpec((_SUBLANE, btl), lambda i, j: (0, i)),
         pl.BlockSpec((1, 16, _SUBLANE, btl), lambda i, j: (j, 0, 0, i)),

@@ -261,7 +261,7 @@ def encode_change_log(records: list[Change | dict]) -> bytes:
     downstream).  Uses the native columnar encoder when available, the
     scalar Python codec otherwise — byte-identical output either way
     (tested)."""
-    from ..wire.change_codec import encode_change
+    from ..wire.change_codec import _check_uint32, encode_change
     from ..wire.framing import frame
 
     lib = native.get_lib()
@@ -299,12 +299,12 @@ def encode_change_log(records: list[Change | dict]) -> bytes:
             heap += bytes(rec.value)
         else:
             voff[r] = 0
-        for name, v in (("change", rec.change), ("from", rec.from_),
-                        ("to", rec.to)):
-            if not isinstance(v, int) or v < 0 or v > 0xFFFFFFFF:
-                raise ValueError(f"Change.{name} must be a uint32, got {v!r}")
-        chg[r], frm[r], tov[r] = rec.change, rec.from_, rec.to
-    src = np.frombuffer(bytes(heap), np.uint8) if heap else np.zeros(1, np.uint8)
+        chg[r] = _check_uint32("change", rec.change)
+        frm[r] = _check_uint32("from", rec.from_)
+        tov[r] = _check_uint32("to", rec.to)
+    # np.frombuffer reads the bytearray zero-copy (the C side takes
+    # const uint8*); heap stays alive via src for the call's duration
+    src = np.frombuffer(heap, np.uint8) if heap else np.zeros(1, np.uint8)
     # capacity bound: header(<=6) + per-field tags/varints(<=1+5 each x6)
     # + payload bytes
     cap = int(len(heap) + n * 64 + 64)

@@ -48,10 +48,26 @@ def enable_compile_cache(tag: str, env_var: str | None = None) -> None:
             )
         # create 0700 and verify ownership: a predictable path that
         # accepted a pre-existing foreign directory would let another
-        # local user feed us attacker-controlled compiled artifacts
+        # local user feed us attacker-controlled compiled artifacts.
+        # lstat + symlink rejection: st_uid of the *target* passes the
+        # ownership test when an attacker plants a symlink to a dir the
+        # victim owns, redirecting cache reads/writes wherever they chose.
+        # The hardening applies only to the *derived* (predictable)
+        # default path — an operator-chosen override is trusted as given
+        # (shared group caches and symlinked scratch disks are legitimate
+        # there, and the planted-path attack needs a predictable target)
         os.makedirs(path, mode=0o700, exist_ok=True)
-        if hasattr(os, "getuid") and os.stat(path).st_uid != os.getuid():
-            raise PermissionError(f"{path} owned by another user")
+        if not override:
+            st = os.lstat(path)
+            import stat as stat_mod
+
+            if stat_mod.S_ISLNK(st.st_mode):
+                raise PermissionError(f"{path} is a symlink")
+            if hasattr(os, "getuid"):  # POSIX-only: Windows fakes 0o777
+                if st.st_uid != os.getuid():
+                    raise PermissionError(f"{path} owned by another user")
+                if st.st_mode & 0o022:
+                    raise PermissionError(f"{path} group/world-writable")
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception as e:
