@@ -84,7 +84,30 @@ def hash_extents(buf: np.ndarray, offs, lens,
 
     The bucketed, vectorized-pack version of
     :func:`..ops.blake2b.blake2b_batch` for data already resident in one
-    buffer (replay logs, reassembled blobs).
+    buffer (replay logs, reassembled blobs).  The digests ride D2H here;
+    device-side consumers should stay on :func:`hash_extents_device`.
+    """
+    n = len(offs)
+    out = np.empty((n, 32), dtype=np.uint8)
+    if not n:
+        return out
+    hh, hl = hash_extents_device(buf, offs, lens, use_pallas)
+    raw = np.empty((n, 8), dtype="<u4")
+    raw[:, 0::2] = np.asarray(hl)
+    raw[:, 1::2] = np.asarray(hh)
+    return raw.view(np.uint8).reshape(n, 32)
+
+
+def hash_extents_device(buf: np.ndarray, offs, lens,
+                        use_pallas: bool | None = None):
+    """Digests of extents as DEVICE arrays ``(hh, hl)``, each (N, 4) u32.
+
+    The HBM-resident core of :func:`hash_extents`: columns are the four
+    (hi, lo) u32 word pairs of the 32-byte digest (byte k*8..k*8+3 = lo
+    word k, k*8+4..k*8+7 = hi word k, little-endian).  For consumers
+    that keep reducing on device (sketch scatter-adds, Merkle leaf
+    levels), fetching N 32-byte digests only to re-upload them is pure
+    tunnel tax — at 1M digests that is 32 MB of D2H for nothing.
     """
     import jax
 
@@ -93,18 +116,19 @@ def hash_extents(buf: np.ndarray, offs, lens,
     offs = np.asarray(offs, dtype=np.int64)
     lens = np.asarray(lens, dtype=np.int64)
     n = len(offs)
-    out = np.empty((n, 32), dtype=np.uint8)
-    if not n:
-        return out
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
+    out_hh = jnp.zeros((max(1, n), 4), dtype=jnp.uint32)
+    out_hl = jnp.zeros((max(1, n), 4), dtype=jnp.uint32)
+    if not n:
+        return out_hh[:0], out_hl[:0]
     for nb, idx in bucketed_extents(lens).items():
         mh, ml, blens = pack_ragged(buf, offs[idx], lens[idx], nb)
         # pad the batch axis to a power of two: jit specializes per
         # (B, nblocks) shape, and without bucketing B every distinct
         # batch size pays a fresh compile (minutes on the CPU backend's
         # scanned path).  Zero rows are valid empty payloads; their
-        # digests are dropped below.
+        # digests land in rows the scatter below never touches.
         B = len(idx)
         Bp = blake2b._bucket_nblocks(max(1, B))
         if Bp != B:
@@ -117,13 +141,10 @@ def hash_extents(buf: np.ndarray, offs, lens,
         else:
             fn = blake2b.blake2b_packed
         hh, hl = fn(jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(blens))
-        raw = np.empty((B, 8), dtype="<u4")
-        # slice on DEVICE before transferring: padding rows and the
-        # unused high word columns would otherwise ride the D2H link
-        raw[:, 0::2] = np.asarray(hl[:B, :4])
-        raw[:, 1::2] = np.asarray(hh[:B, :4])
-        out[idx] = raw.view(np.uint8).reshape(B, 32)
-    return out
+        at = jnp.asarray(idx)
+        out_hh = out_hh.at[at].set(hh[:B, :4])
+        out_hl = out_hl.at[at].set(hl[:B, :4])
+    return out_hh, out_hl
 
 
 def leaves_from_columns(cols, frames=None) -> np.ndarray:

@@ -44,37 +44,6 @@ from ..utils.trace import span
 DIGEST_WORDS = 8  # 32-byte digests as 8 uint32 words
 
 
-def sketch(digests: np.ndarray, keys_hash: np.ndarray, log2_slots: int):
-    """Build the key-addressed sketch on device.
-
-    ``digests``: (N, 32) uint8 record digests (or (N, 8) uint32 words);
-    ``keys_hash``: (N,) uint64/int64 stable key hashes (any good mix of
-    the record *key* — :func:`key_hashes` derives them from key-digest
-    prefixes); returns (nslots, 8) uint32 device array.
-    """
-    import jax.numpy as jnp
-
-    digests = np.asarray(digests)
-    if digests.dtype == np.uint8:
-        words = digests.reshape(len(digests), 32).view("<u4")
-    else:
-        words = digests.reshape(len(digests), DIGEST_WORDS)
-    nslots = 1 << log2_slots
-    slots = np.asarray(keys_hash, dtype=np.uint64) & np.uint64(nslots - 1)
-    with span("reconcile.sketch"):
-        table = jnp.zeros((nslots, DIGEST_WORDS), dtype=jnp.uint32)
-        table = table.at[jnp.asarray(slots.astype(np.int64))].add(
-            jnp.asarray(np.ascontiguousarray(words))
-        )
-    return table
-
-
-def key_hashes(key_digests: np.ndarray) -> np.ndarray:
-    """(N,) uint64 slot hashes from (N, 32) uint8 key digests."""
-    kd = np.asarray(key_digests)
-    return kd.reshape(len(kd), 32)[:, :8].copy().view("<u8").reshape(-1)
-
-
 def diff_sketches(table_a, table_b) -> np.ndarray:
     """Differing slot indices between two sketches (sorted ascending).
 
@@ -98,22 +67,56 @@ def diff_sketches(table_a, table_b) -> np.ndarray:
     return np.nonzero(dense)[0]
 
 
+_SUMMARIZE_JIT = None  # lazy: keep jax out of module import
+
+
+def _summarize(all_hh, all_hl, n: int, log2_slots: int):
+    """Device-fused summary: record digests -> sketch table, key digests
+    -> slot indices.  Runs jitted so only the (tiny) slot vector and the
+    (nslots, 8) table ever exist as outputs; the 2n digests stay in HBM.
+    """
+    import jax.numpy as jnp
+
+    nslots = 1 << log2_slots
+    # slot = key-digest first-8-bytes (LE u64) & (nslots-1); for
+    # log2_slots <= 31 that mask only touches the low u32 word (and the
+    # int32 scatter index below stays non-negative), so the u64
+    # lane-pair never needs materializing
+    slots = all_hl[n:, 0] & jnp.uint32(nslots - 1)
+    # interleave (hl, hh) word pairs back to the host digest word order:
+    # words[2k] = lo k, words[2k+1] = hi k (see hash_extents_device)
+    words = jnp.stack([all_hl[:n], all_hh[:n]], axis=2).reshape(n, 8)
+    table = jnp.zeros((nslots, DIGEST_WORDS), dtype=jnp.uint32)
+    table = table.at[slots.astype(jnp.int32)].add(words)
+    return table, slots
+
+
 class LogSummary:
-    """One replica's reconciliation state: digests, key hashes, sketch."""
+    """One replica's reconciliation state: key slots + digest sketch.
+
+    The digest pipeline is device-resident end-to-end (hash ->
+    scatter-add sketch on device, jit-fused): per record, only its
+    4-byte slot index crosses D2H — the 64 bytes of record+key digests
+    stay in HBM.  On the tunneled dev link that transfer was the
+    dominant cost of reconciliation (measured ~45% of wall time at 200k
+    records).
+    """
 
     def __init__(self, records: list[bytes], keys: list[bytes],
                  log2_slots: int):
-        from ..batch.feed import hash_extents
+        import jax
+
+        from ..batch.feed import hash_extents_device
 
         if len(records) != len(keys):
             raise ValueError("records and keys must align")
+        if not 0 < log2_slots <= 31:
+            raise ValueError("log2_slots must be in [1, 31]")
         n = len(records)
         if n == 0:  # a fresh replica reconciling against a populated one
             import jax.numpy as jnp
 
-            self.digests = np.empty((0, 32), dtype=np.uint8)
-            self.key_hash = np.empty((0,), dtype=np.uint64)
-            self.slots = np.empty((0,), dtype=np.uint64)
+            self.slots = np.empty((0,), dtype=np.int64)
             self.table = jnp.zeros((1 << log2_slots, DIGEST_WORDS),
                                    dtype=jnp.uint32)
             self.keys = []
@@ -123,11 +126,13 @@ class LogSummary:
                         + [len(k) for k in keys], dtype=np.int64)
         offs = np.cumsum(lens) - lens
         with span("reconcile.hash"):
-            all_digests = hash_extents(buf, offs, lens)
-        self.digests = all_digests[:n]
-        self.key_hash = key_hashes(all_digests[n:])
-        self.slots = self.key_hash & np.uint64((1 << log2_slots) - 1)
-        self.table = sketch(self.digests, self.key_hash, log2_slots)
+            all_hh, all_hl = hash_extents_device(buf, offs, lens)
+        global _SUMMARIZE_JIT
+        if _SUMMARIZE_JIT is None:  # one wrapper, so jit caching applies
+            _SUMMARIZE_JIT = jax.jit(_summarize, static_argnums=(2, 3))
+        with span("reconcile.sketch"):
+            self.table, slots = _SUMMARIZE_JIT(all_hh, all_hl, n, log2_slots)
+        self.slots = np.asarray(slots).astype(np.int64)
         self.keys = keys
 
 

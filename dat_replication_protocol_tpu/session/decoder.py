@@ -727,11 +727,21 @@ class Decoder:
         assert blob is not None
         take = min(len(chunk), self._missing)
         self._missing -= take
-        blob._deliver(bytes(chunk[:take]))
+        # materialize ONCE; bytes are immutable, so every consumer —
+        # the BlobReader and any _note_blob_bytes subscriber (digest
+        # buffering) — shares this object instead of re-copying the
+        # scratch memoryview
+        data = bytes(chunk[:take])
+        self._note_blob_bytes(data)
+        blob._deliver(data)
         rest = chunk[take:]
         if self._missing == 0:
             self._end_blob()
         return rest
+
+    def _note_blob_bytes(self, data: bytes) -> None:
+        """Hook: called with each materialized blob payload piece (exactly
+        the bytes object delivered to the BlobReader).  Base: no-op."""
 
     def _end_blob(self) -> None:
         blob, self._current_blob = self._current_blob, None
