@@ -88,9 +88,8 @@ def hash_extents(buf: np.ndarray, offs, lens,
     device-side consumers should stay on :func:`hash_extents_device`.
     """
     n = len(offs)
-    out = np.empty((n, 32), dtype=np.uint8)
     if not n:
-        return out
+        return np.empty((0, 32), dtype=np.uint8)
     hh, hl = hash_extents_device(buf, offs, lens, use_pallas)
     raw = np.empty((n, 8), dtype="<u4")
     raw[:, 0::2] = np.asarray(hl)
