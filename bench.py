@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 import time
 import traceback
@@ -36,6 +37,24 @@ import traceback
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def _timed_reps(fenced_run, reps: int) -> list[float]:
+    """Time ``reps`` calls individually; caller takes the median.
+
+    Each call must fence its own completion (device configs end in a
+    small D2H).  Median-of-reps is the headline on device configs: the
+    dev chip is shared, and one congestion spike in one rep should not
+    misprice a kernel (identical code measured 9-21 GiB/s across one
+    congested afternoon).  The aggregate over sum(dts) is reported
+    alongside for transparency.
+    """
+    dts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fenced_run()
+        dts.append(time.perf_counter() - t0)
+    return dts
 
 
 def _env_int(name, default):
@@ -344,15 +363,19 @@ def bench_hash(quick: bool, backend: str) -> dict:
     # stage (batch/feed.leaves_from_columns -> ops.merkle.build_tree),
     # not the host; fetching all of them would bill the ~8.5 MiB/s dev
     # tunnel's D2H against the kernel (~45% of wall time at these rates).
-    t0 = time.perf_counter()
-    outs = [run() for _ in range(reps)]
-    for hh, hl in outs:
+    def fenced_run():
+        hh, hl = run()
         np.asarray(hh[:1, :1])
         np.asarray(hl[:1, :1])
-    dt = time.perf_counter() - t0
+
+    rep_dts = _timed_reps(fenced_run, reps)
+    dt = sum(rep_dts)
     total = reps * chunk * item_bytes
-    gib_s = total / dt / (1 << 30)
-    log(f"bench[hash]: {total / (1 << 30):.1f} GiB in {dt:.3f}s = {gib_s:.2f} GiB/s")
+    gib_s = (chunk * item_bytes) / statistics.median(rep_dts) / (1 << 30)
+    log(
+        f"bench[hash]: {total / (1 << 30):.1f} GiB in {dt:.3f}s = "
+        f"{gib_s:.2f} GiB/s median ({total / dt / (1 << 30):.2f} aggregate)"
+    )
 
     # honest end-to-end variant: host log buffer -> pack_ragged -> H2D ->
     # digests -> D2H, the batch/feed.py:hash_extents path.  Small volume
@@ -392,6 +415,7 @@ def bench_hash(quick: bool, backend: str) -> dict:
         "value": round(gib_s, 3),
         "unit": "GiB/s",
         "vs_baseline": round(gib_s / 50.0, 4),
+        "aggregate_gib_s": round(total / dt / (1 << 30), 3),
         "e2e_host_gib_s": round(e2e_gib_s, 3),
         "h2d_mib_s": round(h2d, 1),
         "items": reps * chunk,
@@ -536,14 +560,15 @@ def bench_merkle(quick: bool, backend: str) -> dict:
 
     idx = run()  # warmup/compile
     reps = 3 if quick else 10
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        run()
-    dt = time.perf_counter() - t0
-    rate = reps * n / dt
+    # each rep already ends in a host-side nonzero (its own fence), so
+    # reps were never pipelined
+    rep_dts = _timed_reps(run, reps)
+    dt = sum(rep_dts)
+    rate = n / statistics.median(rep_dts)
     log(
         f"bench[merkle]: {log2}-level diff x{reps} in {dt:.3f}s = "
-        f"{rate / 1e6:.2f} M entries/s ({len(idx)} differing leaves)"
+        f"{rate / 1e6:.2f} M entries/s median ({reps * n / dt / 1e6:.2f} "
+        f"aggregate; {len(idx)} differing leaves)"
     )
     # divergent-replica reconciliation rate (round-2 verdict missing #2):
     # two logs differing by inserts/deletes/flips, end-to-end through
@@ -583,6 +608,7 @@ def bench_merkle(quick: bool, backend: str) -> dict:
         "value": round(rate, 0),
         "unit": "entries/s",
         "vs_baseline": round(rate / 10e6, 4),
+        "aggregate_entries_s": round(reps * n / dt, 0),
         "leaves": n,
         "reconcile_records_s": round(rrate, 0),
         "reconcile_records": len(keys_a) + len(keys_b),

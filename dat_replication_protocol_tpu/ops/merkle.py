@@ -111,7 +111,7 @@ def build_tree(leaf_hh, leaf_hl):
     compressions.
     """
     n = leaf_hh.shape[0]
-    if n & (n - 1):
+    if n == 0 or n & (n - 1):
         raise ValueError(f"leaf count {n} is not a power of two; pad first")
     levels_hh, levels_hl = [leaf_hh], [leaf_hl]
     while leaf_hh.shape[0] > 1:
@@ -148,7 +148,7 @@ def diff_root_guided(a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl):
     the Pallas kernel's minimum-parents threshold.
     """
     n = a_leaf_hh.shape[0]
-    if n & (n - 1):
+    if n == 0 or n & (n - 1):
         raise ValueError(f"leaf count {n} is not a power of two; pad first")
     if b_leaf_hh.shape[0] != n:
         raise ValueError(

@@ -153,3 +153,30 @@ def test_update_leaves_matches_rebuild():
         assert np.array_equal(np.asarray(a), np.asarray(b)), f"hh level {lvl}"
     for lvl, (a, b) in enumerate(zip(u_hl, r_hl)):
         assert np.array_equal(np.asarray(a), np.asarray(b)), f"hl level {lvl}"
+
+
+def test_diff_rejects_unequal_snapshot_widths():
+    # the fused concat-tree build must not accept widths that merely sum
+    # to a power of two (a 4+12 concat builds a "valid" 16-leaf tree
+    # whose halves are not the two snapshots)
+    a_hh, a_hl = merkle.digests_to_device(_leaves(4))
+    b_hh, b_hl = merkle.digests_to_device(_leaves(12, seed=1))
+    with pytest.raises(ValueError, match="widths differ"):
+        merkle.diff_root_guided(a_hh, a_hl, b_hh, b_hl)
+
+
+@pytest.mark.parametrize("n", [1, 2])
+def test_diff_tiny_trees(n):
+    a = _leaves(n)
+    b = list(a)
+    b[-1] = _digest(b"flipped")
+    a_hh, a_hl = merkle.digests_to_device(a)
+    b_hh, b_hl = merkle.digests_to_device(b)
+    mask, (rahh, rahl), (rbhh, rbhl) = merkle.diff_root_guided(
+        a_hh, a_hl, b_hh, b_hl
+    )
+    assert np.nonzero(np.asarray(mask))[0].tolist() == [n - 1]
+    (ra,) = merkle.digests_from_device(rahh, rahl)
+    (rb,) = merkle.digests_from_device(rbhh, rbhl)
+    assert ra == merkle.host_tree(a)[-1][0]
+    assert rb == merkle.host_tree(b)[-1][0]

@@ -97,3 +97,12 @@ def test_empty_replica_bootstrap():
     out = reconcile.reconcile(empty, full)
     assert out["a_keys"] == []
     assert set(out["b_keys"]) == set(keys)
+
+
+def test_log2_slots_bounds():
+    import pytest
+
+    recs, ks = _mk_log([b"a", b"b"])
+    for bad in (0, -1, 32, 40):
+        with pytest.raises(ValueError, match="log2_slots"):
+            reconcile.LogSummary(recs, ks, bad)
