@@ -1,5 +1,7 @@
-"""Host runtime: native (C++) parsing loops + change-log replay engine."""
+"""Host runtime: native (C++) parsing loops, change-log replay engine,
+and the composed content-addressing pipeline."""
 
+from .content import ContentSummary, content_address, delta, reassemble
 from .replay import (
     ChangeColumns,
     FrameIndex,
@@ -10,8 +12,12 @@ from .replay import (
 
 __all__ = [
     "ChangeColumns",
+    "ContentSummary",
     "FrameIndex",
+    "content_address",
     "decode_change_columns",
+    "delta",
+    "reassemble",
     "replay_log",
     "split_frames",
 ]
