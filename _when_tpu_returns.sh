@@ -2,30 +2,44 @@
 # Fire the full device measurements the moment the tunnel answers.
 cd "$(dirname "$0")"
 set -x
-# 1) block_items sweep for the hash kernel (the open question)
-timeout 580 python - <<'PY' 2>&1 | grep -v WARNING
-import time, numpy as np, jax, jax.numpy as jnp
+# 1) hash kernel variant sweep: msg_loads x block_items, interleaved
+#    twice to denoise the shared chip
+timeout 900 python - <<'PY' 2>&1 | grep -v WARNING
+import time, statistics, numpy as np, jax, jax.numpy as jnp
 from dat_replication_protocol_tpu.ops.blake2b_pallas import blake2b_native
 from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
 enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
 item_bytes = 1 << 20
 nblocks = item_bytes // 128
-def bench(chunk, block_items, reps=4):
+def mk(chunk):
     kh, kl = jax.random.split(jax.random.PRNGKey(0))
     shape = (nblocks, 16, 8, chunk // 8)
-    mh = jax.random.bits(kh, shape, dtype=jnp.uint32)
-    ml = jax.random.bits(kl, shape, dtype=jnp.uint32)
-    lengths = jnp.full((8, chunk // 8), item_bytes, dtype=jnp.uint32)
-    run = lambda: blake2b_native(mh, ml, lengths, block_items=block_items)
-    np.asarray(run()[0][:1,:1])
-    t0 = time.perf_counter()
-    outs = [run() for _ in range(reps)]
-    for hh, hl in outs:
-        np.asarray(hh[:1,:1]); np.asarray(hl[:1,:1])
-    dt = time.perf_counter() - t0
-    print(f"chunk={chunk} bi={block_items}: {reps*chunk*item_bytes/dt/(1<<30):.2f} GiB/s", flush=True)
-bench(2048, 1024)
-bench(2048, 2048)
+    return (jax.random.bits(kh, shape, dtype=jnp.uint32),
+            jax.random.bits(kl, shape, dtype=jnp.uint32),
+            jnp.full((8, chunk // 8), item_bytes, dtype=jnp.uint32))
+data = {c: mk(c) for c in (2048, 4096)}
+def run(tag, chunk, bi, ml):
+    mh, mlo, lens = data[chunk]
+    f = lambda: blake2b_native(mh, mlo, lens, block_items=bi, msg_loads=ml)
+    np.asarray(f()[0][:1, :1])
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        hh, hl = f()
+        np.asarray(hh[:1, :1]); np.asarray(hl[:1, :1])
+        dts.append(time.perf_counter() - t0)
+    g = chunk * item_bytes / statistics.median(dts) / (1 << 30)
+    print(f"{tag}: {g:.2f} GiB/s (median of 3)", flush=True)
+variants = [("A c4096 bi1024 ml0", 4096, 1024, False),
+            ("K c4096 bi1024 ml1", 4096, 1024, True),
+            ("K2 c4096 bi2048 ml1", 4096, 2048, True),
+            ("K3 c2048 bi1024 ml1", 2048, 1024, True)]
+for rnd in range(2):
+    for tag, c, bi, ml in variants:
+        run(f"r{rnd} {tag}", c, bi, ml)
 PY
-# 2) full bench configs 3,4,5
+# 2) profiler trace of the hash+cdc+merkle configs (quick shapes)
+BENCH_CONFIGS=3,5 timeout 600 python bench.py --quick --trace=/tmp/dat_trace 2>&1 | tail -3
+ls -la /tmp/dat_trace 2>/dev/null | head -5
+# 3) full bench configs 3,4,5
 BENCH_CONFIGS=3,4,5 timeout 1500 python bench.py 2>&1 | grep -v WARNING | tail -6
