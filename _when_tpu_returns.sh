@@ -39,7 +39,7 @@ for rnd in range(2):
         run(f"r{rnd} {tag}", c, bi, ml)
 PY
 # 2) profiler trace of the hash+cdc+merkle configs (quick shapes)
-BENCH_CONFIGS=3,5 timeout 600 python bench.py --quick --trace=/tmp/dat_trace 2>&1 | tail -3
+BENCH_CONFIGS=3,4,5 timeout 900 python bench.py --quick --trace=/tmp/dat_trace 2>&1 | tail -3
 ls -la /tmp/dat_trace 2>/dev/null | head -5
 # 3) full bench configs 3,4,5
 BENCH_CONFIGS=3,4,5 timeout 1500 python bench.py 2>&1 | grep -v WARNING | tail -6

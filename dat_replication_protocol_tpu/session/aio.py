@@ -1,0 +1,141 @@
+"""asyncio transport pumps: a session over non-blocking byte streams.
+
+The reference's native habitat is Node's event loop — `pipe()` composes
+with any async stream and backpressure propagates through `write()`
+return values and `'drain'` events (reference: example.js:53,
+decode.js:87-99,168).  :mod:`.transport` covers blocking sockets/fds
+with thread pumps; this module is the single-threaded event-loop
+equivalent over :mod:`asyncio` streams:
+
+* **Sender**: pulls :meth:`Encoder.read` and writes to a
+  ``StreamWriter``; ``await writer.drain()`` is the congestion stall
+  (the kernel send buffer pushes back through asyncio's flow control).
+  An empty pull awaits the encoder's readable event.
+* **Receiver**: feeds ``StreamReader`` chunks to :meth:`Decoder.write`;
+  when the decoder stalls on an outstanding app ``done``, the pump
+  awaits the write-completion callback before reading on — so the
+  kernel receive buffer (not host RAM) absorbs the in-flight window.
+  Everything runs on one event loop, so unlike the threaded pump there
+  is no lost-wakeup window and no polling fallback.
+
+App callbacks fire on the event loop thread; ``done`` acks may be
+issued synchronously or deferred to any later task/callback on the
+same loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .decoder import Decoder, DecoderDestroyedError
+from .encoder import Encoder, EncoderDestroyedError
+from .transport import DEFAULT_CHUNK
+
+
+async def send_over_async(
+    encoder: Encoder,
+    writer: asyncio.StreamWriter,
+    chunk_size: int = DEFAULT_CHUNK,
+) -> None:
+    """Pump ``encoder`` into an asyncio writer until EOF or destroy."""
+    readable = asyncio.Event()
+    encoder._on_readable = readable.set
+    encoder.on_error(lambda _e: readable.set())
+    try:
+        while True:
+            try:
+                data = encoder.read(chunk_size)
+            except EncoderDestroyedError:
+                break
+            if data is None:  # finalized and drained
+                break
+            if not data:
+                await readable.wait()
+                readable.clear()
+                continue
+            writer.write(bytes(data))
+            await writer.drain()  # congestion backpressure
+    finally:
+        try:
+            if writer.can_write_eof():
+                writer.write_eof()
+        except (OSError, RuntimeError):
+            pass
+
+
+async def recv_over_async(
+    decoder: Decoder,
+    reader: asyncio.StreamReader,
+    chunk_size: int = DEFAULT_CHUNK,
+) -> None:
+    """Pump an asyncio reader into ``decoder`` until EOF or destroy."""
+    while not decoder.destroyed:
+        data = await reader.read(chunk_size)
+        if not data:
+            if not decoder.destroyed and not decoder.finished:
+                decoder.end()
+            return
+        drained = asyncio.Event()
+        try:
+            consumed = decoder.write(data, on_consumed=drained.set)
+        except DecoderDestroyedError:
+            return
+        if not consumed:
+            # single-threaded: the ack that drains the decoder runs on
+            # this loop, so the event cannot be missed (contrast the
+            # threaded pump's bounded poll, transport.py:recv_over)
+            await drained.wait()
+
+
+async def session_over_asyncio(
+    encoder: Encoder,
+    decoder: Decoder,
+    chunk_size: int = DEFAULT_CHUNK,
+) -> None:
+    """Run a whole session over a kernel socketpair on the event loop.
+
+    Opens both ends, pumps concurrently, returns when the sender has
+    flushed EOF and the receiver has finished (or either destroyed).
+    """
+    import socket
+
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    b.setblocking(False)
+    writers = []
+    send_task = recv_task = None
+    try:
+        _, writer = await asyncio.open_connection(sock=a)
+        reader, writer_b = await asyncio.open_connection(sock=b)
+        writers = [writer, writer_b]
+        send_task = asyncio.ensure_future(
+            send_over_async(encoder, writer, chunk_size)
+        )
+        recv_task = asyncio.ensure_future(
+            recv_over_async(decoder, reader, chunk_size)
+        )
+        await asyncio.gather(send_task, recv_task)
+    finally:
+        # one pump failing must not orphan the other (asyncio would log
+        # "Task exception was never retrieved" when the closed sockets
+        # fail it later)
+        for t in (send_task, recv_task):
+            if t is not None and not t.done():
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        # close via the transports (closing only the raw sockets leaves
+        # the StreamWriter transports registered with the loop)
+        for w in writers:
+            try:
+                w.close()
+                await w.wait_closed()
+            except (OSError, RuntimeError):
+                pass
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass

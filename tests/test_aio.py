@@ -1,0 +1,97 @@
+"""Session over asyncio streams: event-loop pumps, deferred acks.
+
+The asyncio analogue of test_transport.py's socket suite (reference
+semantics: example.js:53 piping over any async stream).
+"""
+
+import asyncio
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.session.aio import session_over_asyncio
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_changes_and_blob_over_asyncio():
+    enc, dec = protocol.encode(), protocol.decode()
+    got = []
+    dec.change(lambda c, done: (got.append(("change", c.key)), done()))
+    dec.blob(
+        lambda b, done: b.collect(lambda d: (got.append(("blob", d)), done()))
+    )
+    dec.finalize(lambda done: (got.append(("finalize",)), done()))
+
+    async def main():
+        enc.change({"key": "a", "change": 1, "from": 0, "to": 1})
+        ws = enc.blob(11)
+        ws.write(b"hello ")
+        ws.end(b"world")
+        enc.change({"key": "b", "change": 2, "from": 1, "to": 2})
+        enc.finalize()
+        await asyncio.wait_for(session_over_asyncio(enc, dec), 30)
+
+    _run(main())
+    assert got == [
+        ("change", "a"),
+        ("blob", b"hello world"),
+        ("change", "b"),
+        ("finalize",),
+    ]
+    assert enc.bytes == dec.bytes and dec.changes == 2 and dec.blobs == 1
+
+
+def test_deferred_ack_stalls_and_resumes():
+    enc, dec = protocol.encode(), protocol.decode()
+    order = []
+
+    def on_change(c, done):
+        order.append(f"change-{c.key}")
+        # ack later from the event loop: the pump must stall (not drop or
+        # reorder) until the deferred done fires
+        asyncio.get_running_loop().call_later(0.05, done)
+
+    dec.change(on_change)
+    dec.finalize(lambda done: (order.append("finalize"), done()))
+
+    async def main():
+        for i in range(5):
+            enc.change({"key": str(i), "change": i, "from": i, "to": i + 1})
+        enc.finalize()
+        await asyncio.wait_for(session_over_asyncio(enc, dec), 30)
+
+    _run(main())
+    assert order == [f"change-{i}" for i in range(5)] + ["finalize"]
+
+
+def test_large_blob_backpressure_over_asyncio():
+    enc, dec = protocol.encode(), protocol.decode()
+    total = (1 << 20) + 12345
+    seen = bytearray()
+
+    def on_blob(b, done):
+        b.on_data(lambda piece: seen.extend(piece))
+        b.on_end(lambda: done())
+
+    dec.blob(on_blob)
+
+    async def feed():
+        ws = enc.blob(total)
+        sent = 0
+        while sent < total:
+            n = min(64 * 1024, total - sent)
+            ws.write(bytes([sent % 251]) * n)
+            sent += n
+            await asyncio.sleep(0)  # yield so pumps interleave
+        ws.end()
+        enc.finalize()
+
+    async def main():
+        await asyncio.wait_for(
+            asyncio.gather(feed(), session_over_asyncio(enc, dec)), 60
+        )
+
+    _run(main())
+    assert len(seen) == total
+    assert dec.blobs == 1
