@@ -53,8 +53,16 @@ async def send_over_async(
                 await readable.wait()
                 readable.clear()
                 continue
-            writer.write(bytes(data))
-            await writer.drain()  # congestion backpressure
+            try:
+                writer.write(bytes(data))
+                await writer.drain()  # congestion backpressure
+            except OSError as e:  # incl. every ConnectionError subclass
+                # peer gone mid-session: nothing downstream will read
+                # these bytes — cascade into the encoder (failure
+                # semantics: destroy releases parked callbacks) and stop
+                if not encoder.destroyed:
+                    encoder.destroy(e)
+                break
     finally:
         try:
             if writer.can_write_eof():
@@ -70,7 +78,15 @@ async def recv_over_async(
 ) -> None:
     """Pump an asyncio reader into ``decoder`` until EOF or destroy."""
     while not decoder.destroyed:
-        data = await reader.read(chunk_size)
+        try:
+            data = await reader.read(chunk_size)
+        except OSError as e:
+            # peer reset mid-frame: cascade so the app's on_error fires
+            # (a decoder already destroyed/finished — e.g. the session's
+            # deliberate abort after an app-side destroy — stays as-is)
+            if not decoder.destroyed and not decoder.finished:
+                decoder.destroy(e)
+            return
         if not data:
             if not decoder.destroyed and not decoder.finished:
                 decoder.end()
@@ -102,18 +118,34 @@ async def session_over_asyncio(
     a, b = socket.socketpair()
     a.setblocking(False)
     b.setblocking(False)
-    writers = []
+    writers: list[asyncio.StreamWriter] = []
     send_task = recv_task = None
     try:
         _, writer = await asyncio.open_connection(sock=a)
+        writers.append(writer)  # immediately: if the second open raises,
+        # the finally must still tear this transport down
         reader, writer_b = await asyncio.open_connection(sock=b)
-        writers = [writer, writer_b]
+        writers.append(writer_b)
         send_task = asyncio.ensure_future(
             send_over_async(encoder, writer, chunk_size)
         )
         recv_task = asyncio.ensure_future(
             recv_over_async(decoder, reader, chunk_size)
         )
+        done, pending = await asyncio.wait(
+            {send_task, recv_task}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if pending and recv_task in done:
+            # receiver exited early (destroy): nothing will ever read
+            # the socket again.  Abort the transports (fails a sender
+            # blocked in drain()) AND destroy the encoder (wakes a
+            # sender parked in readable.wait() on an idle encoder — the
+            # destroy releases parked callbacks and fires on_error,
+            # which sets the readable event)
+            for w in writers:
+                w.transport.abort()
+            if not encoder.destroyed:
+                encoder.destroy(ConnectionAbortedError("receiver gone"))
         await asyncio.gather(send_task, recv_task)
     finally:
         # one pump failing must not orphan the other (asyncio would log
@@ -126,11 +158,18 @@ async def session_over_asyncio(
                     await t
                 except (asyncio.CancelledError, Exception):
                     pass
-        # close via the transports (closing only the raw sockets leaves
-        # the StreamWriter transports registered with the loop)
+        # abort, not close: a flushing close on a congested transport
+        # waits for a peer that may never read (teardown must not hang);
+        # on the normal path the sender already drained every write, so
+        # nothing is discarded
         for w in writers:
             try:
+                w.transport.abort()
                 w.close()
+            except (OSError, RuntimeError):
+                pass
+        for w in writers:
+            try:
                 await w.wait_closed()
             except (OSError, RuntimeError):
                 pass

@@ -95,3 +95,45 @@ def test_large_blob_backpressure_over_asyncio():
     _run(main())
     assert len(seen) == total
     assert dec.blobs == 1
+
+
+def test_decoder_destroy_mid_blob_does_not_hang():
+    # regression: a destroyed decoder leaves the socket unread; the
+    # session must abort the stuck sender instead of deadlocking in
+    # writer.drain() (and teardown must not hang on a flushing close)
+    enc, dec = protocol.encode(), protocol.decode()
+
+    def on_blob(b, done):
+        b.on_data(lambda piece: dec.destroy(RuntimeError("app bail")))
+
+    dec.blob(on_blob)
+    dec.on_error(lambda e: None)
+    enc.on_error(lambda e: None)
+
+    async def main():
+        ws = enc.blob(4 << 20)
+        ws.end(b"\xab" * (4 << 20))
+        enc.finalize()
+        await asyncio.wait_for(session_over_asyncio(enc, dec), 10)
+
+    _run(main())
+    assert dec.destroyed
+
+
+def test_decoder_destroy_with_idle_sender_does_not_hang():
+    # regression: receiver exits while the sender is parked in
+    # readable.wait() on an idle, unfinalized encoder — the session must
+    # destroy the encoder (waking the park) rather than deadlock
+    enc, dec = protocol.encode(), protocol.decode()
+    errs = []
+    dec.change(lambda c, done: dec.destroy(RuntimeError("bail")))
+    dec.on_error(lambda e: errs.append(e))
+    enc.on_error(lambda e: errs.append(e))
+
+    async def main():
+        enc.change({"key": "x", "change": 1, "from": 0, "to": 1})
+        # deliberately not finalized: the encoder goes idle
+        await asyncio.wait_for(session_over_asyncio(enc, dec), 10)
+
+    _run(main())
+    assert dec.destroyed and enc.destroyed

@@ -1,0 +1,110 @@
+"""Randomized session scripts: ordering/content invariants under fuzz.
+
+The fixed conformance suite pins the reference's documented orderings;
+this property test drives randomly generated producer scripts (blob
+creations, interleaved chunk writes, changes submitted at arbitrary
+moments) through randomly chunked decoder feeds and checks the
+invariants that hold for every schedule:
+
+* changes arrive exactly once, in submission order among themselves;
+* blobs arrive intact and in creation order (FIFO framing,
+  reference: encode.js:87-95);
+* a change submitted while no blob was open precedes any blob created
+  after it;
+* byte/frame counters agree on both ends and the finalize hook fires
+  last.
+"""
+
+import random
+
+import dat_replication_protocol_tpu as protocol
+
+
+def _run_script(seed: int) -> None:
+    rng = random.Random(seed)
+    enc, dec = protocol.encode(), protocol.decode()
+
+    events = []
+    dec.change(lambda c, done: (events.append(("change", c.key)), done()))
+    dec.blob(
+        lambda b, done: b.collect(
+            lambda d, _b=b: (events.append(("blob", d)), done())
+        )
+    )
+    dec.finalize(lambda done: (events.append(("finalize",)), done()))
+
+    sent_changes = []
+    blob_payloads = []
+    open_blobs = []  # (writer, payload, written)
+    clear_points = []  # change keys submitted while no blob was open
+    n_actions = rng.randrange(10, 40)
+    ci = 0
+    for _ in range(n_actions):
+        act = rng.random()
+        if act < 0.35:  # submit a change
+            key = f"c{ci}"
+            ci += 1
+            enc.change(
+                {"key": key, "change": ci, "from": ci, "to": ci + 1,
+                 "value": bytes(rng.randrange(0, 30))}
+            )
+            sent_changes.append(key)
+            if not open_blobs:
+                clear_points.append((key, len(blob_payloads)))
+        elif act < 0.65:  # open a blob
+            size = rng.randrange(1, 2000)
+            # unique prefix: duplicate payloads would make the
+            # events.index ordering assertions below ambiguous
+            uid = len(blob_payloads).to_bytes(2, "little")  # low byte
+            # first, so even 1-byte blobs stay unique within a script
+            payload = uid[: min(2, size)] + rng.randbytes(size - min(2, size))
+            ws = enc.blob(size)
+            open_blobs.append([ws, payload, 0])
+            blob_payloads.append(payload)
+        elif open_blobs:  # write a chunk into a random open blob
+            slot = rng.choice(open_blobs)
+            ws, payload, written = slot
+            n = rng.randrange(1, len(payload) - written + 1)
+            ws.write(payload[written:written + n])
+            slot[2] += n
+            if slot[2] == len(payload):
+                ws.end()
+                open_blobs.remove(slot)
+    for ws, payload, written in open_blobs:
+        ws.end(payload[written:])
+    enc.finalize()
+
+    # pump with randomly sized decoder feeds (1..4096 bytes)
+    wire = bytearray()
+    while True:
+        piece = enc.read(rng.randrange(1, 4096))
+        if piece is None:
+            break
+        if piece:
+            wire += piece
+    i = 0
+    while i < len(wire):
+        n = rng.randrange(1, 4096)
+        assert dec.write(bytes(wire[i:i + n]))
+        i += n
+    dec.end()
+
+    assert events[-1] == ("finalize",)
+    got_changes = [k for t, k in events[:-1] if t == "change"]
+    got_blobs = [d for t, d in events[:-1] if t == "blob"]
+    assert got_changes == sent_changes, seed
+    assert got_blobs == blob_payloads, seed
+    for key, n_blobs_before in clear_points:
+        # a change submitted while no blob was open must precede every
+        # blob created after it
+        c_at = events.index(("change", key))
+        for payload in blob_payloads[n_blobs_before:]:
+            assert c_at < events.index(("blob", payload)), seed
+    assert enc.bytes == dec.bytes, seed
+    assert enc.changes == dec.changes == len(sent_changes), seed
+    assert enc.blobs == dec.blobs == len(blob_payloads), seed
+
+
+def test_random_session_scripts():
+    for seed in range(60):
+        _run_script(seed)
