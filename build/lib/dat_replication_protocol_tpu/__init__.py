@@ -1,0 +1,82 @@
+"""dat_replication_protocol_tpu — a TPU-native replication-protocol framework.
+
+A ground-up re-design of the capabilities of `dat-replication-protocol`
+(the streaming dat replication wire codec) for TPU hardware:
+
+* the varint-framed multibuffer wire format and the `Change` protobuf codec
+  (reference: README.md:63-71, messages/schema.proto:1-8) as a host-side
+  session layer with the same ordering / backpressure / finalize semantics
+  (reference: encode.js, decode.js);
+* batched content-hashing (BLAKE2b), Rabin rolling-hash content-defined
+  chunking, and Merkle-tree diff / set reconciliation as JAX / Pallas kernels
+  that process thousands of blobs per XLA dispatch;
+* a ``backend='tpu'`` option on :func:`encode` / :func:`decode` that offloads
+  digest work to the device while keeping the callback API unchanged;
+* `jax.sharding` mesh parallelism for multi-chip scale-out.
+
+Public entry points mirror the reference's two factories
+(reference: index.js:1-2)::
+
+    import dat_replication_protocol_tpu as protocol
+    enc = protocol.encode()
+    dec = protocol.decode()           # or protocol.decode(backend='tpu')
+    protocol.pipe(enc, dec)
+"""
+
+from __future__ import annotations
+
+from .session import (
+    BlobLengthError,
+    BlobReader,
+    BlobWriter,
+    Decoder,
+    Encoder,
+    Pipe,
+    pipe,
+)
+from .wire import Change, ProtocolError, decode_change, encode_change
+
+__version__ = "0.1.0"
+
+
+def encode(backend: str = "host", **kwargs) -> Encoder:
+    """Create the producing end of a session (reference: index.js:1).
+
+    ``backend='tpu'`` attaches a device pipeline that content-hashes outgoing
+    blobs in batches (see :mod:`.backend`).
+    """
+    if backend == "host":
+        return Encoder(**kwargs)
+    if backend == "tpu":
+        from .backend import tpu_backend
+
+        return tpu_backend.TpuEncoder(**kwargs)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def decode(backend: str = "host", **kwargs) -> Decoder:
+    """Create the consuming end of a session (reference: index.js:2)."""
+    if backend == "host":
+        return Decoder(**kwargs)
+    if backend == "tpu":
+        from .backend import tpu_backend
+
+        return tpu_backend.TpuDecoder(**kwargs)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+__all__ = [
+    "encode",
+    "decode",
+    "pipe",
+    "Pipe",
+    "Change",
+    "ProtocolError",
+    "encode_change",
+    "decode_change",
+    "Encoder",
+    "Decoder",
+    "BlobReader",
+    "BlobWriter",
+    "BlobLengthError",
+]

@@ -1,0 +1,10 @@
+"""Batching feed layer: ragged host data -> fixed-shape device batches."""
+
+from .feed import bucketed_extents, hash_extents, leaves_from_columns, pack_ragged
+
+__all__ = [
+    "bucketed_extents",
+    "hash_extents",
+    "leaves_from_columns",
+    "pack_ragged",
+]

@@ -1,0 +1,1 @@
+"""Native C sources (compiled on demand by runtime.native)."""

@@ -1,0 +1,316 @@
+// Native runtime for dat_replication_protocol_tpu: the host-side hot loops.
+//
+// The reference's hot receive path is a byte-at-a-time varint scan and
+// per-frame dispatch in JS (reference: decode.js:144-169, 251-262).  The
+// TPU-native framework needs the same parsing at change-log-replay scale
+// (BASELINE.json config 2: 1M-row replay) where per-record Python costs
+// ~1us each; this translation unit provides the two tight loops behind a
+// plain C ABI (loaded via ctypes — no pybind11 in the image):
+//
+//   dat_split_frames    multibuffer framing: varint(len+1) | id | payload
+//   dat_decode_changes  proto2 `Change` records -> columnar arrays
+//                       (zero-copy: strings/bytes become (offset, len)
+//                       views into the log buffer — the layout the device
+//                       feed packs from directly)
+//
+// Build: g++ -O3 -shared -fPIC (runtime/native.py does this on demand and
+// caches the .so; every entry point has a pure-Python fallback).
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+// Decode one unsigned LEB128 varint at buf[i..len).  Returns the number of
+// bytes consumed (0 = truncated, -1 = overlong/>10 bytes).
+inline int read_uvarint(const uint8_t* buf, int64_t i, int64_t len,
+                        uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  for (int k = 0; k < 10; ++k) {
+    if (i + k >= len) return 0;
+    uint8_t b = buf[i + k];
+    // 10th byte may only contribute bit 63: anything else encodes a
+    // value >= 2^64 (overlong — matches the Python decoder's rejection).
+    if (k == 9 && (b & 0x7F) > 1) return -1;
+    v |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return k + 1;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Error codes shared by both entry points.
+enum {
+  DAT_ERR_TRUNCATED = -1,
+  DAT_ERR_CAPACITY = -2,
+  DAT_ERR_BAD_VARINT = -3,
+  DAT_ERR_BAD_RECORD = -4,
+};
+
+// Split a multibuffer stream into frames.
+//
+// Returns the count of complete valid frames (<= cap) and fills, per
+// frame:
+//   starts[f]  byte offset of the payload (after the id byte)
+//   lens[f]    payload length (framed length minus the id byte)
+//   ids[f]     the 1-byte type id (unvalidated; policy lives above)
+// `consumed` gets the offset one past the last complete frame (a partial
+// trailing frame is not an error — streaming callers re-feed the tail).
+// A malformed header (overlong varint / zero framed length) STOPS the
+// scan at that frame: the valid prefix is still returned and `err` gets
+// the error code (0 otherwise), so a streaming caller can deliver the
+// prefix and surface the error at exactly the offending frame — the same
+// observable order as the byte-at-a-time scanner.  Only a capacity
+// overflow (caller bug) is a negative return.
+int64_t dat_split_frames(const uint8_t* buf, int64_t len, int64_t* starts,
+                         int64_t* lens, uint8_t* ids, int64_t cap,
+                         int64_t* consumed, int64_t* err) {
+  int64_t i = 0;
+  int64_t n = 0;
+  *consumed = 0;
+  *err = 0;
+  while (i < len) {
+    uint64_t framed;
+    int used = read_uvarint(buf, i, len, &framed);
+    if (used == 0) break;  // partial header at tail
+    if (used < 0) {
+      *err = DAT_ERR_BAD_VARINT;
+      break;
+    }
+    if (framed == 0) {  // must include the id byte
+      *err = DAT_ERR_BAD_RECORD;
+      break;
+    }
+    // Unsigned compare BEFORE any int64 cast: a hostile length >= 2^63
+    // must not wrap negative and walk the cursor backwards.  Anything
+    // larger than the bytes on hand is a partial tail (streaming callers
+    // re-feed), matching the Python fallback's NeedMoreData behavior.
+    uint64_t remaining = static_cast<uint64_t>(len - i) - used;
+    if (framed > remaining) break;  // partial frame at tail
+    int64_t payload = static_cast<int64_t>(framed) - 1;
+    int64_t frame_end = i + used + 1 + payload;
+    if (n >= cap) return DAT_ERR_CAPACITY;
+    ids[n] = buf[i + used];
+    starts[n] = i + used + 1;
+    lens[n] = payload;
+    ++n;
+    i = frame_end;
+    *consumed = i;
+  }
+  return n;
+}
+
+// Greedy min/max chunk-size pass over sorted candidate byte offsets (the
+// sequential tail of content-defined chunking; ops/rabin.py documents the
+// algorithm).  Writes chunk end-offsets (exclusive), always ending with
+// `length`.  Returns the cut count, or DAT_ERR_CAPACITY.
+int64_t dat_greedy_select(const int64_t* cands, int64_t n, int64_t length,
+                          int64_t min_size, int64_t max_size, int64_t* out,
+                          int64_t cap) {
+  int64_t start = 0, i = 0, m = 0;
+  while (length - start > max_size) {
+    int64_t lo = start + min_size;
+    int64_t hi = start + max_size;
+    while (i < n && cands[i] < lo) ++i;
+    int64_t cut;
+    if (i < n && cands[i] <= hi) {
+      cut = cands[i];
+      ++i;
+    } else {
+      cut = hi;
+    }
+    if (m >= cap) return DAT_ERR_CAPACITY;
+    out[m++] = cut;
+    start = cut;
+  }
+  if (m >= cap) return DAT_ERR_CAPACITY;
+  out[m++] = length;
+  return m;
+}
+
+// Proto2 tags for the Change message (reference: messages/schema.proto:1-8).
+enum {
+  TAG_SUBSET = (1 << 3) | 2,
+  TAG_KEY = (2 << 3) | 2,
+  TAG_CHANGE = (3 << 3) | 0,
+  TAG_FROM = (4 << 3) | 0,
+  TAG_TO = (5 << 3) | 0,
+  TAG_VALUE = (6 << 3) | 2,
+};
+
+// Decode n Change payloads into columnar arrays.
+//
+// Absent optional fields get len -1 (host maps to ''/b'').  Unknown fields
+// are skipped per proto2.  Returns 0, or a negative error with err_index
+// set to the offending record.
+int64_t dat_decode_changes(const uint8_t* buf, const int64_t* starts,
+                           const int64_t* lens, int64_t n, uint32_t* change,
+                           uint32_t* from_v, uint32_t* to_v, int64_t* key_off,
+                           int64_t* key_len, int64_t* sub_off,
+                           int64_t* sub_len, int64_t* val_off,
+                           int64_t* val_len, int64_t* err_index) {
+  for (int64_t r = 0; r < n; ++r) {
+    int64_t i = starts[r];
+    const int64_t end = i + lens[r];
+    bool has_key = false, has_change = false, has_from = false, has_to = false;
+    sub_len[r] = -1;
+    val_len[r] = -1;
+    sub_off[r] = 0;
+    val_off[r] = 0;
+    while (i < end) {
+      uint64_t tag;
+      int used = read_uvarint(buf, i, end, &tag);
+      if (used <= 0) goto bad;
+      i += used;
+      switch (tag & 7) {
+        case 0: {  // varint
+          uint64_t v;
+          used = read_uvarint(buf, i, end, &v);
+          if (used <= 0) goto bad;
+          i += used;
+          if (tag == TAG_CHANGE) {
+            change[r] = static_cast<uint32_t>(v);
+            has_change = true;
+          } else if (tag == TAG_FROM) {
+            from_v[r] = static_cast<uint32_t>(v);
+            has_from = true;
+          } else if (tag == TAG_TO) {
+            to_v[r] = static_cast<uint32_t>(v);
+            has_to = true;
+          }
+          break;
+        }
+        case 2: {  // length-delimited
+          uint64_t ln;
+          used = read_uvarint(buf, i, end, &ln);
+          if (used <= 0) goto bad;
+          i += used;
+          // Unsigned compare before the cast: ln >= 2^63 would go
+          // negative as int64 and slip past the bounds check below.
+          if (ln > static_cast<uint64_t>(end - i)) goto bad;
+          if (tag == TAG_SUBSET) {
+            sub_off[r] = i;
+            sub_len[r] = static_cast<int64_t>(ln);
+          } else if (tag == TAG_KEY) {
+            key_off[r] = i;
+            key_len[r] = static_cast<int64_t>(ln);
+            has_key = true;
+          } else if (tag == TAG_VALUE) {
+            val_off[r] = i;
+            val_len[r] = static_cast<int64_t>(ln);
+          }
+          i += static_cast<int64_t>(ln);
+          break;
+        }
+        case 5:  // fixed32 (unknown field)
+          if (i + 4 > end) goto bad;
+          i += 4;
+          break;
+        case 1:  // fixed64 (unknown field)
+          if (i + 8 > end) goto bad;
+          i += 8;
+          break;
+        default:
+          goto bad;
+      }
+    }
+    if (!has_key || !has_change || !has_from || !has_to) goto bad;
+    continue;
+  bad:
+    *err_index = r;
+    return DAT_ERR_BAD_RECORD;
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+namespace {
+
+inline int uvarint_size(uint64_t v) {
+  int n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    ++n;
+  }
+  return n;
+}
+
+inline int64_t write_uvarint(uint8_t* dst, int64_t i, uint64_t v) {
+  while (v >= 0x80) {
+    dst[i++] = static_cast<uint8_t>(v) | 0x80;
+    v >>= 7;
+  }
+  dst[i++] = static_cast<uint8_t>(v);
+  return i;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bulk-encode n Change records (columnar, offsets into `src`) as framed
+// wire bytes: varint(len+1) | 0x01 | proto payload, fields in ascending
+// field-number order matching the Python encoder (wire/change_codec.py).
+// sub_len/val_len -1 = absent optional.  Returns bytes written into
+// `dst` (capacity `cap`), or DAT_ERR_CAPACITY.
+int64_t dat_encode_changes(const uint8_t* src, int64_t n,
+                           const uint32_t* change, const uint32_t* from_v,
+                           const uint32_t* to_v, const int64_t* key_off,
+                           const int64_t* key_len, const int64_t* sub_off,
+                           const int64_t* sub_len, const int64_t* val_off,
+                           const int64_t* val_len, uint8_t* dst,
+                           int64_t cap) {
+  int64_t w = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    // payload size
+    int64_t psize = 0;
+    if (sub_len[r] >= 0)
+      psize += 1 + uvarint_size(sub_len[r]) + sub_len[r];
+    psize += 1 + uvarint_size(key_len[r]) + key_len[r];
+    psize += 1 + uvarint_size(change[r]);
+    psize += 1 + uvarint_size(from_v[r]);
+    psize += 1 + uvarint_size(to_v[r]);
+    if (val_len[r] >= 0)
+      psize += 1 + uvarint_size(val_len[r]) + val_len[r];
+    int64_t need = uvarint_size(psize + 1) + 1 + psize;
+    if (w + need > cap) return DAT_ERR_CAPACITY;
+    w = write_uvarint(dst, w, psize + 1);
+    dst[w++] = 1;  // TYPE_CHANGE
+    if (sub_len[r] >= 0) {
+      dst[w++] = TAG_SUBSET;
+      w = write_uvarint(dst, w, sub_len[r]);
+      for (int64_t k = 0; k < sub_len[r]; ++k)
+        dst[w + k] = src[sub_off[r] + k];
+      w += sub_len[r];
+    }
+    dst[w++] = TAG_KEY;
+    w = write_uvarint(dst, w, key_len[r]);
+    for (int64_t k = 0; k < key_len[r]; ++k) dst[w + k] = src[key_off[r] + k];
+    w += key_len[r];
+    dst[w++] = TAG_CHANGE;
+    w = write_uvarint(dst, w, change[r]);
+    dst[w++] = TAG_FROM;
+    w = write_uvarint(dst, w, from_v[r]);
+    dst[w++] = TAG_TO;
+    w = write_uvarint(dst, w, to_v[r]);
+    if (val_len[r] >= 0) {
+      dst[w++] = TAG_VALUE;
+      w = write_uvarint(dst, w, val_len[r]);
+      for (int64_t k = 0; k < val_len[r]; ++k)
+        dst[w + k] = src[val_off[r] + k];
+      w += val_len[r];
+    }
+  }
+  return w;
+}
+
+}  // extern "C"

@@ -1,0 +1,26 @@
+"""Device ops: batched hashing, chunking, and tree kernels (JAX/XLA/Pallas)."""
+
+from .blake2b import blake2b_batch, blake2b_packed, digests_to_bytes, pack_payloads
+from .merkle import build_tree, diff_leaves, diff_root_guided, merkle_level
+from .rabin import chunk_stream, gear_candidates_tiled
+from .u64 import add64, mul64, ror64, shl64, shr64, to_pair, xor64
+
+__all__ = [
+    "blake2b_batch",
+    "blake2b_packed",
+    "build_tree",
+    "chunk_stream",
+    "diff_leaves",
+    "diff_root_guided",
+    "gear_candidates_tiled",
+    "merkle_level",
+    "digests_to_bytes",
+    "pack_payloads",
+    "add64",
+    "mul64",
+    "ror64",
+    "shl64",
+    "shr64",
+    "to_pair",
+    "xor64",
+]

@@ -1,0 +1,506 @@
+"""Batched BLAKE2b on device (JAX/XLA, TPU-first).
+
+The reference does no hashing at all; content-addressing lives above it in
+dat core.  The TPU-native framework pulls it into the data plane
+(BASELINE.json north star: "batched BLAKE2b ... thousands of blobs per XLA
+dispatch").  Design:
+
+* 64-bit words are (hi, lo) uint32 lane pairs (:mod:`.u64`) — byte-exact
+  RFC 7693 BLAKE2b without 64-bit integer lanes.
+* The batch dim is the vector dim, in SoA layout: the 16 working-vector
+  lanes are 16 separate (hi, lo) pairs of ``(B,)`` vectors, selected by
+  Python indexing.  Every 64-bit op is a full-width elementwise VPU op
+  over all B items; there are no gathers or dynamic-update-slices in the
+  round function.  The 12 rounds are Python-unrolled (static) so XLA sees
+  one straight fused elementwise pipeline per block.
+* Variable lengths inside one padded batch: a `lax.scan` over the padded
+  block axis with per-item ``active`` / ``final`` masks and byte counters —
+  no data-dependent shapes, no recompiles across batches of the same padded
+  shape.
+* Host edge: :func:`blake2b_batch` packs ``list[bytes]`` into padded uint32
+  arrays (bucketed by power-of-two block count to bound padding waste and
+  compile count) and unpacks digests, preserving submit order — the
+  completion-queue contract the session backend relies on
+  (reference semantics: decode.js:87-99 pending accounting).
+
+Per-item payloads are limited to < 2 GiB (byte counters carried in uint32;
+larger streams go through the Rabin chunker first, mirroring the
+reference's "blobs are streamed, never materialized" discipline,
+reference: README.md:73).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .u64 import U32, add64, add64_3, ror64
+
+DIGEST_SIZE = 32  # BLAKE2b-256 default, dat's content-hash size
+BLOCK_BYTES = 128
+
+_IV = (
+    0x6A09E667F3BCC908,
+    0xBB67AE8584CAA73B,
+    0x3C6EF372FE94F82B,
+    0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1,
+    0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B,
+    0x5BE0CD19137E2179,
+)
+_IV_HI = np.array([w >> 32 for w in _IV], dtype=np.uint32)
+_IV_LO = np.array([w & 0xFFFFFFFF for w in _IV], dtype=np.uint32)
+
+_SIGMA = np.array(
+    [
+        [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
+        [14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3],
+        [11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4],
+        [7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8],
+        [9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13],
+        [2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9],
+        [12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11],
+        [13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10],
+        [6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5],
+        [10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0],
+    ],
+    dtype=np.int32,
+)
+# rounds 10, 11 reuse schedules 0, 1
+_ROUND_SIGMA = [_SIGMA[r % 10] for r in range(12)]
+
+# the 8 G applications per round: (a, b, c, d) working-vector lane indices,
+# columns then diagonals (RFC 7693 §3.2)
+_G_LANES = (
+    (0, 4, 8, 12),
+    (1, 5, 9, 13),
+    (2, 6, 10, 14),
+    (3, 7, 11, 15),
+    (0, 5, 10, 15),
+    (1, 6, 11, 12),
+    (2, 7, 8, 13),
+    (3, 4, 9, 14),
+)
+
+
+def _g(v, a, b, c, d, x, y):
+    """One G mix on SoA state: ``v`` is a list of 16 (hi, lo) pairs of (B,)
+    vectors; lane selection is Python indexing, so the whole mix lowers to
+    full-width elementwise VPU ops — no gathers, no dynamic-update-slices.
+    (The earlier (B, 16) array-of-struct layout spent its time in per-lane
+    scatter updates and 16-wide minor-dim padding; SoA is ~3 orders of
+    magnitude faster on the VPU.)
+    """
+    (ah, al), (bh, bl), (ch, cl), (dh, dl) = v[a], v[b], v[c], v[d]
+    xh, xl = x
+    yh, yl = y
+
+    ah, al = add64_3(ah, al, bh, bl, xh, xl)
+    dh, dl = ror64(dh ^ ah, dl ^ al, 32)
+    ch, cl = add64(ch, cl, dh, dl)
+    bh, bl = ror64(bh ^ ch, bl ^ cl, 24)
+    ah, al = add64_3(ah, al, bh, bl, yh, yl)
+    dh, dl = ror64(dh ^ ah, dl ^ al, 16)
+    ch, cl = add64(ch, cl, dh, dl)
+    bh, bl = ror64(bh ^ ch, bl ^ cl, 63)
+
+    v[a], v[b], v[c], v[d] = (ah, al), (bh, bl), (ch, cl), (dh, dl)
+
+
+def _rounds_unrolled(v, m):
+    """All 12 rounds Python-unrolled: one straight ~5k-op elementwise DAG.
+
+    Best runtime on TPU (XLA fuses the whole chain, zero loop or gather
+    overhead) but pathological to *compile* on the CPU backend's LLVM
+    pipeline — hence the scanned variant below for host runs.
+    """
+    for sigma in _ROUND_SIGMA:
+        for gi, (a, b, c, d) in enumerate(_G_LANES):
+            _g(v, a, b, c, d, m[sigma[2 * gi]], m[sigma[2 * gi + 1]])
+    return v
+
+
+def _rounds_scanned(v, m, sigma=None):
+    """The 12 rounds as a lax.scan with runtime sigma gathers.
+
+    ~12x smaller HLO than the unrolled form: the body is one round (8 G
+    mixes) and the per-round message schedule is a 16-row gather from the
+    stacked message words.  Used on the CPU backend where compile time,
+    not VPU throughput, is the binding constraint (tests, virtual-mesh
+    dry runs).  ``sigma`` overrides the (12, 16) schedule table — pallas
+    kernels must pass it in as an input (no closure constants allowed).
+    """
+    vh = jnp.stack([p[0] for p in v])
+    vl = jnp.stack([p[1] for p in v])
+    mh = jnp.stack([p[0] for p in m])
+    ml = jnp.stack([p[1] for p in m])
+    sig = jnp.asarray(np.stack(_ROUND_SIGMA)) if sigma is None else sigma
+
+    def round_body(carry, sig_r):
+        vh, vl = carry
+        xh = jnp.take(mh, sig_r, axis=0)
+        xl = jnp.take(ml, sig_r, axis=0)
+        vv = [(vh[i], vl[i]) for i in range(16)]
+        for gi, (a, b, c, d) in enumerate(_G_LANES):
+            _g(vv, a, b, c, d, (xh[2 * gi], xl[2 * gi]), (xh[2 * gi + 1], xl[2 * gi + 1]))
+        return (
+            jnp.stack([p[0] for p in vv]),
+            jnp.stack([p[1] for p in vv]),
+        ), None
+
+    (vh, vl), _ = jax.lax.scan(round_body, (vh, vl), sig)
+    return [(vh[i], vl[i]) for i in range(16)]
+
+
+def compress_soa(h, m, t_lo, is_final, unroll: bool | None = None, sigma=None,
+                 t_hi=None):
+    """One BLAKE2b compression in SoA layout.
+
+    ``h``: list of 8 (hi, lo) pairs of (B,) uint32 vectors; ``m``: list of
+    16 such pairs (message words); ``t_lo``: (B,) uint32 byte counter after
+    this block; ``t_hi``: optional (B,) high counter word for streams past
+    4 GiB (None = zero, the single-dispatch case); ``is_final``: (B,) bool
+    last-block flags.  Returns the new h.
+
+    ``unroll=None`` picks per backend: unrolled rounds on accelerators,
+    scanned rounds on CPU (see the two round helpers).  Both are
+    byte-exact RFC 7693.
+    """
+    if unroll is None:
+        unroll = jax.default_backend() != "cpu"
+    shape = t_lo.shape  # any batch shape: (B,) under scan, (8, B/8) in pallas
+    iv = [
+        (jnp.full(shape, _IV_HI[i], U32), jnp.full(shape, _IV_LO[i], U32))
+        for i in range(8)
+    ]
+    v = list(h) + iv
+    v12_hi = v[12][0] if t_hi is None else v[12][0] ^ t_hi
+    v[12] = (v12_hi, v[12][1] ^ t_lo)
+    f = jnp.where(is_final, U32(0xFFFFFFFF), U32(0))
+    v[14] = (v[14][0] ^ f, v[14][1] ^ f)
+
+    v = _rounds_unrolled(v, m) if unroll else _rounds_scanned(v, m, sigma)
+
+    return [
+        (hh ^ v[i][0] ^ v[i + 8][0], hl ^ v[i][1] ^ v[i + 8][1])
+        for i, (hh, hl) in enumerate(h)
+    ]
+
+
+def compress(hh, hl, mh, ml, t_lo, is_final, unroll: bool | None = None):
+    """Array-of-struct wrapper over :func:`compress_soa`.
+
+    state (B, 8) hi/lo pairs, block (B, 16) pairs — the layout the packers
+    and the Merkle level op exchange.  Unpacking to SoA costs 24 strided
+    slices + 2 stacks per block, negligible against the ~4k elementwise ops
+    of the 12 rounds.
+    """
+    h = [(hh[:, i], hl[:, i]) for i in range(8)]
+    m = [(mh[:, i], ml[:, i]) for i in range(16)]
+    h = compress_soa(h, m, t_lo, is_final, unroll=unroll)
+    return (
+        jnp.stack([p[0] for p in h], axis=1),
+        jnp.stack([p[1] for p in h], axis=1),
+    )
+
+
+def initial_state(batch: int, digest_size: int = DIGEST_SIZE):
+    """h0 = IV ^ parameter block (sequential mode, no key)."""
+    hh = jnp.broadcast_to(jnp.asarray(_IV_HI), (batch, 8))
+    hl = jnp.broadcast_to(jnp.asarray(_IV_LO), (batch, 8))
+    param_lo = U32(0x01010000 ^ digest_size)  # digest | key<<8 | fanout | depth
+    hl = hl.at[:, 0].set(hl[:, 0] ^ param_lo)
+    return hh, hl
+
+
+@functools.partial(jax.jit, static_argnames=("digest_size",))
+def blake2b_packed(mh, ml, lengths, digest_size: int = DIGEST_SIZE):
+    """Hash a padded batch: mh/ml (B, nblocks, 16) uint32, lengths (B,).
+
+    Padding bytes in the final partial block MUST be zero (the host packer
+    guarantees this).  Returns digest words as (hh, hl), each (B, 8).
+    """
+    B, nblocks, _ = mh.shape
+    hh, hl = initial_state(B, digest_size)
+    lengths = lengths.astype(U32)
+    # ceil(len/128), minimum 1: an empty message still compresses one block
+    item_blocks = jnp.maximum((lengths + U32(127)) >> U32(7), U32(1))
+
+    # carry in SoA layout — 16 flat (B,) vectors — so the scan body is a
+    # pure elementwise DAG with no per-block stack/unstack
+    carry0 = tuple(hh[:, i] for i in range(8)) + tuple(hl[:, i] for i in range(8))
+
+    # message words to (nblocks, 16, B): each word a contiguous (B,) row in
+    # the lane dim (the (B, 16) minor-dim layout pads 16 -> 128 lanes and
+    # turns every per-word slice into a strided read)
+    mh = jnp.transpose(mh, (1, 2, 0))
+    ml = jnp.transpose(ml, (1, 2, 0))
+
+    def step(carry, xs):
+        h = [(carry[i], carry[i + 8]) for i in range(8)]
+        bmh, bml, k = xs
+        m = [(bmh[i], bml[i]) for i in range(16)]
+        active = k < item_blocks
+        final = k == item_blocks - U32(1)
+        t_lo = jnp.minimum(lengths, (k + U32(1)) << U32(7))
+        nh = compress_soa(h, m, t_lo, final)
+        out = tuple(
+            jnp.where(active, nh[i][0], h[i][0]) for i in range(8)
+        ) + tuple(jnp.where(active, nh[i][1], h[i][1]) for i in range(8))
+        return out, None
+
+    ks = jnp.arange(nblocks, dtype=jnp.uint32)
+    carry, _ = jax.lax.scan(step, carry0, (mh, ml, ks))
+    return jnp.stack(carry[:8], axis=1), jnp.stack(carry[8:], axis=1)
+
+
+@jax.jit
+def blake2b_update(hh, hl, t_hi, t_lo, mh, ml, seg_lengths, is_last):
+    """Advance chaining states over one packed segment per item.
+
+    The resumable core of streaming hashing: a message is split into
+    segments dispatched one at a time, so a blob of any size is hashed in
+    bounded device memory — the device-scale analogue of the reference's
+    "blobs are streamed, never materialized" (reference: README.md:73).
+
+    ``hh``/``hl``: (B, 8) chaining state; ``t_hi``/``t_lo``: (B,) uint32
+    pair = bytes already compressed (a multiple of 128 per RFC 7693
+    block chaining); ``mh``/``ml``: (B, nblocks, 16) packed segment
+    words; ``seg_lengths``: (B,) bytes in this segment — non-final
+    segments must be full-block multiples; ``is_last``: (B,) bool.
+
+    Returns ``(hh, hl, t_hi, t_lo)`` advanced past the segment.  The
+    empty-message case (zero-length last segment with zero counter)
+    compresses the mandatory single zero block.
+    """
+    B, nblocks, _ = mh.shape
+    seg_lengths = seg_lengths.astype(U32)
+    is_last = is_last.astype(bool)
+    raw_blocks = (seg_lengths + U32(127)) >> U32(7)
+    t_zero = (t_hi == U32(0)) & (t_lo == U32(0))
+    item_blocks = jnp.where(
+        is_last & (raw_blocks == U32(0)) & t_zero, U32(1), raw_blocks
+    )
+
+    carry0 = tuple(hh[:, i] for i in range(8)) + tuple(hl[:, i] for i in range(8))
+    mh_t = jnp.transpose(mh, (1, 2, 0))
+    ml_t = jnp.transpose(ml, (1, 2, 0))
+
+    def step(carry, xs):
+        h = [(carry[i], carry[i + 8]) for i in range(8)]
+        bmh, bml, k = xs
+        m = [(bmh[i], bml[i]) for i in range(16)]
+        active = k < item_blocks
+        final = is_last & (k == item_blocks - U32(1))
+        inc = jnp.minimum(seg_lengths, (k + U32(1)) << U32(7))
+        bt_hi, bt_lo = add64(t_hi, t_lo, jnp.zeros_like(inc), inc)
+        nh = compress_soa(h, m, bt_lo, final, t_hi=bt_hi)
+        out = tuple(
+            jnp.where(active, nh[i][0], h[i][0]) for i in range(8)
+        ) + tuple(jnp.where(active, nh[i][1], h[i][1]) for i in range(8))
+        return out, None
+
+    ks = jnp.arange(nblocks, dtype=jnp.uint32)
+    carry, _ = jax.lax.scan(step, carry0, (mh_t, ml_t, ks))
+    nt_hi, nt_lo = add64(t_hi, t_lo, jnp.zeros_like(seg_lengths), seg_lengths)
+    return (
+        jnp.stack(carry[:8], axis=1),
+        jnp.stack(carry[8:], axis=1),
+        nt_hi,
+        nt_lo,
+    )
+
+
+class Blake2bStream:
+    """Incremental BLAKE2b over bounded device dispatches (one stream).
+
+    ``update(bytes)`` buffers until a full segment is available, then
+    advances the on-device (h, t) chaining state via
+    :func:`blake2b_update`; ``digest()`` flushes the tail.  Peak host
+    memory is O(segment_bytes) regardless of stream length, and the
+    64-bit byte counter supports streams past 4 GiB — this removes the
+    session backend's whole-blob host buffering and the < 2 GiB item cap.
+
+    Middle segments all share one padded shape (one XLA compile); the
+    final partial segment is bucketed to a power-of-two block count.
+    """
+
+    def __init__(self, digest_size: int = DIGEST_SIZE,
+                 segment_bytes: int = 1 << 22, max_inflight: int = 2):
+        if segment_bytes % BLOCK_BYTES:
+            raise ValueError(f"segment_bytes must be a multiple of {BLOCK_BYTES}")
+        self._digest_size = digest_size
+        self._seg = segment_bytes
+        self._max_inflight = max(1, max_inflight)
+        self._since_barrier = 0
+        hh, hl = initial_state(1, digest_size)
+        z = jnp.zeros((1,), U32)
+        self._state = (hh, hl, z, z)
+        self._pending = bytearray()
+        self._digest: bytes | None = None
+        self.length = 0
+
+    def update(self, data) -> "Blake2bStream":
+        if self._digest is not None:
+            raise RuntimeError("update() after digest()")
+        self._pending += bytes(data)
+        self.length += len(data)
+        # strictly '>' — the final block must go out WITH the final flag,
+        # so when pending lands exactly on a segment boundary it is held
+        # for digest() (an empty non-final segment can't set the flag)
+        while len(self._pending) > self._seg:
+            seg = bytes(self._pending[: self._seg])
+            del self._pending[: self._seg]
+            self._advance(seg, last=False)
+        return self
+
+    def _advance(self, seg: bytes, last: bool) -> None:
+        hh, hl, thi, tlo = self._state
+        nblocks = max(1, -(-len(seg) // BLOCK_BYTES))
+        if last:
+            nblocks = _bucket_nblocks(nblocks)  # bound tail-shape compiles
+        mh, ml, lengths = pack_payloads([seg], nblocks=nblocks)
+        self._state = blake2b_update(
+            hh, hl, thi, tlo,
+            jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths),
+            jnp.asarray([last]),
+        )
+        # bounded async dispatch: without a periodic barrier the host can
+        # outrun the device and queue every segment's message arrays in
+        # RAM — the O(chunk) discipline would silently become O(blob).
+        # Fetching the (tiny) counter word is the completion barrier that
+        # works on platforms where block_until_ready returns early.
+        self._since_barrier += 1
+        if self._since_barrier >= self._max_inflight:
+            np.asarray(self._state[3])
+            self._since_barrier = 0
+
+    def digest(self) -> bytes:
+        if self._digest is None:
+            self._advance(bytes(self._pending), last=True)
+            self._pending.clear()
+            hh, hl, _, _ = self._state
+            self._digest = digests_to_bytes(hh, hl, self._digest_size)[0]
+        return self._digest
+
+
+# ---------------------------------------------------------------------------
+# host edge: bytes <-> padded uint32 batches
+# ---------------------------------------------------------------------------
+
+
+def pack_payloads(payloads, nblocks: int | None = None):
+    """Pack byte strings into padded (B, nblocks, 16) hi/lo uint32 arrays.
+
+    Little-endian 64-bit message words: u32-word index 2k is word k's low
+    half, 2k+1 its high half.  Zero padding satisfies the blake2b_packed
+    contract.
+    """
+    B = len(payloads)
+    max_len = max((len(p) for p in payloads), default=0)
+    need = max(1, -(-max_len // BLOCK_BYTES))
+    if nblocks is None:
+        nblocks = need
+    elif nblocks < need:
+        raise ValueError(f"nblocks={nblocks} < required {need}")
+    buf = np.zeros((B, nblocks * BLOCK_BYTES), dtype=np.uint8)
+    lengths = np.empty((B,), dtype=np.uint32)
+    for i, p in enumerate(payloads):
+        if len(p) >= 1 << 31:
+            raise ValueError("per-item payload limit is < 2 GiB; chunk first")
+        buf[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+        lengths[i] = len(p)
+    words = buf.view("<u4").reshape(B, nblocks, 32)
+    return words[:, :, 1::2].copy(), words[:, :, 0::2].copy(), lengths
+
+
+def digests_to_bytes(hh, hl, digest_size: int = DIGEST_SIZE) -> list[bytes]:
+    """Interleave (hi, lo) word pairs back into little-endian digest bytes."""
+    hh = np.asarray(hh, dtype=np.uint32)
+    hl = np.asarray(hl, dtype=np.uint32)
+    B = hh.shape[0]
+    out = np.empty((B, 16), dtype=np.uint32)
+    out[:, 0::2] = hl
+    out[:, 1::2] = hh
+    raw = out.astype("<u4").view(np.uint8).reshape(B, 64)
+    return [raw[i, :digest_size].tobytes() for i in range(B)]
+
+
+def _bucket_nblocks(n: int) -> int:
+    """Round a block count up to a power of two to bound compile count."""
+    from ..utils.num import next_pow2
+
+    return next_pow2(n)
+
+
+# below this bucket size the pallas kernel's pad-to-1024-items overhead
+# outweighs its throughput edge over the XLA-scan path
+_PALLAS_MIN_ITEMS = 512
+
+
+def blake2b_batch_begin(
+    payloads, digest_size: int = DIGEST_SIZE, use_pallas: bool | None = None
+):
+    """Dispatch batched hashing; return a zero-arg ``collect()`` closure.
+
+    JAX dispatch is asynchronous: the device starts compressing as soon
+    as this returns, while the host goes back to parsing.  ``collect()``
+    blocks on the transfers and yields digests in submit order — the
+    split the async DigestPipeline uses to overlap parse and hash.
+
+    Items are grouped into power-of-two block-count buckets; each bucket
+    is one padded XLA dispatch.  ``use_pallas=None`` selects, per bucket,
+    the Pallas kernel on TPU backends when the bucket is large enough to
+    amortize its 1024-item tile padding, and the portable XLA-scan path
+    otherwise.
+    """
+    on_tpu = jax.default_backend() == "tpu"
+    buckets: dict[int, list[int]] = {}
+    for i, p in enumerate(payloads):
+        nb = _bucket_nblocks(max(1, -(-len(p) // BLOCK_BYTES)))
+        buckets.setdefault(nb, []).append(i)
+    handles = []
+    for nb, idxs in buckets.items():
+        pallas_bucket = (
+            use_pallas
+            if use_pallas is not None
+            else on_tpu and len(idxs) >= _PALLAS_MIN_ITEMS
+        )
+        if pallas_bucket:
+            from .blake2b_pallas import blake2b_packed_pallas as packed_fn
+        else:
+            packed_fn = blake2b_packed
+        # pad the batch axis to a power of two as well: jit specializes
+        # per (B, nblocks), so unbucketed batch sizes recompile every
+        # distinct count (minutes each on the CPU scanned path).  Empty
+        # payloads are valid; their digests are dropped in collect().
+        batch = [payloads[i] for i in idxs]
+        Bp = _bucket_nblocks(len(batch))
+        batch += [b""] * (Bp - len(batch))
+        mh, ml, lengths = pack_payloads(batch, nblocks=nb)
+        hh, hl = packed_fn(
+            jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths), digest_size
+        )
+        handles.append((idxs, hh[: len(idxs)], hl[: len(idxs)]))
+
+    def collect() -> list[bytes]:
+        out: list[bytes | None] = [None] * len(payloads)
+        for idxs, hh, hl in handles:
+            for i, d in zip(idxs, digests_to_bytes(hh, hl, digest_size)):
+                out[i] = d
+        return out  # type: ignore[return-value]
+
+    return collect
+
+
+def blake2b_batch(
+    payloads, digest_size: int = DIGEST_SIZE, use_pallas: bool | None = None
+) -> list[bytes]:
+    """Hash a list of byte strings on device; digests in submit order."""
+    if not payloads:
+        return []
+    return blake2b_batch_begin(payloads, digest_size, use_pallas)()

@@ -1,0 +1,224 @@
+"""Batched BLAKE2b as a Pallas TPU kernel.
+
+The XLA-scan formulation in :mod:`.blake2b` leaves VPU throughput on the
+table: every scan step re-materializes carries and message slices through
+fusion boundaries.  This kernel keeps the whole hash state resident in
+VMEM scratch for the lifetime of a batch tile and streams message blocks
+HBM -> VMEM with Pallas's pipelined block fetches, so the 12 unrolled
+rounds run as straight-line VPU code with no per-block traffic beyond the
+message bytes themselves.
+
+Layout (TPU-first):
+
+* Mosaic tiles are (8, 128) for uint32, so the batch axis is reshaped to
+  ``(8, B/8)`` — every 64-bit lane-pair op covers full vector registers.
+* Messages arrive pre-packed as ``(nblocks, 16, 8, B/8)`` hi/lo uint32
+  (word-major), so each of the 16 message words is one contiguous
+  ``(8, BTL)`` tile slice: zero strided reads in the hot loop.
+* Grid = (batch_tiles, nblocks): batch tiles are embarrassingly parallel;
+  the block axis is sequential ("arbitrary") with the chaining state in
+  VMEM scratch, initialized at block 0 and emitted at the last block.
+* Per-item variable lengths use the same active/final masks as the scan
+  version (:func:`.blake2b.blake2b_packed`) — no dynamic shapes.
+
+Round function and masks are shared with :mod:`.blake2b` (they are
+shape-polymorphic), so byte-exactness is inherited from the tested scan
+path.  reference: the protocol itself does no hashing (SURVEY.md §2);
+this kernel serves BASELINE.json's ">= 50 GiB/s batched BLAKE2b" target.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .blake2b import _IV_HI, _IV_LO, DIGEST_SIZE, compress_soa
+from .u64 import U32
+
+# batch items per kernel tile: 8 sublanes x BTL lanes
+_LANE = 128
+_SUBLANE = 8
+
+
+class _RefWords:
+    """Lazy message-word view: ``m[w]`` issues the VMEM loads at use site.
+
+    The unrolled rounds reference each of the 16 message words twice per
+    round; materializing all 32 hi/lo word tiles up front pins 32 vector
+    registers for the whole block, which together with the 32 state
+    registers overflows the register file and makes the scheduler spill
+    *state* (measured: block_items=2048 halves throughput).  Issuing the
+    loads where the schedule consumes them leaves liveness decisions to
+    Mosaic, which can rematerialize a cheap VMEM load instead of
+    spilling a hot value.
+    """
+
+    def __init__(self, mh_ref, ml_ref):
+        self._mh = mh_ref
+        self._ml = ml_ref
+
+    def __getitem__(self, w):
+        w = int(w)
+        return self._mh[0, w], self._ml[0, w]
+
+
+def _kernel(*refs, digest_size: int, unroll: bool = True,
+            msg_loads: bool = False):
+    if unroll:
+        len_ref, mh_ref, ml_ref, outh_ref, outl_ref, sth_ref, stl_ref = refs
+        sigma = None
+    else:
+        # scanned-rounds variant (interpreter): the schedule table rides in
+        # as an input ref — pallas kernels may not capture array constants
+        (len_ref, mh_ref, ml_ref, sig_ref,
+         outh_ref, outl_ref, sth_ref, stl_ref) = refs
+        sigma = sig_ref[:]
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        shape = len_ref.shape
+        param_lo = np.uint32(0x01010000 ^ digest_size)
+        for w in range(8):
+            sth_ref[w] = jnp.full(shape, _IV_HI[w], U32)
+            lo = _IV_LO[w] ^ param_lo if w == 0 else _IV_LO[w]
+            stl_ref[w] = jnp.full(shape, lo, U32)
+
+    lengths = len_ref[:]
+    ju = j.astype(U32)
+    # where-based max/min: Mosaic has no arith.maxui/minui legalization
+    nb_ceil = (lengths + U32(127)) >> U32(7)
+    item_blocks = jnp.where(nb_ceil == U32(0), U32(1), nb_ceil)
+    active = ju < item_blocks
+    final = ju == item_blocks - U32(1)
+    cap = (ju + U32(1)) << U32(7)
+    t_lo = jnp.where(cap < lengths, cap, lengths)
+
+    h = [(sth_ref[w], stl_ref[w]) for w in range(8)]
+    if msg_loads and unroll:
+        m = _RefWords(mh_ref, ml_ref)
+    else:
+        m = [(mh_ref[0, w], ml_ref[0, w]) for w in range(16)]
+    nh = compress_soa(h, m, t_lo, final, unroll=unroll, sigma=sigma)
+    for w in range(8):
+        sth_ref[w] = jnp.where(active, nh[w][0], h[w][0])
+        stl_ref[w] = jnp.where(active, nh[w][1], h[w][1])
+
+    @pl.when(j == nb - 1)
+    def _emit():
+        for w in range(8):
+            outh_ref[w] = sth_ref[w]
+            outl_ref[w] = stl_ref[w]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("digest_size", "block_items", "interpret", "msg_loads"),
+)
+def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
+                   block_items: int = 1024, interpret: bool = False,
+                   msg_loads: bool = True):
+    """Hash in the kernel-native layout.
+
+    ``mh``/``ml``: (nblocks, 16, 8, B/8) uint32 message word halves;
+    ``lengths``: (8, B/8) uint32.  ``B`` must be a multiple of
+    ``block_items`` (and ``block_items`` of 8*128).  Returns digest words
+    as ``(hh, hl)``, each (8, 8, B/8): word-major, batch split like the
+    input.
+    """
+    nb, _, s, bl = mh.shape
+    if s != _SUBLANE:
+        raise ValueError(f"batch must be split (8, B/8); got sublane {s}")
+    if block_items % (_SUBLANE * _LANE):
+        raise ValueError(f"block_items must be a multiple of {_SUBLANE * _LANE}")
+    btl = block_items // _SUBLANE
+    if bl % btl:
+        raise ValueError(f"B/8={bl} not a multiple of tile width {btl}")
+
+    grid = (bl // btl, nb)
+    # Mosaic gets the straight-line unrolled rounds; the interpreter (CPU
+    # tests) gets the scanned rounds, whose 12x-smaller graph sidesteps
+    # the CPU backend's pathological compile of the unrolled chain
+    unroll = not interpret
+    kernel = functools.partial(
+        _kernel, digest_size=digest_size, unroll=unroll, msg_loads=msg_loads
+    )
+    in_specs = [
+        pl.BlockSpec((_SUBLANE, btl), lambda i, j: (0, i)),
+        pl.BlockSpec((1, 16, _SUBLANE, btl), lambda i, j: (j, 0, 0, i)),
+        pl.BlockSpec((1, 16, _SUBLANE, btl), lambda i, j: (j, 0, 0, i)),
+    ]
+    inputs = [lengths, mh, ml]
+    if not unroll:
+        from .blake2b import _ROUND_SIGMA
+
+        in_specs.append(pl.BlockSpec((12, 16), lambda i, j: (0, 0)))
+        inputs.append(jnp.asarray(np.stack(_ROUND_SIGMA)))
+    outh, outl = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((8, _SUBLANE, btl), lambda i, j: (0, 0, i)),
+            pl.BlockSpec((8, _SUBLANE, btl), lambda i, j: (0, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((8, _SUBLANE, bl), jnp.uint32),
+            jax.ShapeDtypeStruct((8, _SUBLANE, bl), jnp.uint32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((8, _SUBLANE, btl), jnp.uint32),
+            pltpu.VMEM((8, _SUBLANE, btl), jnp.uint32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*inputs)
+    return outh, outl
+
+
+def to_native(mh, ml, lengths, block_items: int = 1024):
+    """(B, nblocks, 16) padded-batch layout -> kernel-native layout.
+
+    Pads the batch up to a multiple of ``block_items`` (zero payloads are
+    valid BLAKE2b inputs; the wrapper drops their digests).  Returns
+    (mh_n, ml_n, lengths_n, B).
+    """
+    B, nb, _ = mh.shape
+    Bp = -(-B // block_items) * block_items
+    if Bp != B:
+        mh = jnp.pad(mh, ((0, Bp - B), (0, 0), (0, 0)))
+        ml = jnp.pad(ml, ((0, Bp - B), (0, 0), (0, 0)))
+        lengths = jnp.pad(lengths, (0, Bp - B))
+    mh_n = jnp.transpose(mh, (1, 2, 0)).reshape(nb, 16, _SUBLANE, Bp // _SUBLANE)
+    ml_n = jnp.transpose(ml, (1, 2, 0)).reshape(nb, 16, _SUBLANE, Bp // _SUBLANE)
+    len_n = lengths.reshape(_SUBLANE, Bp // _SUBLANE)
+    return mh_n, ml_n, len_n, B
+
+
+def from_native(outh, outl, B: int):
+    """Kernel-native digest words -> (B, 8) hi/lo (the scan-path layout)."""
+    Bp = outh.shape[1] * outh.shape[2]
+    hh = outh.reshape(8, Bp).T[:B]
+    hl = outl.reshape(8, Bp).T[:B]
+    return hh, hl
+
+
+def blake2b_packed_pallas(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
+                          block_items: int = 1024, interpret: bool = False):
+    """Drop-in for :func:`.blake2b.blake2b_packed`, Pallas-accelerated.
+
+    Same (B, nblocks, 16) interface and (B, 8) hi/lo digest outputs.
+    """
+    mh_n, ml_n, len_n, B = to_native(mh, ml, lengths, block_items)
+    outh, outl = blake2b_native(
+        mh_n, ml_n, len_n, digest_size, block_items, interpret
+    )
+    return from_native(outh, outl, B)

@@ -1,0 +1,112 @@
+"""64-bit word arithmetic as (hi, lo) uint32 lane pairs.
+
+TPUs have no native 64-bit integer lanes; every 64-bit quantity in the device
+kernels (BLAKE2b state words, gear-hash accumulators, Merkle node words) is
+represented as a pair of uint32 arrays ``(hi, lo)``.  All helpers are shape-
+polymorphic elementwise ops, so they vectorize over arbitrary batch dims and
+fuse under jit.  This is the "lane-pair emulation" SURVEY.md §7 names as a
+hard part of byte-exact BLAKE2b on TPU.
+
+The reference has no analogue (pure JS, no hashing); these ops exist to serve
+the framework's device data plane (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+MASK32 = jnp.uint32(0xFFFFFFFF)
+
+
+def add64(ah, al, bh, bl):
+    """(ah,al) + (bh,bl) mod 2**64. uint32 addition wraps, carry = lo < al."""
+    lo = al + bl
+    carry = (lo < al).astype(U32)
+    hi = ah + bh + carry
+    return hi, lo
+
+
+def add64_3(ah, al, bh, bl, ch, cl):
+    """Three-way 64-bit add (the BLAKE2b G step `a = a + b + x`)."""
+    hi, lo = add64(ah, al, bh, bl)
+    return add64(hi, lo, ch, cl)
+
+
+def xor64(ah, al, bh, bl):
+    return ah ^ bh, al ^ bl
+
+
+def ror64(hi, lo, r: int):
+    """Rotate right by a static amount r in [1, 63].
+
+    r == 32 is a pure hi/lo swap; r < 32 and r > 32 are the two shifted
+    cross-lane blends.  r is a Python int so each case compiles to a fixed
+    pair of shifts — no data-dependent control flow under jit.
+    """
+    r = int(r) % 64
+    if r == 0:
+        return hi, lo
+    if r == 32:
+        return lo, hi
+    if r < 32:
+        s, t = U32(r), U32(32 - r)
+        new_lo = (lo >> s) | (hi << t)
+        new_hi = (hi >> s) | (lo << t)
+        return new_hi, new_lo
+    # r > 32: rotate by 32 (swap) then by r - 32
+    return ror64(lo, hi, r - 32)
+
+
+def shl64(hi, lo, s: int):
+    """Logical shift left by static s in [0, 63]."""
+    s = int(s)
+    if s == 0:
+        return hi, lo
+    if s >= 32:
+        return (lo << U32(s - 32)) if s > 32 else lo, jnp.zeros_like(lo)
+    return (hi << U32(s)) | (lo >> U32(32 - s)), lo << U32(s)
+
+
+def shr64(hi, lo, s: int):
+    """Logical shift right by static s in [0, 63]."""
+    s = int(s)
+    if s == 0:
+        return hi, lo
+    if s >= 32:
+        return jnp.zeros_like(hi), (hi >> U32(s - 32)) if s > 32 else hi
+    return hi >> U32(s), (lo >> U32(s)) | (hi << U32(32 - s))
+
+
+def mul64(ah, al, bh, bl):
+    """(a * b) mod 2**64 via 16-bit limb products (no 64-bit multiply lanes).
+
+    Splits each 32-bit lane into 16-bit halves so every partial product fits
+    in uint32 without losing carries; used by the gear/Rabin rolling-hash
+    scan combiner.
+    """
+    a0, a1 = al & U32(0xFFFF), al >> U32(16)
+    b0, b1 = bl & U32(0xFFFF), bl >> U32(16)
+
+    # low 32x32 -> 64 product of al * bl
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+
+    mid = p01 + p10  # may wrap: track its carry into the high word
+    mid_carry = (mid < p01).astype(U32) << U32(16)
+
+    lo = p00 + (mid << U32(16))
+    lo_carry = (lo < p00).astype(U32)
+    hi = p11 + (mid >> U32(16)) + mid_carry + lo_carry
+
+    # cross terms only affect the high 32 bits (mod 2**64)
+    hi = hi + al * bh + ah * bl
+    return hi, lo
+
+
+def to_pair(x: int):
+    """Split a Python int into (hi, lo) uint32 scalars."""
+    x = int(x) & 0xFFFFFFFFFFFFFFFF
+    return U32(x >> 32), U32(x & 0xFFFFFFFF)

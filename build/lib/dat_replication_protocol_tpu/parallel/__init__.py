@@ -1,0 +1,23 @@
+"""Multi-chip parallelism: mesh construction + sharded data-plane steps."""
+
+from .cdc_mesh import sharded_gear_scan
+from .mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    digest_root_step,
+    make_mesh,
+    pad_batch,
+    replicated,
+    sharded_diff,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "batch_sharding",
+    "digest_root_step",
+    "make_mesh",
+    "pad_batch",
+    "replicated",
+    "sharded_diff",
+    "sharded_gear_scan",
+]

@@ -1,0 +1,23 @@
+"""Host runtime: native (C++) parsing loops, change-log replay engine,
+and the composed content-addressing pipeline."""
+
+from .content import ContentSummary, content_address, delta, reassemble
+from .replay import (
+    ChangeColumns,
+    FrameIndex,
+    decode_change_columns,
+    replay_log,
+    split_frames,
+)
+
+__all__ = [
+    "ChangeColumns",
+    "ContentSummary",
+    "FrameIndex",
+    "content_address",
+    "decode_change_columns",
+    "delta",
+    "reassemble",
+    "replay_log",
+    "split_frames",
+]

@@ -1,0 +1,157 @@
+"""Content addressing: chunk -> hash -> Merkle root, and version deltas.
+
+The reference moves *already content-addressed* data — dat core above it
+chunks blobs, hashes chunks, and exchanges only missing pieces; the wire
+protocol's ``Change.value``/blob frames carry the results (reference:
+README.md:73, messages/schema.proto:6).  This module composes the
+framework's device pipeline into that exact workflow as one API:
+
+* :func:`content_address` — CDC cut a byte stream
+  (:func:`..ops.rabin.chunk_stream`), BLAKE2b every chunk in batched
+  device dispatches (:func:`..batch.feed.hash_extents`), fold the chunk
+  digests to a Merkle root (:mod:`..ops.merkle`).
+* :func:`delta` — the transfer set between two versions of a blob: chunks
+  of ``new`` whose digests ``old`` does not hold.  Because the cuts are
+  content-defined, an insertion/deletion reshuffles only the chunks it
+  touches — the delta stays O(edit), not O(blob), which is the entire
+  point of CDC dedup.
+
+Everything heavy runs on device; the host sees cut offsets, digests, and
+the root.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def _extents_from_cuts(cuts) -> tuple[np.ndarray, np.ndarray]:
+    """Chunk end-offsets -> (offsets, lengths); single owner of the
+    exclusive-ends convention."""
+    ends = np.asarray(cuts, dtype=np.int64)
+    offs = np.concatenate([np.zeros(1, np.int64), ends[:-1]])
+    return offs, ends - offs
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ContentSummary:
+    """One blob version's content-addressed identity.
+
+    ``cuts``: chunk end-offsets (exclusive, ascending, last == length);
+    ``digests``: (nchunks, 32) uint8 BLAKE2b-256 per chunk, in order;
+    ``root``: 32-byte Merkle root over the chunk digests (zero-padded to
+    a power of two, so equal content always folds to an equal root).
+
+    Equality/hash use the identity triple (length, cuts, root) — the
+    dataclass defaults would tuple-compare the ndarray field, which
+    raises; the root already commits to every digest.
+    """
+
+    length: int
+    cuts: list[int]
+    digests: np.ndarray
+    root: bytes
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, ContentSummary):
+            return NotImplemented
+        return (self.length == other.length and self.cuts == other.cuts
+                and self.root == other.root)
+
+    def __hash__(self) -> int:
+        return hash((self.length, tuple(self.cuts), self.root))
+
+    @property
+    def nchunks(self) -> int:
+        return len(self.cuts)
+
+    def extents(self) -> tuple[np.ndarray, np.ndarray]:
+        """(offsets, lengths) arrays of the chunks."""
+        return _extents_from_cuts(self.cuts)
+
+
+def content_address(data, avg_bits: int = 13,
+                    min_size: int | None = None,
+                    max_size: int | None = None) -> ContentSummary:
+    """Chunk, hash, and root a byte stream on device.
+
+    ``data``: bytes or uint8 array.  Empty input has zero chunks and the
+    all-zero root (the empty-subtree sentinel of
+    :func:`..ops.merkle.pad_leaves`).
+    """
+    from ..batch.feed import hash_extents_device
+    from ..ops import merkle
+    from ..ops.rabin import chunk_stream
+
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)
+    ) else np.asarray(data, dtype=np.uint8)
+    if buf.size == 0:
+        return ContentSummary(0, [], np.empty((0, 32), np.uint8), b"\0" * 32)
+    cuts = chunk_stream(buf, avg_bits, min_size, max_size)
+    offs, lens = _extents_from_cuts(cuts)
+    # digests stay in HBM through the tree fold; the host copy is one
+    # interleave off the same device arrays (no fetch-then-reupload)
+    hh, hl = hash_extents_device(buf, offs, lens)
+    (root_bytes,) = merkle.digests_from_device(
+        *merkle.root(*merkle.pad_leaves(hh, hl))
+    )
+    n = len(cuts)
+    raw = np.empty((n, 8), dtype="<u4")
+    raw[:, 0::2] = np.asarray(hl)
+    raw[:, 1::2] = np.asarray(hh)
+    digests = raw.view(np.uint8).reshape(n, 32)
+    return ContentSummary(int(buf.size), list(map(int, cuts)), digests,
+                          root_bytes)
+
+
+def delta(old: ContentSummary, new: ContentSummary) -> list[int]:
+    """Chunk indices of ``new`` that ``old`` cannot supply.
+
+    The sender ships exactly these chunks (plus the cut table); the
+    receiver reassembles everything else from chunks it already holds —
+    dat's dedup exchange, here decided by digest set membership.  Equal
+    roots short-circuit to an empty delta.
+    """
+    if old.root == new.root and old.cuts == new.cuts:
+        return []
+    have = {old.digests[i].tobytes() for i in range(old.nchunks)}
+    return [
+        i for i in range(new.nchunks)
+        if new.digests[i].tobytes() not in have
+    ]
+
+
+def reassemble(new: ContentSummary, old_data,
+               old: ContentSummary, sent: dict[int, bytes]) -> bytes:
+    """Receiver-side reconstruction: old chunks + the delta -> new bytes.
+
+    ``sent`` maps chunk index -> bytes for every index in
+    ``delta(old, new)``.  Raises ``KeyError`` if a needed chunk is
+    neither held nor sent, ``ValueError`` if a supplied chunk's digest
+    does not match the summary (corruption check — digests are the
+    addresses, so verification is free).
+    """
+    import hashlib
+
+    old_buf = np.frombuffer(old_data, dtype=np.uint8) if isinstance(
+        old_data, (bytes, bytearray, memoryview)
+    ) else np.asarray(old_data, dtype=np.uint8)
+    by_digest: dict[bytes, tuple[int, int]] = {}
+    o_offs, o_lens = old.extents()
+    for i in range(old.nchunks):
+        by_digest[old.digests[i].tobytes()] = (int(o_offs[i]), int(o_lens[i]))
+    out = bytearray()
+    for i in range(new.nchunks):
+        d = new.digests[i].tobytes()
+        if i in sent:
+            piece = sent[i]
+            if hashlib.blake2b(piece, digest_size=32).digest() != d:
+                raise ValueError(f"chunk {i} digest mismatch")
+        else:
+            off, ln = by_digest[d]
+            piece = old_buf[off:off + ln].tobytes()
+        out += piece
+    return bytes(out)

@@ -1,0 +1,117 @@
+"""On-demand build + ctypes bindings for the native runtime.
+
+The image has a C++ toolchain but no pybind11 (and nothing may be pip
+installed), so the native layer is a plain C ABI compiled with g++ on
+first use and loaded via ctypes.  The compiled object is cached next to
+the source keyed by a content hash, so rebuilds only happen when
+``dat_native.cpp`` changes.  Everything degrades gracefully: callers use
+:func:`get_lib` and fall back to pure Python when it returns ``None``
+(no toolchain, read-only filesystem, ...).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parent.parent / "native" / "dat_native.cpp"
+_BUILD_DIR = Path(
+    os.environ.get(
+        "DAT_NATIVE_BUILD_DIR",
+        Path(__file__).resolve().parent.parent / "native" / "_build",
+    )
+)
+
+ERR_TRUNCATED = -1
+ERR_CAPACITY = -2
+ERR_BAD_VARINT = -3
+ERR_BAD_RECORD = -4
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+_U32P = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> Path | None:
+    digest = hashlib.blake2b(_SRC.read_bytes(), digest_size=8).hexdigest()
+    so = _BUILD_DIR / f"dat_native-{digest}.so"
+    if so.exists():
+        return so
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = so.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        str(_SRC), "-o", str(tmp),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"dat_native build failed ({e}); using Python fallbacks",
+              file=sys.stderr)
+        return None
+    os.replace(tmp, so)  # atomic: concurrent builders race benignly
+    return so
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.dat_split_frames.restype = ctypes.c_int64
+    lib.dat_split_frames.argtypes = [
+        _U8P, ctypes.c_int64, _I64P, _I64P, _U8P, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.dat_greedy_select.restype = ctypes.c_int64
+    lib.dat_greedy_select.argtypes = [
+        _I64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, _I64P, ctypes.c_int64,
+    ]
+    lib.dat_decode_changes.restype = ctypes.c_int64
+    lib.dat_decode_changes.argtypes = [
+        _U8P, _I64P, _I64P, ctypes.c_int64,
+        _U32P, _U32P, _U32P,
+        _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.dat_encode_changes.restype = ctypes.c_int64
+    lib.dat_encode_changes.argtypes = [
+        _U8P, ctypes.c_int64,
+        _U32P, _U32P, _U32P,
+        _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
+        _U8P, ctypes.c_int64,
+    ]
+    return lib
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The bound native library, building it on first call; None if
+    unavailable (callers fall back to Python)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DAT_NATIVE_DISABLE"):
+            return None
+        so = _build()
+        if so is not None:
+            try:
+                _lib = _bind(ctypes.CDLL(str(so)))
+            except OSError as e:
+                print(f"dat_native load failed ({e}); using Python fallbacks",
+                      file=sys.stderr)
+                _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,22 @@
+"""L2 session layer: Encoder / Decoder objects and the loopback pipe."""
+
+from .decoder import BlobReader, Decoder, DecoderDestroyedError
+from .encoder import (
+    BlobLengthError,
+    BlobWriter,
+    Encoder,
+    EncoderDestroyedError,
+)
+from .pipe import Pipe, pipe
+
+__all__ = [
+    "BlobReader",
+    "Decoder",
+    "DecoderDestroyedError",
+    "BlobLengthError",
+    "BlobWriter",
+    "Encoder",
+    "EncoderDestroyedError",
+    "Pipe",
+    "pipe",
+]

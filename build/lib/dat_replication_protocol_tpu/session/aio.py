@@ -1,0 +1,180 @@
+"""asyncio transport pumps: a session over non-blocking byte streams.
+
+The reference's native habitat is Node's event loop — `pipe()` composes
+with any async stream and backpressure propagates through `write()`
+return values and `'drain'` events (reference: example.js:53,
+decode.js:87-99,168).  :mod:`.transport` covers blocking sockets/fds
+with thread pumps; this module is the single-threaded event-loop
+equivalent over :mod:`asyncio` streams:
+
+* **Sender**: pulls :meth:`Encoder.read` and writes to a
+  ``StreamWriter``; ``await writer.drain()`` is the congestion stall
+  (the kernel send buffer pushes back through asyncio's flow control).
+  An empty pull awaits the encoder's readable event.
+* **Receiver**: feeds ``StreamReader`` chunks to :meth:`Decoder.write`;
+  when the decoder stalls on an outstanding app ``done``, the pump
+  awaits the write-completion callback before reading on — so the
+  kernel receive buffer (not host RAM) absorbs the in-flight window.
+  Everything runs on one event loop, so unlike the threaded pump there
+  is no lost-wakeup window and no polling fallback.
+
+App callbacks fire on the event loop thread; ``done`` acks may be
+issued synchronously or deferred to any later task/callback on the
+same loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .decoder import Decoder, DecoderDestroyedError
+from .encoder import Encoder, EncoderDestroyedError
+from .transport import DEFAULT_CHUNK
+
+
+async def send_over_async(
+    encoder: Encoder,
+    writer: asyncio.StreamWriter,
+    chunk_size: int = DEFAULT_CHUNK,
+) -> None:
+    """Pump ``encoder`` into an asyncio writer until EOF or destroy."""
+    readable = asyncio.Event()
+    encoder._on_readable = readable.set
+    encoder.on_error(lambda _e: readable.set())
+    try:
+        while True:
+            try:
+                data = encoder.read(chunk_size)
+            except EncoderDestroyedError:
+                break
+            if data is None:  # finalized and drained
+                break
+            if not data:
+                await readable.wait()
+                readable.clear()
+                continue
+            try:
+                writer.write(bytes(data))
+                await writer.drain()  # congestion backpressure
+            except OSError as e:  # incl. every ConnectionError subclass
+                # peer gone mid-session: nothing downstream will read
+                # these bytes — cascade into the encoder (failure
+                # semantics: destroy releases parked callbacks) and stop
+                if not encoder.destroyed:
+                    encoder.destroy(e)
+                break
+    finally:
+        try:
+            if writer.can_write_eof():
+                writer.write_eof()
+        except (OSError, RuntimeError):
+            pass
+
+
+async def recv_over_async(
+    decoder: Decoder,
+    reader: asyncio.StreamReader,
+    chunk_size: int = DEFAULT_CHUNK,
+) -> None:
+    """Pump an asyncio reader into ``decoder`` until EOF or destroy."""
+    while not decoder.destroyed:
+        try:
+            data = await reader.read(chunk_size)
+        except OSError as e:
+            # peer reset mid-frame: cascade so the app's on_error fires
+            # (a decoder already destroyed/finished — e.g. the session's
+            # deliberate abort after an app-side destroy — stays as-is)
+            if not decoder.destroyed and not decoder.finished:
+                decoder.destroy(e)
+            return
+        if not data:
+            if not decoder.destroyed and not decoder.finished:
+                decoder.end()
+            return
+        drained = asyncio.Event()
+        try:
+            consumed = decoder.write(data, on_consumed=drained.set)
+        except DecoderDestroyedError:
+            return
+        if not consumed:
+            # single-threaded: the ack that drains the decoder runs on
+            # this loop, so the event cannot be missed (contrast the
+            # threaded pump's bounded poll, transport.py:recv_over)
+            await drained.wait()
+
+
+async def session_over_asyncio(
+    encoder: Encoder,
+    decoder: Decoder,
+    chunk_size: int = DEFAULT_CHUNK,
+) -> None:
+    """Run a whole session over a kernel socketpair on the event loop.
+
+    Opens both ends, pumps concurrently, returns when the sender has
+    flushed EOF and the receiver has finished (or either destroyed).
+    """
+    import socket
+
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    b.setblocking(False)
+    writers: list[asyncio.StreamWriter] = []
+    send_task = recv_task = None
+    try:
+        _, writer = await asyncio.open_connection(sock=a)
+        writers.append(writer)  # immediately: if the second open raises,
+        # the finally must still tear this transport down
+        reader, writer_b = await asyncio.open_connection(sock=b)
+        writers.append(writer_b)
+        send_task = asyncio.ensure_future(
+            send_over_async(encoder, writer, chunk_size)
+        )
+        recv_task = asyncio.ensure_future(
+            recv_over_async(decoder, reader, chunk_size)
+        )
+        done, pending = await asyncio.wait(
+            {send_task, recv_task}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if pending and recv_task in done:
+            # receiver exited early (destroy): nothing will ever read
+            # the socket again.  Abort the transports (fails a sender
+            # blocked in drain()) AND destroy the encoder (wakes a
+            # sender parked in readable.wait() on an idle encoder — the
+            # destroy releases parked callbacks and fires on_error,
+            # which sets the readable event)
+            for w in writers:
+                w.transport.abort()
+            if not encoder.destroyed:
+                encoder.destroy(ConnectionAbortedError("receiver gone"))
+        await asyncio.gather(send_task, recv_task)
+    finally:
+        # one pump failing must not orphan the other (asyncio would log
+        # "Task exception was never retrieved" when the closed sockets
+        # fail it later)
+        for t in (send_task, recv_task):
+            if t is not None and not t.done():
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        # abort, not close: a flushing close on a congested transport
+        # waits for a peer that may never read (teardown must not hang);
+        # on the normal path the sender already drained every write, so
+        # nothing is discarded
+        for w in writers:
+            try:
+                w.transport.abort()
+                w.close()
+            except (OSError, RuntimeError):
+                pass
+        for w in writers:
+            try:
+                await w.wait_closed()
+            except (OSError, RuntimeError):
+                pass
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -1,0 +1,756 @@
+"""Decoder — the consuming end of a replication session.
+
+Capability parity with the reference Decoder (reference: decode.js:63-262),
+re-designed as a push-based incremental parser with an explicit pending
+counter instead of Node Writable plumbing:
+
+* :meth:`write` feeds wire bytes; the internal state machine is
+  header → (change | blob payload) → header …, slicing without copying on the
+  fast path (reference keeps the same discipline, decode.js:217-227,198-201).
+* Handlers are registered with :meth:`change` / :meth:`blob` /
+  :meth:`finalize` (same registration-style API as the reference,
+  decode.js:112-122). Each handler receives a ``done`` callable;
+  **backpressure**: while any ``done`` is outstanding, parsing pauses and
+  :meth:`write` returns ``False`` — the analogue of the reference withholding
+  the Writable's callback (reference: decode.js:87-99,168).
+* Unregistered handlers never deadlock the pipeline: changes are dropped,
+  blobs drained, finalize auto-acked (reference: decode.js:50-61).
+* :meth:`end` invokes the finalize handler after all prior frames are
+  consumed, before the session completes — the sentinel-write trick of the
+  reference (decode.js:6,124-142) becomes an explicit queued finalization.
+* Unknown frame type ids destroy the session with
+  :class:`~..wire.framing.ProtocolError` (reference: decode.js:159-161).
+* Counters ``bytes`` / ``changes`` / ``blobs`` (reference: decode.js:68-70).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+from ..wire.change_codec import Change, decode_change
+from ..wire.framing import MAX_HEADER_LEN, TYPE_BLOB, TYPE_CHANGE, TYPE_HEADER, ProtocolError
+from ..wire.varint import decode_uvarint
+
+OnDone = Optional[Callable[[], None]]
+
+
+class DecoderDestroyedError(Exception):
+    pass
+
+
+class BlobReader:
+    """Read side of one streamed blob, handed to the app's blob handler.
+
+    Chunks are delivered through :meth:`on_data` as they are parsed; chunks
+    arriving before a handler is registered are buffered and replayed at
+    registration (the Readable-buffer behavior of the reference's BlobStream,
+    reference: decode.js:8-48). :meth:`pause` / :meth:`resume` give the app
+    per-chunk backpressure: while paused the decoder stops parsing, which
+    propagates to the transport.
+    """
+
+    def __init__(self, decoder: "Decoder", length: int):
+        self._decoder = decoder
+        self.length = length
+        self.received = 0
+        self.ended = False
+        self.destroyed = False
+        self._data_cb: Optional[Callable[[bytes], None]] = None
+        self._end_cbs: list[Callable[[], None]] = []
+        self._buffered: list[bytes] = []
+        self._paused = False
+
+    def on_data(self, cb: Callable[[bytes], None]) -> "BlobReader":
+        self._data_cb = cb
+        if self._buffered:
+            chunks, self._buffered = self._buffered, []
+            for c in chunks:
+                cb(c)
+        return self
+
+    def on_end(self, cb: Callable[[], None]) -> "BlobReader":
+        if self.ended:
+            cb()
+        else:
+            self._end_cbs.append(cb)
+        return self
+
+    def collect(self, cb: Callable[[bytes], None]) -> "BlobReader":
+        """Convenience: buffer the whole blob and deliver it once on end —
+        the role `concat-stream` plays in the reference suite
+        (reference: test/basic.js:36-40)."""
+        parts: list[bytes] = []
+        self.on_data(parts.append)
+        self.on_end(lambda: cb(b"".join(parts)))
+        return self
+
+    def pause(self) -> None:
+        """Stop the decoder from parsing further input (chunk granularity)
+        until :meth:`resume` — per-chunk backpressure, the analogue of the
+        reference's Readable drain accounting (reference: decode.js:35-48)."""
+        if self._paused:
+            return
+        self._paused = True
+        self._decoder._paused_readers += 1
+
+    def resume(self) -> None:
+        if not self._paused:
+            return
+        self._paused = False
+        self._decoder._paused_readers -= 1
+        self._decoder._resume()
+
+    def destroy(self, err: Exception | None = None) -> None:
+        """Destroying a blob reader tears down the whole session
+        (reference: decode.js:20-26)."""
+        if self.destroyed:
+            return
+        self.destroyed = True
+        self._decoder.destroy(err)
+
+    # -- driven by the decoder ---------------------------------------------
+
+    def _deliver(self, chunk: bytes) -> None:
+        self.received += len(chunk)
+        if self._data_cb is not None:
+            self._data_cb(chunk)
+        else:
+            self._buffered.append(chunk)
+
+    def _finish(self) -> None:
+        self.ended = True
+        cbs, self._end_cbs = self._end_cbs, []
+        for cb in cbs:
+            cb()
+
+
+def _drain_blob(blob: BlobReader, done: Callable[[], None]) -> None:
+    """Default blob handler: consume and discard (reference: decode.js:58-61).
+
+    The discarding data callback matters: without one, BlobReader buffers
+    every chunk for later replay and an unconsumed blob accumulates whole
+    in host RAM — the opposite of draining.
+    """
+    blob.on_data(lambda _chunk: None)
+    blob.on_end(done)
+
+
+class Decoder:
+    """Push-based incremental wire parser. See module docstring."""
+
+    def __init__(self):
+        self.bytes = 0
+        self.changes = 0
+        self.blobs = 0
+        self.destroyed = False
+        self.finished = False
+        self._on_change: Callable[[Change, Callable[[], None]], None] | None = None
+        self._on_blob: Callable[[BlobReader, Callable[[], None]], None] | None = None
+        self._on_finalize: Callable[[Callable[[], None]], None] | None = None
+        self._error_cbs: list[Callable[[Exception | None], None]] = []
+        self._finish_cbs: list[Callable[[], None]] = []
+
+        # parser state
+        self._state = TYPE_HEADER
+        self._header = bytearray()  # accumulating varint+id bytes
+        self._missing = 0  # payload bytes still to consume
+        self._payload_parts: list[bytes] | None = None  # change slow path
+        self._current_blob: BlobReader | None = None
+
+        # flow control
+        self._pending = 0
+        self._paused_readers = 0
+        self._overflow: deque[memoryview] = deque()  # unparsed input, in order
+        self._overflow_bytes = 0  # running total (kept in sync with the deque)
+        self._bulk: dict | None = None  # parked native frame-index cursor
+        self._write_cbs: list[Callable[[], None]] = []
+        self._end_queued = False
+        self._end_cb: OnDone = None
+        self._consuming = False  # reentrancy guard for _consume
+
+    # -- handler registration (same shape as the reference API) -------------
+
+    def change(self, cb: Callable[[Change, Callable[[], None]], None]) -> "Decoder":
+        self._on_change = cb
+        return self
+
+    def blob(self, cb: Callable[[BlobReader, Callable[[], None]], None]) -> "Decoder":
+        self._on_blob = cb
+        return self
+
+    def finalize(self, cb: Callable[[Callable[[], None]], None]) -> "Decoder":
+        self._on_finalize = cb
+        return self
+
+    def on_error(self, cb: Callable[[Exception | None], None]) -> "Decoder":
+        self._error_cbs.append(cb)
+        return self
+
+    def on_finish(self, cb: Callable[[], None]) -> "Decoder":
+        if self.finished:
+            cb()
+        else:
+            self._finish_cbs.append(cb)
+        return self
+
+    # -- write side ---------------------------------------------------------
+
+    def write(self, data, on_consumed: OnDone = None) -> bool:
+        """Feed wire bytes. Returns True if fully consumed synchronously;
+        False if parsing stalled on an outstanding ``done`` (the
+        ``on_consumed`` callback then fires when the app drains —
+        reference: decode.js:124-133,168)."""
+        if self.destroyed:
+            raise DecoderDestroyedError("write after destroy")
+        if self.finished or self._end_queued:
+            raise DecoderDestroyedError("write after end")
+        data = memoryview(data.encode("utf-8") if isinstance(data, str) else data)
+        self.bytes += len(data)
+        if len(data):
+            self._overflow.append(data)
+            self._overflow_bytes += len(data)
+        # Park the completion callback BEFORE consuming: _consume's
+        # drained epilogue is the single place parked callbacks fire, so
+        # a done() ack landing on another thread can never slip between
+        # a stall check and the parking (the lost-wakeup TOCTOU).  A
+        # fresh wrapper keeps the parked entry unique per call.
+        entry = None
+        if on_consumed is not None:
+            entry = lambda cb=on_consumed: cb()  # noqa: E731
+            self._write_cbs.append(entry)
+        self._consume()
+        if entry is not None:
+            return entry not in self._write_cbs  # fired <=> consumed
+        return not (
+            self._overflow or self._bulk is not None or self._stalled()
+        )
+
+    def end(self, on_finished: OnDone = None) -> None:
+        """Graceful end: after all prior frames are consumed, the finalize
+        handler runs, then the session finishes (reference: decode.js:135-142)."""
+        if self.destroyed:
+            raise DecoderDestroyedError("end after destroy")
+        if self._end_queued or self.finished:
+            return
+        self._end_queued = True
+        self._end_cb = on_finished
+        self._maybe_finalize()
+
+    def destroy(self, err: Exception | None = None) -> None:
+        """Fail-fast teardown, cascading to a live blob reader
+        (reference: decode.js:104-110)."""
+        if self.destroyed:
+            return
+        self.destroyed = True
+        blob, self._current_blob = self._current_blob, None
+        if blob is not None and not blob.destroyed:
+            blob.destroyed = True
+        self._overflow.clear()
+        self._overflow_bytes = 0
+        self._bulk = None
+        for cb in self._error_cbs:
+            cb(err)
+        # Release parked write-completion callbacks so a transport blocked on
+        # "consumed" wakes up and observes the destroyed state (Node errors
+        # the pending Writable callback for the same reason).
+        cbs, self._write_cbs = self._write_cbs, []
+        for cb in cbs:
+            cb()
+
+    def writable(self) -> bool:
+        return not (
+            self._stalled()
+            or self._overflow
+            or self._bulk is not None
+            or self.destroyed
+            or self.finished
+        )
+
+    # -- flow control --------------------------------------------------------
+
+    def _stalled(self) -> bool:
+        return self._pending > 0 or self._paused_readers > 0
+
+    def _up(self) -> Callable[[], None]:
+        """Create a one-shot ``done`` for an app callback; parsing pauses
+        while any are outstanding (reference: decode.js:87-99)."""
+        self._pending += 1
+        fired = False
+
+        def done() -> None:
+            nonlocal fired
+            if fired:
+                return
+            fired = True
+            self._pending -= 1
+            self._resume()
+
+        return done
+
+    def _resume(self) -> None:
+        # While _consume is live on the stack, the outer loop may hold a
+        # chunk's unparsed remainder in a local — it will keep going (pending
+        # just dropped) and run the drained notifications itself, so a nested
+        # resume must be a no-op rather than observe a falsely-empty overflow.
+        if self.destroyed or self._stalled() or self._consuming:
+            return
+        self._consume()
+
+    def _maybe_finalize(self) -> None:
+        if (
+            not self._end_queued
+            or self.finished
+            or self.destroyed
+            or self._overflow
+            or self._bulk is not None
+            or self._stalled()
+            or self._consuming  # drained-check at the end of _consume re-runs this
+        ):
+            return
+        if self._state != TYPE_HEADER or self._header:
+            self.destroy(ProtocolError("stream ended mid-frame"))
+            return
+        self._end_queued = False  # run once
+
+        def finish() -> None:
+            self.finished = True
+            cb, self._end_cb = self._end_cb, None
+            if cb is not None:
+                cb()
+            cbs, self._finish_cbs = self._finish_cbs, []
+            for fcb in cbs:
+                fcb()
+
+        if self._on_finalize is not None:
+            self._on_finalize(finish)
+        else:
+            finish()
+
+    # -- parser --------------------------------------------------------------
+
+    # bulk path threshold: below this, the native round-trip (array
+    # wrapping + index buffers) costs more than the per-byte scan saves
+    _NATIVE_MIN = 4096
+
+    def _consume(self) -> None:
+        """Main parse loop: drain overflow while the app is keeping up
+        (reference: decode.js:144-169).
+
+        When at least a buffer's worth of complete frames is queued and
+        the parser sits at a frame boundary, the whole buffer is indexed
+        in one native call (``dat_split_frames``,
+        native/dat_native.cpp) and frames dispatch from the index —
+        the reference's per-byte header scan (decode.js:251-262) drops
+        out of the hot path entirely.  The per-byte scanner remains the
+        slow/tail path: split headers, partial frames, tiny writes.
+
+        Guarded against reentrancy: a handler that acks synchronously while
+        the loop holds a chunk's unparsed remainder in a local must not
+        re-enter and pop the *next* queued chunk out of order — the guard
+        makes the nested resume a no-op and the outer loop carries on.
+        """
+        if self._consuming:
+            return
+        self._consuming = True
+        try:
+            while not self._stalled() and not self.destroyed:
+                if self._bulk is not None:
+                    # resume a parked frame index from its cursor — an
+                    # async ack must NOT re-index/re-decode the remainder
+                    # (that would make bulk decode O(frames^2))
+                    self._run_indexed()
+                    continue
+                if not self._overflow:
+                    break
+                if (
+                    self._state == TYPE_HEADER
+                    and not self._header
+                    # O(1) size gate BEFORE merging: joining the backlog
+                    # costs O(bytes), and when the native path is
+                    # unavailable (_NATIVE_MIN pushed to 2**62) an
+                    # unconditional merge would re-copy the whole backlog
+                    # on every resume — quadratic on the Python fallback
+                    and self._overflow_bytes >= self._NATIVE_MIN
+                ):
+                    merged = self._merged_overflow()
+                    if merged is not None and len(merged) >= self._NATIVE_MIN:
+                        if self._start_indexed(merged):
+                            continue
+                        if self.destroyed:
+                            return
+                        # no complete frame in the whole buffer (e.g. a
+                        # large blob frame still arriving): fall through
+                        # to the streaming scanner so it can enter the
+                        # frame and consume payload incrementally
+                        self._ov_appendleft(merged)
+                    elif merged is not None:
+                        self._ov_appendleft(merged)
+                chunk = self._overflow.popleft()
+                self._overflow_bytes -= len(chunk)
+                rest = self._consume_chunk(chunk)
+                if self.destroyed:
+                    return
+                if rest is not None and len(rest):
+                    self._ov_appendleft(rest)
+        finally:
+            self._consuming = False
+        # Fully drained and nothing outstanding: release parked writers and
+        # run a queued finalization. This lives here (not in _resume) so a
+        # handler acking synchronously mid-loop cannot finalize while the
+        # loop still holds unparsed bytes in a local.
+        if (
+            not self.destroyed
+            and not self._overflow
+            and self._bulk is None
+            and not self._stalled()
+        ):
+            cbs, self._write_cbs = self._write_cbs, []
+            for cb in cbs:
+                cb()
+            self._maybe_finalize()
+
+    def _ov_appendleft(self, mv: memoryview) -> None:
+        self._overflow.appendleft(mv)
+        self._overflow_bytes += len(mv)
+
+    def _merged_overflow(self) -> memoryview | None:
+        """Pop ALL queued overflow as one contiguous memoryview."""
+        if not self._overflow:
+            return None
+        if len(self._overflow) == 1:
+            chunk = self._overflow.popleft()
+            self._overflow_bytes -= len(chunk)
+            return chunk
+        chunks = list(self._overflow)
+        self._overflow.clear()
+        self._overflow_bytes = 0
+        return memoryview(b"".join(chunks))
+
+    def _start_indexed(self, buf: memoryview) -> bool:
+        """Index ``buf``'s complete frames natively and park a cursor.
+
+        One ``dat_split_frames`` call replaces per-frame header scans,
+        and one ``dat_decode_changes`` call pre-decodes every change
+        payload columnar-wise (the per-record Python proto parse is ~2/3
+        of bulk decode time, measured).  The index + columns + cursor
+        live in ``self._bulk`` so an async ack resumes dispatch where it
+        stopped instead of re-indexing the remainder.
+
+        Returns False when the bulk path cannot proceed (no native lib,
+        or zero complete frames in the buffer) — the caller falls back
+        to the streaming scanner.  On a corrupt change payload the
+        columns are dropped and the per-frame Python decoder takes over,
+        so records before the corrupt one are still delivered and the
+        error surfaces with identical semantics.
+        """
+        from ..runtime import native
+
+        lib = native.get_lib()
+        if lib is None:
+            self._NATIVE_MIN = 1 << 62  # don't retry every write
+            return False
+        import ctypes
+
+        import numpy as np
+
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        cap = len(arr) // 2 + 1  # a frame is at least 2 bytes
+        starts = np.empty(cap, dtype=np.int64)
+        lens = np.empty(cap, dtype=np.int64)
+        ids = np.empty(cap, dtype=np.uint8)
+        consumed = ctypes.c_int64(0)
+        err = ctypes.c_int64(0)
+        n = lib.dat_split_frames(arr, len(arr), starts, lens, ids, cap,
+                                 ctypes.byref(consumed), ctypes.byref(err))
+        # A malformed header mid-buffer only STOPS the native scan (err is
+        # informational): the valid prefix still dispatches through the
+        # bulk path and the streaming scanner re-encounters the bad
+        # header in the remainder, destroying at exactly the frame the
+        # per-byte path would — delivery-before-error must not depend on
+        # how the transport chunked its writes.
+        if n <= 0:
+            return False
+
+        cols = None
+        cidx = np.nonzero(ids[:n] == TYPE_CHANGE)[0]
+        m = len(cidx)
+        if m >= 16:
+            chg = np.empty(m, np.uint32)
+            frm = np.empty(m, np.uint32)
+            tov = np.empty(m, np.uint32)
+            koff = np.empty(m, np.int64)
+            klen = np.empty(m, np.int64)
+            soff = np.empty(m, np.int64)
+            slen = np.empty(m, np.int64)
+            voff = np.empty(m, np.int64)
+            vlen = np.empty(m, np.int64)
+            erri = ctypes.c_int64(-1)
+            rc = lib.dat_decode_changes(
+                arr, np.ascontiguousarray(starts[cidx]),
+                np.ascontiguousarray(lens[cidx]), m,
+                chg, frm, tov, koff, klen, soff, slen, voff, vlen,
+                ctypes.byref(erri),
+            )
+            if rc == 0:
+                cols = (
+                    chg.tolist(), frm.tolist(), tov.tolist(),
+                    koff.tolist(), klen.tolist(), soff.tolist(),
+                    slen.tolist(), voff.tolist(), vlen.tolist(),
+                )
+        self._bulk = {
+            "buf": buf,
+            "starts": starts[:n].tolist(),
+            "lens": lens[:n].tolist(),
+            "ids": ids[:n].tolist(),
+            "n": n,
+            "consumed": int(consumed.value),
+            "f": 0,
+            "row": 0,
+            "cols": cols,
+            "blob_open": False,
+        }
+        return True
+
+    def _run_indexed(self) -> None:
+        """Dispatch frames from the parked index until done or stalled.
+
+        Each frame goes through the same change/blob machinery as the
+        streaming path (counters, ordering, blob latches, zero-length
+        blobs — shared, not duplicated).
+        """
+        st = self._bulk
+        assert st is not None
+        buf = st["buf"]
+        starts, lens, ids = st["starts"], st["lens"], st["ids"]
+        cols = st["cols"]
+        f = st["f"]
+        n = st["n"]
+        while f < n:
+            if self._stalled() or self.destroyed:
+                st["f"] = f
+                return
+            type_id = ids[f]
+            start = starts[f]
+            flen = lens[f]
+            self._missing = flen
+            if type_id == TYPE_CHANGE:
+                row = st["row"]
+                if cols is not None:
+                    (chg, frm, tov, koff, klen, soff, slen, voff,
+                     vlen) = cols
+                    ko, kl = koff[row], klen[row]
+                    so, sl = soff[row], slen[row]
+                    vo, vl = voff[row], vlen[row]
+                    try:
+                        change = Change(
+                            key=str(buf[ko : ko + kl], "utf-8"),
+                            change=chg[row],
+                            from_=frm[row],
+                            to=tov[row],
+                            value=(bytes(buf[vo : vo + vl])
+                                   if vl >= 0 else b""),
+                            subset=(str(buf[so : so + sl], "utf-8")
+                                    if sl >= 0 else ""),
+                        )
+                    except ValueError as e:  # incl. UnicodeDecodeError
+                        self._bulk = None
+                        self.destroy(ProtocolError(str(e)))
+                        return
+                    st["row"] = row + 1
+                    self._missing = 0
+                    self._deliver_change(change, buf[start : start + flen])
+                else:
+                    st["row"] = row + 1
+                    self._state = TYPE_CHANGE
+                    self._payload_parts = None
+                    self._change_data(buf[start : start + flen])
+            elif type_id == TYPE_BLOB:
+                if not st["blob_open"]:
+                    self._state = TYPE_BLOB
+                    self._current_blob = None
+                    self._open_blob_if_ready()
+                    st["blob_open"] = True
+                    if self.destroyed:
+                        self._bulk = None
+                        return
+                    # a handler that pause()d synchronously must not
+                    # receive the payload until it resumes — same as the
+                    # streaming path parking the chunk undelivered
+                    if flen and self._stalled():
+                        st["f"] = f
+                        return
+                if flen:
+                    self._blob_data(buf[start : start + flen])
+                st["blob_open"] = False
+            else:
+                self._bulk = None
+                self.destroy(
+                    ProtocolError(f"Protocol error, unknown type: {type_id}")
+                )
+                return
+            if self.destroyed:
+                self._bulk = None
+                return
+            f += 1
+        self._bulk = None
+        tail = buf[st["consumed"]:]
+        if len(tail):
+            self._ov_appendleft(tail)
+
+    def _consume_chunk(self, chunk: memoryview) -> memoryview | None:
+        if self._state == TYPE_HEADER:
+            return self._scan_header(chunk)
+        if self._state == TYPE_CHANGE:
+            return self._change_data(chunk)
+        if self._state == TYPE_BLOB:
+            return self._blob_data(chunk)
+        raise AssertionError(f"bad parser state {self._state}")
+
+    def _scan_header(self, chunk: memoryview) -> memoryview | None:
+        """Byte-at-a-time varint scan; the byte after the varint is the type
+        id (reference: decode.js:251-262). Bounded at MAX_HEADER_LEN."""
+        i = 0
+        n = len(chunk)
+        while i < n:
+            self._header.append(chunk[i])
+            i += 1
+            # varint terminated iff the *previous* byte had its MSB clear and
+            # we now also hold the id byte.
+            if len(self._header) >= 2 and not (self._header[-2] & 0x80):
+                try:
+                    framed_len, _ = decode_uvarint(self._header)
+                except ValueError as e:  # e.g. varint exceeds 64 bits
+                    self.destroy(ProtocolError(str(e)))
+                    return None
+                type_id = self._header[-1]
+                self._header.clear()
+                self._missing = framed_len - 1  # length counts the id byte
+                if framed_len < 1:
+                    self.destroy(ProtocolError("frame length must be >= 1"))
+                    return None
+                if type_id == TYPE_CHANGE:
+                    self._state = TYPE_CHANGE
+                    self._payload_parts = None
+                elif type_id == TYPE_BLOB:
+                    self._state = TYPE_BLOB
+                    self._current_blob = None
+                    self._open_blob_if_ready()
+                else:
+                    self.destroy(
+                        ProtocolError(f"Protocol error, unknown type: {type_id}")
+                    )
+                    return None
+                return chunk[i:]
+            if len(self._header) >= MAX_HEADER_LEN:
+                self.destroy(ProtocolError("frame header too long"))
+                return None
+        return None
+
+    # -- change frames -------------------------------------------------------
+
+    def _change_data(self, chunk: memoryview) -> memoryview | None:
+        if self._payload_parts is None and len(chunk) >= self._missing:
+            # fast path: whole payload inside one chunk — zero-copy slice
+            # (reference: decode.js:217-227)
+            payload = chunk[: self._missing]
+            rest = chunk[self._missing :]
+            self._missing = 0
+            self._finish_change(payload)
+            return rest
+        # slow path: accumulate across chunk boundaries (reference:
+        # decode.js:229-248)
+        if self._payload_parts is None:
+            self._payload_parts = []
+        take = min(len(chunk), self._missing)
+        self._payload_parts.append(bytes(chunk[:take]))
+        self._missing -= take
+        rest = chunk[take:]
+        if self._missing == 0:
+            parts, self._payload_parts = self._payload_parts, None
+            self._finish_change(b"".join(parts))
+        return rest
+
+    def _finish_change(self, payload) -> None:
+        try:
+            change = decode_change(payload)
+        except ValueError as e:
+            self.destroy(ProtocolError(str(e)))
+            return
+        self._deliver_change(change, payload)
+
+    def _deliver_change(self, change: Change, payload) -> None:
+        """Deliver one decoded change: the single hook both parse paths
+        (streaming scanner and native bulk index) funnel through, so
+        subclasses adding per-change work (the TPU backend hashes every
+        payload) override exactly one method."""
+        self.changes += 1
+        self._state = TYPE_HEADER
+        if self._on_change is not None:
+            self._on_change(change, self._up())
+        # default: drop (reference: decode.js:54-56)
+
+    # -- blob frames ---------------------------------------------------------
+
+    def _open_blob_if_ready(self) -> None:
+        """Create the reader and invoke the app handler.
+
+        The blob-level ``done`` does NOT gate parsing of the blob's own
+        payload — the reference hands the handler ``_down`` without a matching
+        ``_up`` and instead increments pending at blob END
+        (reference: decode.js:171-177,182), so frames *after* the blob wait
+        for the app's ack. The latch below reproduces exactly that pairing.
+        (The reference defers reader creation to the first payload byte,
+        decode.js:180-184; creating at header time additionally supports
+        zero-length blobs.)"""
+        blob = BlobReader(self, self._missing)
+        self._current_blob = blob
+        self.blobs += 1
+        latch = {"ended": False, "acked": False}
+        blob._pending_latch = latch
+
+        def done() -> None:
+            if latch["acked"]:
+                return
+            latch["acked"] = True
+            if latch["ended"]:
+                self._pending -= 1
+                self._resume()
+
+        handler = self._on_blob if self._on_blob is not None else _drain_blob
+        handler(blob, done)
+        if self._missing == 0:
+            self._end_blob()
+
+    def _blob_data(self, chunk: memoryview) -> memoryview | None:
+        blob = self._current_blob
+        assert blob is not None
+        take = min(len(chunk), self._missing)
+        self._missing -= take
+        # materialize ONCE; bytes are immutable, so every consumer —
+        # the BlobReader and any _note_blob_bytes subscriber (digest
+        # buffering) — shares this object instead of re-copying the
+        # scratch memoryview
+        data = bytes(chunk[:take])
+        self._note_blob_bytes(data)
+        blob._deliver(data)
+        rest = chunk[take:]
+        if self._missing == 0:
+            self._end_blob()
+        return rest
+
+    def _note_blob_bytes(self, data: bytes) -> None:
+        """Hook: called with each materialized blob payload piece (exactly
+        the bytes object delivered to the BlobReader).  Base: no-op."""
+
+    def _end_blob(self) -> None:
+        blob, self._current_blob = self._current_blob, None
+        self._state = TYPE_HEADER
+        if blob is not None:
+            # Hold the pipeline until the app acks the blob — the
+            # `_pending++` of the reference's _onblobend (decode.js:171-177).
+            latch = blob._pending_latch
+            if not latch["acked"]:
+                latch["ended"] = True
+                self._pending += 1
+            blob._finish()

@@ -1,0 +1,377 @@
+"""Encoder — the producing end of a replication session.
+
+Capability parity with the reference Encoder (reference: encode.js:46-151),
+re-designed as a pull-based Python object instead of a Node Readable:
+
+* ``change(change, on_flush)`` frames a protobuf Change (type id 1).
+* ``blob(length, on_flush)`` opens a streamed blob (type id 2); returns a
+  :class:`BlobWriter`. The frame length must be declared up front because the
+  wire header precedes the data (reference: encode.js:79).
+* **Blob FIFO discipline**: any number of blobs may be *open* concurrently but
+  their bytes hit the wire strictly in creation order — the second and later
+  blobs are corked at creation and uncorked when the head finishes
+  (reference: encode.js:87-96). Writes to a corked blob are parked.
+* **Change parking**: a change submitted while any blob is open is parked and
+  replayed once the blob queue drains, so changes are ordered after all blobs
+  that were open at submit time (reference: encode.js:104-107, replay at :95).
+* **Backpressure**: the consumer pulls with :meth:`read`; ``on_flush``
+  callbacks fire when the corresponding bytes have actually been pulled —
+  the pull is this design's analogue of the Readable drain that times flush
+  callbacks in the reference (reference: encode.js:139-151).
+* ``finalize()`` marks EOF; :meth:`read` returns ``None`` once drained
+  (reference: encode.js:119-122 pushes EOF on the Readable).
+* Counters ``bytes`` / ``changes`` / ``blobs`` mirror the reference's passive
+  counters (reference: encode.js:51-53).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+from ..wire.change_codec import Change, encode_change
+from ..wire.framing import TYPE_BLOB, TYPE_CHANGE, frame_header
+
+OnDone = Optional[Callable[[], None]]
+
+DEFAULT_HIGH_WATER = 64 * 1024
+
+
+class EncoderDestroyedError(Exception):
+    pass
+
+
+class BlobLengthError(Exception):
+    """Writes did not match the declared blob length."""
+
+
+class BlobWriter:
+    """Write side of one streamed blob.
+
+    Mirrors the encoder-side BlobStream (reference: encode.js:11-44): chunks
+    forward into the parent's output queue; while corked (not head of the blob
+    FIFO) writes are parked and flushed on uncork. Unlike the reference —
+    which never validates payload size against the declared frame length —
+    this writer raises :class:`BlobLengthError` on overflow or short ``end()``,
+    because a mismatch silently desyncs the wire.
+    """
+
+    def __init__(self, encoder: "Encoder", length: int, on_flush: OnDone = None):
+        self._encoder = encoder
+        self.length = length
+        self._on_flush = on_flush
+        self._written = 0
+        self._corked = False
+        self._parked: list[tuple[bytes, OnDone]] = []
+        self._ended = False
+        self._finished = False
+        self.destroyed = False
+
+    # -- public API ---------------------------------------------------------
+
+    def write(self, data, on_flush: OnDone = None) -> bool:
+        """Append blob payload bytes. Returns False when the encoder's output
+        buffer is above the high-water mark (the caller should wait for
+        :meth:`Encoder.on_drain`)."""
+        if self.destroyed or self._encoder.destroyed:
+            raise EncoderDestroyedError("write after destroy")
+        if self._ended:
+            raise BlobLengthError("write after end()")
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        elif not isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)
+        if self._written + len(data) > self.length:
+            err = BlobLengthError(
+                f"blob overflow: declared {self.length}, writing past it "
+                f"({self._written} + {len(data)})"
+            )
+            self._encoder.destroy(err)
+            raise err
+        self._written += len(data)
+        if self._corked:
+            self._park(bytes(data), on_flush)
+            return not self._encoder._above_high_water()
+        return self._encoder._push(data, on_flush)
+
+    def end(self, data=None, on_flush: OnDone = None) -> None:
+        """Finish the blob (optionally writing a final chunk)."""
+        if data is not None:
+            self.write(data, on_flush)
+        elif on_flush is not None:
+            # fire once the blob's bytes are flushed
+            prev = self._on_flush
+            if prev is None:
+                self._on_flush = on_flush
+            else:
+                def both(a=prev, b=on_flush):
+                    a()
+                    b()
+                self._on_flush = both
+        if self._ended:
+            return
+        self._ended = True
+        if self._written != self.length:
+            err = BlobLengthError(
+                f"blob ended short: declared {self.length}, wrote {self._written}"
+            )
+            self._encoder.destroy(err)
+            raise err
+        if not self._corked:
+            self._finish()
+
+    def destroy(self, err: Exception | None = None) -> None:
+        """Tear down this blob and the whole session — destroying either side
+        of a blob destroys its parent (reference: encode.js:22-28)."""
+        if self.destroyed:
+            return
+        self.destroyed = True
+        self._encoder.destroy(err)
+
+    # -- internal -----------------------------------------------------------
+
+    def _cork(self) -> None:
+        self._corked = True
+
+    def _park(self, data: bytes, cb: OnDone) -> None:
+        """Parked bytes count toward the encoder's high-water mark so
+        backpressure stays honest while the head blob streams."""
+        self._parked.append((data, cb))
+        self._encoder._parked_bytes += len(data)
+
+    def _uncork(self) -> None:
+        """Flush parked chunks into the parent; if already ended, finish —
+        cascading to the next queued blob (reference: encode.js:30-35,92-96)."""
+        if not self._corked:
+            return
+        self._corked = False
+        for data, cb in self._parked:
+            self._encoder._parked_bytes -= len(data)
+            self._encoder._push(data, cb)
+        self._parked.clear()
+        if self._ended:
+            self._finish()
+
+    def _finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        if self._on_flush is not None:
+            # Deliver the blob-level flush when the last pushed byte drains.
+            self._encoder._after_flush(self._on_flush)
+        self._encoder._blob_finished(self)
+
+
+class Encoder:
+    """Pull-based frame producer. See module docstring for semantics."""
+
+    def __init__(self, high_water: int = DEFAULT_HIGH_WATER):
+        self.bytes = 0
+        self.changes = 0
+        self.blobs = 0
+        self.destroyed = False
+        self.finalized = False
+        self._high_water = high_water
+        # queue of (payload: bytes, on_consumed: OnDone); payloads are wire
+        # bytes (headers and data alike).
+        self._queue: deque[tuple[bytes, OnDone]] = deque()
+        self._queued_bytes = 0
+        self._parked_bytes = 0  # bytes held in corked blobs / parked changes
+        self._open_blobs: deque[BlobWriter] = deque()
+        # Parked changes are encoded at submit time (catching bad input early
+        # and making the parked bytes countable); framed on replay.
+        self._parked_changes: list[tuple[bytes, OnDone]] = []
+        self._drain_cbs: list[Callable[[], None]] = []
+        self._error_cbs: list[Callable[[Exception | None], None]] = []
+        self._finalize_cb: OnDone = None
+        # Consumer hook (set by session.pipe.Pipe): called whenever new wire
+        # bytes become readable, so a connected pump keeps flowing on late
+        # writes — the pull-based stand-in for Node's 'readable' event.
+        self._on_readable: Optional[Callable[[], None]] = None
+
+    # -- public API ---------------------------------------------------------
+
+    def change(self, change: Change | dict, on_flush: OnDone = None) -> bool:
+        """Frame a Change. If any blob is open the change is parked and
+        replayed when the blob queue drains (reference: encode.js:102-117)."""
+        if self.destroyed:
+            raise EncoderDestroyedError("change after destroy")
+        if self.finalized:
+            raise EncoderDestroyedError("change after finalize")
+        payload = encode_change(change)
+        if self._open_blobs:
+            self._parked_changes.append((payload, on_flush))
+            self._parked_bytes += len(payload)
+            return not self._above_high_water()
+        return self._frame_change(payload, on_flush)
+
+    def _frame_change(self, payload: bytes, on_flush: OnDone) -> bool:
+        self.changes += 1
+        header = frame_header(len(payload), TYPE_CHANGE)
+        self._push(header, None)
+        return self._push(payload, on_flush)
+
+    def blob(self, length: int, on_flush: OnDone = None) -> BlobWriter:
+        """Open a streamed blob of exactly ``length`` bytes. The length is
+        required up front — the frame header precedes the data on the wire
+        (reference: encode.js:77-100)."""
+        if self.destroyed:
+            raise EncoderDestroyedError("blob after destroy")
+        if self.finalized:
+            raise EncoderDestroyedError("blob after finalize")
+        if not isinstance(length, int) or length <= 0:
+            raise ValueError("blob length is required and must be > 0")
+        ws = BlobWriter(self, length, on_flush)
+        self.blobs += 1
+        header = frame_header(length, TYPE_BLOB)
+        if self._open_blobs:
+            ws._cork()
+            ws._park(header, None)
+        else:
+            self._push(header, None)
+        self._open_blobs.append(ws)
+        return ws
+
+    def finalize(self, on_flush: OnDone = None) -> None:
+        """Graceful end of session: after the queue drains, :meth:`read`
+        reports EOF (reference: encode.js:119-122)."""
+        if self.destroyed:
+            raise EncoderDestroyedError("finalize after destroy")
+        if self._open_blobs:
+            raise EncoderDestroyedError(
+                f"finalize with {len(self._open_blobs)} blob(s) still open"
+            )
+        self.finalized = True
+        self._finalize_cb = on_flush
+        if not self._queue and on_flush is not None:
+            cb, self._finalize_cb = self._finalize_cb, None
+            cb()
+        if self._on_readable is not None:
+            self._on_readable()  # let a connected pump observe EOF
+
+    def read(self, max_bytes: int = -1) -> bytes | None:
+        """Pull up to ``max_bytes`` of wire data (all buffered if -1).
+
+        Returns ``b''`` when nothing is buffered yet, or ``None`` for EOF
+        (finalized and fully drained). Firing of ``on_flush`` callbacks is
+        tied to their bytes leaving this buffer — the pull-based analogue of
+        the reference's `_read`-driven drain (reference: encode.js:147-151).
+        """
+        if self.destroyed:
+            raise EncoderDestroyedError("read after destroy")
+        if not self._queue:
+            if self.finalized:
+                return None
+            return b""
+        out = bytearray()
+        fired: list[Callable[[], None]] = []
+        while self._queue and (max_bytes < 0 or len(out) < max_bytes):
+            payload, cb = self._queue[0]
+            room = len(payload) if max_bytes < 0 else max_bytes - len(out)
+            if len(payload) <= room:
+                out += payload
+                self._queue.popleft()
+                self._queued_bytes -= len(payload)
+                if cb is not None:
+                    fired.append(cb)
+            else:
+                out += payload[:room]
+                self._queue[0] = (payload[room:], cb)
+                self._queued_bytes -= room
+                break
+        below = not self._above_high_water()
+        for cb in fired:
+            cb()
+        if below and self._drain_cbs:
+            cbs, self._drain_cbs = self._drain_cbs, []
+            for cb in cbs:
+                cb()
+        if self.finalized and not self._queue and self._finalize_cb is not None:
+            cb, self._finalize_cb = self._finalize_cb, None
+            cb()
+        return bytes(out)
+
+    @property
+    def buffered_bytes(self) -> int:
+        return self._queued_bytes
+
+    def writable(self) -> bool:
+        return not self._above_high_water()
+
+    def on_drain(self, cb: Callable[[], None]) -> None:
+        """One-shot callback when the buffer falls below the high-water mark."""
+        if self._above_high_water():
+            self._drain_cbs.append(cb)
+        else:
+            cb()
+
+    def on_error(self, cb: Callable[[Exception | None], None]) -> None:
+        self._error_cbs.append(cb)
+
+    def destroy(self, err: Exception | None = None) -> None:
+        """Fail-fast teardown: destroys every open blob writer
+        (reference: encode.js:69-75)."""
+        if self.destroyed:
+            return
+        self.destroyed = True
+        for ws in list(self._open_blobs):
+            ws.destroyed = True
+        self._open_blobs.clear()
+        self._queue.clear()
+        self._queued_bytes = 0
+        self._parked_bytes = 0
+        self._parked_changes.clear()
+        for cb in self._error_cbs:
+            cb(err)
+        # Release parked drain callbacks so a producer gated on the drain
+        # signal wakes up and observes the destroyed state (mirrors the
+        # decoder releasing its parked write callbacks on destroy).
+        cbs, self._drain_cbs = self._drain_cbs, []
+        for cb in cbs:
+            cb()
+
+    # -- internal -----------------------------------------------------------
+
+    def _above_high_water(self) -> bool:
+        return self._queued_bytes + self._parked_bytes >= self._high_water
+
+    def _push(self, data, on_consumed: OnDone) -> bool:
+        data = bytes(data)
+        self.bytes += len(data)
+        self._queue.append((data, on_consumed))
+        self._queued_bytes += len(data)
+        if self._on_readable is not None:
+            self._on_readable()
+        return not self._above_high_water()
+
+    def _after_flush(self, cb: Callable[[], None]) -> None:
+        """Run ``cb`` once everything currently queued has been read."""
+        if not self._queue:
+            cb()
+            return
+        payload, prev = self._queue[-1]
+        if prev is None:
+            self._queue[-1] = (payload, cb)
+        else:
+            def both(a=prev, b=cb):
+                a()
+                b()
+            self._queue[-1] = (payload, both)
+
+    def _blob_finished(self, ws: BlobWriter) -> None:
+        """Head-of-line blob completed: uncork the next and replay parked
+        changes (which re-park if blobs remain) — reference: encode.js:92-97."""
+        if not self._open_blobs or self._open_blobs[0] is not ws:
+            err = AssertionError("blob FIFO assertion failed")
+            self.destroy(err)
+            raise err
+        self._open_blobs.popleft()
+        if self._open_blobs:
+            self._open_blobs[0]._uncork()
+        parked, self._parked_changes = self._parked_changes, []
+        for payload, cb in parked:
+            if self._open_blobs:  # a later blob is still open: stay parked
+                self._parked_changes.append((payload, cb))
+            else:
+                self._parked_bytes -= len(payload)
+                self._frame_change(payload, cb)

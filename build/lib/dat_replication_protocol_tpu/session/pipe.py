@@ -1,0 +1,78 @@
+"""In-process loopback pump connecting an Encoder to a Decoder.
+
+The reference wires its two ends with Node's ``encode.pipe(decode)``
+(reference: example.js:53, test/basic.js:29); loopback piping is also how its
+whole test suite exercises the wire format without a socket
+(reference: test/basic.js — every test). This module is the Python analogue:
+a reactive pump that honors both sides' backpressure without an event loop.
+
+``pipe(encoder, decoder)`` drives bytes until EOF. If the decoder stalls on
+an outstanding app ``done``, the pump parks itself and continues when the app
+drains; ``pipe`` returns once everything written *so far* has been pushed
+(the session finishes when the app releases the last ``done``).
+"""
+
+from __future__ import annotations
+
+from .decoder import Decoder
+from .encoder import Encoder
+
+DEFAULT_CHUNK = 64 * 1024
+
+
+class Pipe:
+    """Reactive pump with backpressure in both directions."""
+
+    def __init__(self, encoder: Encoder, decoder: Decoder, chunk_size: int = DEFAULT_CHUNK):
+        self.encoder = encoder
+        self.decoder = decoder
+        self.chunk_size = chunk_size
+        self._pumping = False
+        self._eof_sent = False
+
+    @property
+    def done(self) -> bool:
+        """True once the session fully completed (or tore down) — live view,
+        so a finalize handler acking late still flips this."""
+        return (
+            self.decoder.finished or self.decoder.destroyed or self.encoder.destroyed
+        )
+
+    def pump(self) -> bool:
+        """Move bytes until the source is dry, the sink stalls, or EOF.
+        Returns True when the session fully completed."""
+        if self._pumping or self.done or self._eof_sent:
+            return self.done
+        self._pumping = True
+        try:
+            while True:
+                if self.decoder.destroyed or self.encoder.destroyed:
+                    break
+                if not self.decoder.writable():
+                    # Park: continue pumping when the app drains the decoder.
+                    self.decoder._write_cbs.append(self._on_drain)
+                    break
+                data = self.encoder.read(self.chunk_size)
+                if data is None:  # EOF
+                    self._eof_sent = True
+                    self.decoder.end()
+                    break
+                if not data:
+                    break  # source dry (caller will pump() again after writes)
+                self.decoder.write(data)
+        finally:
+            self._pumping = False
+        return self.done
+
+    def _on_drain(self) -> None:
+        self.pump()
+
+
+def pipe(encoder: Encoder, decoder: Decoder, chunk_size: int = DEFAULT_CHUNK) -> Pipe:
+    """Connect and start pumping. Call after setting up handlers and writes,
+    or call ``p.pump()`` again after late writes (mirrors that Node pipes are
+    pull-driven and keep flowing as more data is produced)."""
+    p = Pipe(encoder, decoder, chunk_size)
+    encoder._on_readable = p.pump
+    p.pump()
+    return p

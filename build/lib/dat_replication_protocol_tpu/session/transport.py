@@ -1,0 +1,204 @@
+"""Byte-transport adapters: run a session over real OS byte streams.
+
+The reference's L0 is *any* Node stream — its two ends meet a TCP socket,
+a pipe, or a file equally well via ``encode.pipe(socket)`` /
+``socket.pipe(decode)`` (reference: example.js:53), with backpressure
+propagating end-to-end through the stream machinery
+(reference: decode.js:87-99,168 -> Writable cb withheld -> pipe pauses ->
+encode.js:139-151 drain).  This module is the Python analogue for the
+pull-based Encoder / push-based Decoder: blocking pump loops that move
+wire bytes across a socket or file descriptor while honoring both sides'
+flow control.
+
+How backpressure crosses the OS boundary:
+
+* **Sender**: :func:`send_over` pulls from :meth:`Encoder.read` and writes
+  to the transport.  A full kernel send buffer blocks the write, which
+  stops the pull, which leaves the encoder's queue above its high-water
+  mark, which makes producer ``write()`` calls return ``False`` — the
+  app-visible stall.
+* **Receiver**: :func:`recv_over` stops reading from the transport
+  whenever :meth:`Decoder.write` reports a stall (an outstanding app
+  ``done``), resuming on the parked write-completion callback.  While it
+  is not reading, the kernel receive buffer fills, the peer's sends
+  block, and the stall propagates back to the producer — exactly the
+  reference's end-to-end valve, with the OS socket buffers as the pipe.
+
+The pumps are blocking by design (run each in a thread, or a process per
+end): a session end is single-threaded state, so each pump owns its end
+and apps must issue ``done`` acks from the delivering thread or an
+external serializer.  :func:`session_over_socketpair` wires two ends of
+an in-process socketpair for tests and examples; the conformance suite
+also runs the encoder in a *separate process* over a pipe
+(tests/test_transport.py), crossing a real process boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Callable
+
+from .decoder import Decoder, DecoderDestroyedError
+from .encoder import Encoder, EncoderDestroyedError
+
+DEFAULT_CHUNK = 64 * 1024
+
+
+def send_over(
+    encoder: Encoder,
+    write_bytes: Callable[[bytes], None],
+    close: Callable[[], None] | None = None,
+    chunk_size: int = DEFAULT_CHUNK,
+) -> None:
+    """Pump ``encoder`` to a blocking byte sink until EOF or destroy.
+
+    ``write_bytes`` must block when the transport is congested (that is
+    the backpressure).  ``close`` (e.g. ``sock.shutdown(SHUT_WR)``) runs
+    on the way out so the peer observes EOF.
+    """
+    readable = threading.Event()
+    encoder._on_readable = readable.set
+    encoder.on_error(lambda _e: readable.set())
+    try:
+        while True:
+            try:
+                data = encoder.read(chunk_size)
+            except EncoderDestroyedError:
+                break
+            if data is None:  # finalized and drained
+                break
+            if not data:
+                readable.wait()
+                readable.clear()
+                continue
+            write_bytes(bytes(data))
+    finally:
+        if close is not None:
+            try:
+                close()
+            except OSError:
+                pass
+
+
+def recv_over(
+    decoder: Decoder,
+    read_bytes: Callable[[int], bytes],
+    chunk_size: int = DEFAULT_CHUNK,
+) -> None:
+    """Pump a blocking byte source into ``decoder`` until EOF or destroy.
+
+    ``read_bytes(n)`` returns up to n bytes, or ``b''`` at EOF.  When the
+    decoder stalls on an outstanding app ``done``, reading is suspended
+    until the parked write-completion callback fires — so the kernel
+    receive buffer (not host RAM) absorbs the in-flight window and the
+    peer's sends eventually block.
+    """
+    while not decoder.destroyed:
+        data = read_bytes(chunk_size)
+        if not data:
+            if not decoder.destroyed and not decoder.finished:
+                decoder.end()
+            return
+        drained = threading.Event()
+        try:
+            consumed = decoder.write(data, on_consumed=drained.set)
+        except DecoderDestroyedError:
+            return
+        if not consumed:
+            # bounded-poll instead of a bare wait: a done() ack landing
+            # on another thread between the decoder's stall check and the
+            # callback parking can drain the decoder without firing our
+            # event (the session objects are single-threaded state; the
+            # transport is where cross-thread acks meet them), so
+            # re-check writability on a short period rather than hanging
+            # on a wakeup that may have been lost
+            while not (decoder.writable() or decoder.destroyed
+                       or decoder.finished):
+                drained.wait(0.05)
+                drained.clear()
+
+
+# -- socket / fd bindings ----------------------------------------------------
+
+
+def send_over_socket(encoder: Encoder, sock: socket.socket,
+                     chunk_size: int = DEFAULT_CHUNK) -> None:
+    send_over(
+        encoder,
+        sock.sendall,
+        close=lambda: sock.shutdown(socket.SHUT_WR),
+        chunk_size=chunk_size,
+    )
+
+
+def recv_over_socket(decoder: Decoder, sock: socket.socket,
+                     chunk_size: int = DEFAULT_CHUNK) -> None:
+    recv_over(decoder, sock.recv, chunk_size=chunk_size)
+
+
+def send_over_fd(encoder: Encoder, fd: int,
+                 chunk_size: int = DEFAULT_CHUNK) -> None:
+    def write_all(data: bytes) -> None:
+        view = memoryview(data)
+        while view:
+            n = os.write(fd, view)
+            view = view[n:]
+
+    send_over(encoder, write_all, close=lambda: os.close(fd),
+              chunk_size=chunk_size)
+
+
+def recv_over_fd(decoder: Decoder, fd: int,
+                 chunk_size: int = DEFAULT_CHUNK) -> None:
+    recv_over(decoder, lambda n: os.read(fd, n), chunk_size=chunk_size)
+
+
+class SocketSession:
+    """Both ends of a session wired through an OS socketpair.
+
+    The in-process stand-in for the reference's
+    ``encode.pipe(socket) ... socket.pipe(decode)`` wiring: unlike
+    :class:`.pipe.Pipe` (a same-call-stack loopback), every byte crosses
+    the kernel, both pump loops run on their own threads, and flow
+    control is exercised against real, bounded socket buffers.
+    """
+
+    def __init__(self, encoder: Encoder, decoder: Decoder,
+                 chunk_size: int = DEFAULT_CHUNK,
+                 sndbuf: int | None = None):
+        self.encoder = encoder
+        self.decoder = decoder
+        self._a, self._b = socket.socketpair()
+        if sndbuf is not None:
+            # shrink the kernel window so tests can observe stalls with
+            # modest payloads
+            self._a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, sndbuf)
+            self._b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, sndbuf)
+        self._sender = threading.Thread(
+            target=send_over_socket, args=(encoder, self._a, chunk_size),
+            daemon=True,
+        )
+        self._receiver = threading.Thread(
+            target=recv_over_socket, args=(decoder, self._b, chunk_size),
+            daemon=True,
+        )
+        self._sender.start()
+        self._receiver.start()
+
+    def wait(self, timeout: float | None = 30.0) -> None:
+        """Join both pumps (the session is over when both return)."""
+        self._sender.join(timeout)
+        self._receiver.join(timeout)
+        if self._sender.is_alive() or self._receiver.is_alive():
+            raise TimeoutError("transport pumps did not finish")
+        self._a.close()
+        self._b.close()
+
+
+def session_over_socketpair(encoder: Encoder, decoder: Decoder,
+                            chunk_size: int = DEFAULT_CHUNK,
+                            sndbuf: int | None = None) -> SocketSession:
+    """Start pumping ``encoder -> kernel socketpair -> decoder``."""
+    return SocketSession(encoder, decoder, chunk_size, sndbuf)

@@ -1,0 +1,1 @@
+from .trace import span, trace_to  # noqa: F401

@@ -1,0 +1,17 @@
+"""Small numeric helpers shared across layers."""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1).
+
+    THE padding policy: batch axes, block counts, and mesh shards all
+    round up with this one function — Merkle-root comparability between
+    replicas depends on both sides padding identically, so the policy
+    must have exactly one implementation.
+    """
+    p = 1
+    while p < n:
+        p <<= 1
+    return p

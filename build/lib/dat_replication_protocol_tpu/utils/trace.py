@@ -1,0 +1,56 @@
+"""Profiling spans around host->device dispatch boundaries.
+
+The reference has no tracing at all — only passive byte/frame counters
+(reference: encode.js:51-53, decode.js:68-70).  At device scale that is
+not enough: round 2 shipped a ~2000x CDC regression that a single trace
+would have localized in minutes (the cost was H2D staging, not the
+kernel).  SURVEY.md §5 therefore promises `jax.profiler` spans around
+every dispatch; this module is that hook.
+
+* :func:`span` — named annotation context.  Wrap host-side phases
+  (packing, dispatch, collect) so they show up on the TraceViewer
+  timeline next to the device ops.  Uses
+  ``jax.profiler.TraceAnnotation``; ~ns overhead when no trace is
+  active, so call sites leave it on unconditionally.
+* :func:`trace_to` — whole-program capture into a profile directory
+  (``bench.py --trace=DIR`` uses it; open with TensorBoard or Perfetto).
+
+JAX is imported lazily: the session layer must stay importable (and
+fast) in processes that never touch a device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def span(name: str):
+    """Named profiler annotation; inert if jax is unavailable."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:
+        return _NullSpan()
+    return TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def trace_to(log_dir: str | None):
+    """Capture a jax profiler trace into ``log_dir`` (no-op if None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

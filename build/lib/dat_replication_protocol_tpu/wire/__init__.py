@@ -1,0 +1,32 @@
+"""L1/L3 wire layer: varint, framing, and the Change protobuf codec."""
+
+from .change_codec import Change, decode_change, encode_change
+from .framing import (
+    KNOWN_TYPES,
+    MAX_HEADER_LEN,
+    TYPE_BLOB,
+    TYPE_CHANGE,
+    TYPE_HEADER,
+    ProtocolError,
+    frame,
+    frame_header,
+)
+from .varint import NeedMoreData, decode_uvarint, encode_uvarint, uvarint_length
+
+__all__ = [
+    "Change",
+    "decode_change",
+    "encode_change",
+    "KNOWN_TYPES",
+    "MAX_HEADER_LEN",
+    "TYPE_BLOB",
+    "TYPE_CHANGE",
+    "TYPE_HEADER",
+    "ProtocolError",
+    "frame",
+    "frame_header",
+    "NeedMoreData",
+    "decode_uvarint",
+    "encode_uvarint",
+    "uvarint_length",
+]

@@ -1,0 +1,191 @@
+"""The ``Change`` record and its protobuf (proto2) wire codec.
+
+Capability parity: the reference compiles `messages/schema.proto` at require
+time via the `protocol-buffers` npm package (reference: messages/index.js:5)
+and defines one message (reference: messages/schema.proto:1-8)::
+
+    message Change {
+      optional string subset = 1;
+      required string key    = 2;
+      required uint32 change = 3;
+      required uint32 from   = 4;
+      required uint32 to     = 5;
+      optional bytes  value  = 6;
+    }
+
+Semantics: row ``key`` moved from version ``from`` to version ``to`` by change
+sequence number ``change``, carrying the new ``value``, optionally scoped to a
+``subset`` (a sub-dataset). Decoded absent optionals default to ``''``/``b''``
+— the reference conformance suite asserts ``subset: ''`` on a change encoded
+without one (reference: test/basic.js:10-17).
+
+This is a hand-rolled, dependency-free proto2 codec for exactly this message,
+byte-compatible with standard protobuf encoders (fields emitted in ascending
+field-number order, absent optionals omitted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .varint import NeedMoreData, decode_uvarint, encode_uvarint
+
+_UINT32_MAX = 0xFFFFFFFF
+
+# Precomputed proto2 tags: (field_number << 3) | wire_type
+_TAG_SUBSET = (1 << 3) | 2  # len-delimited
+_TAG_KEY = (2 << 3) | 2  # len-delimited
+_TAG_CHANGE = (3 << 3) | 0  # varint
+_TAG_FROM = (4 << 3) | 0  # varint
+_TAG_TO = (5 << 3) | 0  # varint
+_TAG_VALUE = (6 << 3) | 2  # len-delimited
+
+
+@dataclasses.dataclass
+class Change:
+    """One replicated row mutation.
+
+    ``from_`` / ``to`` carry the version transition (named with a trailing
+    underscore because ``from`` is a Python keyword; dict conversion uses the
+    wire names).
+    """
+
+    key: str
+    change: int
+    from_: int
+    to: int
+    value: bytes | None = None
+    subset: str | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Change":
+        if "from" in d:
+            from_ = d["from"]
+        elif "from_" in d:
+            from_ = d["from_"]
+        else:
+            raise KeyError("from")  # required field, same as 'key'/'to'
+        return cls(
+            key=d["key"],
+            change=d["change"],
+            from_=from_,
+            to=d["to"],
+            value=d.get("value"),
+            subset=d.get("subset"),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "subset": self.subset,
+            "key": self.key,
+            "change": self.change,
+            "from": self.from_,
+            "to": self.to,
+            "value": self.value,
+        }
+
+
+def _check_uint32(name: str, v: int) -> int:
+    if not isinstance(v, int) or v < 0 or v > _UINT32_MAX:
+        raise ValueError(f"Change.{name} must be a uint32, got {v!r}")
+    return v
+
+
+def encode_change(change: Change | dict) -> bytes:
+    """Serialize a Change to protobuf bytes (proto2 wire format)."""
+    if isinstance(change, dict):
+        change = Change.from_dict(change)
+    out = bytearray()
+    if change.subset is not None:
+        raw = change.subset.encode("utf-8")
+        out.append(_TAG_SUBSET)
+        out += encode_uvarint(len(raw))
+        out += raw
+    if change.key is None:
+        raise ValueError("Change.key is required")
+    raw = change.key.encode("utf-8")
+    out.append(_TAG_KEY)
+    out += encode_uvarint(len(raw))
+    out += raw
+    out.append(_TAG_CHANGE)
+    out += encode_uvarint(_check_uint32("change", change.change))
+    out.append(_TAG_FROM)
+    out += encode_uvarint(_check_uint32("from", change.from_))
+    out.append(_TAG_TO)
+    out += encode_uvarint(_check_uint32("to", change.to))
+    if change.value is not None:
+        out.append(_TAG_VALUE)
+        out += encode_uvarint(len(change.value))
+        out += bytes(change.value)
+    return bytes(out)
+
+
+def decode_change(buf) -> Change:
+    """Parse protobuf bytes into a Change.
+
+    Unknown fields are skipped (proto2 semantics). Missing required fields
+    raise ``ValueError``; absent optionals default to ``''`` / ``b''``
+    (matching what the reference suite observes for ``subset``,
+    reference: test/basic.js:16).
+    """
+    buf = memoryview(buf)
+    n = len(buf)
+    i = 0
+    subset: str | None = None
+    key: str | None = None
+    change_seq: int | None = None
+    from_: int | None = None
+    to: int | None = None
+    value: bytes | None = None
+    try:
+        while i < n:
+            tag, used = decode_uvarint(buf, i)
+            i += used
+            wire_type = tag & 7
+            if wire_type == 0:  # varint
+                v, used = decode_uvarint(buf, i)
+                i += used
+                # proto2 uint32 semantics: a wider varint from a foreign
+                # encoder truncates to the low 32 bits (keeps this path
+                # bit-identical with the native columnar decoder)
+                if tag == _TAG_CHANGE:
+                    change_seq = v & _UINT32_MAX
+                elif tag == _TAG_FROM:
+                    from_ = v & _UINT32_MAX
+                elif tag == _TAG_TO:
+                    to = v & _UINT32_MAX
+            elif wire_type == 2:  # length-delimited
+                ln, used = decode_uvarint(buf, i)
+                i += used
+                if i + ln > n:
+                    raise NeedMoreData("truncated length-delimited field")
+                raw = bytes(buf[i : i + ln])
+                i += ln
+                if tag == _TAG_SUBSET:
+                    subset = raw.decode("utf-8")
+                elif tag == _TAG_KEY:
+                    key = raw.decode("utf-8")
+                elif tag == _TAG_VALUE:
+                    value = raw
+            elif wire_type == 5:  # fixed32 (unknown field skip)
+                if i + 4 > n:
+                    raise NeedMoreData("truncated fixed32 field")
+                i += 4
+            elif wire_type == 1:  # fixed64 (unknown field skip)
+                if i + 8 > n:
+                    raise NeedMoreData("truncated fixed64 field")
+                i += 8
+            else:
+                raise ValueError(f"unsupported protobuf wire type {wire_type}")
+    except NeedMoreData as e:
+        raise ValueError(f"corrupt Change payload: {e}") from e
+    if key is None or change_seq is None or from_ is None or to is None:
+        raise ValueError("Change payload missing required fields")
+    return Change(
+        key=key,
+        change=change_seq,
+        from_=from_,
+        to=to,
+        value=value if value is not None else b"",
+        subset=subset if subset is not None else "",
+    )

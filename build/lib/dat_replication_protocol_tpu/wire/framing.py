@@ -1,0 +1,49 @@
+"""Multibuffer framing — the L1 wire codec.
+
+Every frame on the wire is (reference: README.md:63-71)::
+
+    | varint( len(payload) + 1 ) | 1-byte type id | payload |
+
+The framed length counts the id byte, which is why the decoder subtracts one
+when computing how many payload bytes follow (reference: decode.js:255).
+
+Type ids (reference: encode.js:112 / decode.js:151,155; 0 is reserved for
+"scanning a header"):
+"""
+
+from __future__ import annotations
+
+from .varint import MAX_VARINT_LEN, encode_uvarint
+
+TYPE_HEADER = 0  # parser state only; never a valid frame id
+TYPE_CHANGE = 1
+TYPE_BLOB = 2
+
+KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB)
+
+# Upper bound on header size: 10 varint bytes + 1 id byte.
+MAX_HEADER_LEN = MAX_VARINT_LEN + 1
+
+
+def frame_header(payload_len: int, type_id: int) -> bytes:
+    """Build the wire header for a frame with ``payload_len`` payload bytes.
+
+    The reference amortizes header allocation through a shared 65536-byte pool
+    (reference: encode.js:6-7,124-137); in Python small-bytes construction is
+    already pooled by the allocator, so the header is built directly.
+    """
+    return encode_uvarint(payload_len + 1) + bytes((type_id,))
+
+
+def frame(type_id: int, payload: bytes) -> bytes:
+    """A complete frame: header + payload. Used by tests and golden fixtures."""
+    return frame_header(len(payload), type_id) + payload
+
+
+class ProtocolError(Exception):
+    """Raised (and passed to destroy) on malformed wire data.
+
+    The reference's sole detected fault is an unknown type id
+    (reference: decode.js:159-161); this codec also rejects oversized varint
+    headers.
+    """
