@@ -290,6 +290,13 @@ def bench_replay(quick: bool, backend: str) -> dict:
     edt = time.perf_counter() - t0
     assert wire == block * enc_reps
     enc_rows = len(big)
+
+    # columnar re-encode (replay_log's exact inverse, zero Python/row):
+    # the decoded columns of the full log straight back to wire bytes
+    t0 = time.perf_counter()
+    cwire = replay.encode_change_columns(cols)
+    cdt = time.perf_counter() - t0
+    assert len(cwire) == log_buf.nbytes
     return {
         "metric": "change_log_replay_rate",
         "value": round(total_rows / dt, 0),
@@ -299,6 +306,7 @@ def bench_replay(quick: bool, backend: str) -> dict:
         "rows": total_rows,
         "log_mib": round(log_buf.nbytes / (1 << 20), 1),
         "encode_rows_s": round(enc_rows / edt, 0),
+        "encode_columns_rows_s": round(total_rows / cdt, 0),
     }
 
 

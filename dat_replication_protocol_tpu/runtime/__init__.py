@@ -6,6 +6,8 @@ from .replay import (
     ChangeColumns,
     FrameIndex,
     decode_change_columns,
+    encode_change_columns,
+    encode_change_log,
     replay_log,
     split_frames,
 )
@@ -16,6 +18,8 @@ __all__ = [
     "FrameIndex",
     "content_address",
     "decode_change_columns",
+    "encode_change_columns",
+    "encode_change_log",
     "delta",
     "reassemble",
     "replay_log",

@@ -285,3 +285,38 @@ def test_encode_change_log_python_fallback_identical(monkeypatch):
     monkeypatch.setattr(native, "get_lib", lambda: None)
     without = replay.encode_change_log(records)
     assert with_native == without
+
+
+def test_encode_change_columns_roundtrips_byte_exact(monkeypatch):
+    # wire -> replay_log -> encode_change_columns must reproduce the
+    # change frames byte-for-byte (native and Python paths both)
+    from dat_replication_protocol_tpu.runtime import replay
+    from dat_replication_protocol_tpu.wire.change_codec import Change, encode_change
+    from dat_replication_protocol_tpu.wire.framing import TYPE_CHANGE, frame
+
+    recs = [
+        Change(key=f"k{i}", change=i, from_=i, to=i + 1,
+               value=(b"v%d" % i) * (i % 7) if i % 3 else None,
+               subset="" if i % 5 == 0 else ("s%d" % i if i % 2 else None))
+        for i in range(500)
+    ]
+    wire = b"".join(frame(TYPE_CHANGE, encode_change(c)) for c in recs)
+    cols, _ = replay.replay_log(np.frombuffer(wire, np.uint8))
+    assert replay.encode_change_columns(cols) == wire
+    # Python fallback path agrees
+    monkeypatch.setattr(replay.native, "get_lib", lambda: None)
+    assert replay.encode_change_columns(cols) == wire
+
+
+def test_encode_change_columns_mixed_log_keeps_changes_only():
+    from dat_replication_protocol_tpu.runtime import replay
+    from dat_replication_protocol_tpu.wire.change_codec import Change, encode_change
+    from dat_replication_protocol_tpu.wire.framing import TYPE_BLOB, TYPE_CHANGE, frame
+
+    c1 = frame(TYPE_CHANGE, encode_change(Change(key="a", change=1, from_=0, to=1)))
+    blob = frame(TYPE_BLOB, b"\x01\x02\x03\x04")
+    c2 = frame(TYPE_CHANGE, encode_change(Change(key="b", change=2, from_=1, to=2)))
+    cols, _ = replay.replay_log(np.frombuffer(c1 + blob + c2, np.uint8))
+    assert replay.encode_change_columns(cols) == c1 + c2
+    empty_cols, _ = replay.replay_log(np.frombuffer(blob, np.uint8))
+    assert replay.encode_change_columns(empty_cols) == b""
