@@ -297,6 +297,53 @@ def diff_leaves(a_digests: list[bytes], b_digests: list[bytes]) -> list[int]:
     return np.nonzero(np.asarray(mask)[: len(a_digests)])[0].tolist()
 
 
+def prove(levels_hh, levels_hl, idx: int) -> list[bytes]:
+    """Inclusion proof for leaf ``idx``: the sibling digest per level.
+
+    ``levels_hh/hl``: the tuples from :func:`build_tree`.  The path has
+    log2(N) 32-byte siblings, bottom-up; verification needs only the
+    root (:func:`verify_proof`) — the content-addressed audit primitive
+    a replica uses to check a single record against a snapshot root
+    without holding the snapshot (the reference leaves all verification
+    to dat core above the wire; here it rides the device-built tree).
+    Only the log2(N) sibling rows cross D2H.
+    """
+    n = levels_hh[0].shape[0]
+    if not 0 <= idx < n:
+        raise IndexError(f"leaf {idx} out of range [0, {n})")
+    nlev = len(levels_hh) - 1
+    if nlev == 0:
+        return []
+    # gather all log2(N) sibling rows on device, one D2H transfer (per-
+    # level fetches would pay one round trip each — latency-dominant on
+    # a tunneled link)
+    sib_hh = jnp.concatenate(
+        [levels_hh[lvl][((idx >> lvl) ^ 1)][None] for lvl in range(nlev)]
+    )
+    sib_hl = jnp.concatenate(
+        [levels_hl[lvl][((idx >> lvl) ^ 1)][None] for lvl in range(nlev)]
+    )
+    return digests_from_device(sib_hh, sib_hl)
+
+
+def verify_proof(root: bytes, leaf: bytes, idx: int,
+                 path: list[bytes]) -> bool:
+    """Check an inclusion proof against a 32-byte root (host, hashlib).
+
+    ``idx`` must lie in the tree the path describes: indices outside
+    [0, 2**len(path)) would alias mod the tree width (only the low
+    bits steer the walk), letting a forged claim verify at a
+    nonexistent position — rejected, not masked.
+    """
+    if not 0 <= idx < (1 << len(path)):
+        return False
+    node = leaf
+    for lvl, sib in enumerate(path):
+        bit = (idx >> lvl) & 1
+        node = host_parent(sib, node) if bit else host_parent(node, sib)
+    return node == root
+
+
 # ---------------------------------------------------------------------------
 # host reference (for tests)
 # ---------------------------------------------------------------------------

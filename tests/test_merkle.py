@@ -180,3 +180,44 @@ def test_diff_tiny_trees(n):
     (rb,) = merkle.digests_from_device(rbhh, rbhl)
     assert ra == merkle.host_tree(a)[-1][0]
     assert rb == merkle.host_tree(b)[-1][0]
+
+
+def test_inclusion_proofs_verify_and_reject_tampering():
+    leaves = _leaves(64, seed=9)
+    hh, hl = merkle.digests_to_device(leaves)
+    levels = merkle.build_tree(hh, hl)
+    (root_bytes,) = merkle.digests_from_device(levels[0][-1], levels[1][-1])
+    for idx in (0, 1, 31, 62, 63):
+        path = merkle.prove(levels[0], levels[1], idx)
+        assert len(path) == 6
+        assert merkle.verify_proof(root_bytes, leaves[idx], idx, path)
+        # wrong leaf, wrong index, tampered sibling all fail
+        assert not merkle.verify_proof(root_bytes, leaves[idx ^ 1], idx, path)
+        assert not merkle.verify_proof(root_bytes, leaves[idx], idx ^ 1, path)
+        bad = list(path)
+        bad[3] = bytes(32)
+        assert not merkle.verify_proof(root_bytes, leaves[idx], idx, bad)
+
+
+def test_proof_single_leaf_tree():
+    leaves = _leaves(1)
+    hh, hl = merkle.digests_to_device(leaves)
+    levels = merkle.build_tree(hh, hl)
+    (root_bytes,) = merkle.digests_from_device(levels[0][-1], levels[1][-1])
+    assert merkle.prove(levels[0], levels[1], 0) == []
+    assert merkle.verify_proof(root_bytes, leaves[0], 0, [])
+    with pytest.raises(IndexError):
+        merkle.prove(levels[0], levels[1], 1)
+
+
+def test_proof_rejects_out_of_range_index():
+    leaves = _leaves(64, seed=13)
+    hh, hl = merkle.digests_to_device(leaves)
+    levels = merkle.build_tree(hh, hl)
+    (root_bytes,) = merkle.digests_from_device(levels[0][-1], levels[1][-1])
+    path = merkle.prove(levels[0], levels[1], 0)
+    assert merkle.verify_proof(root_bytes, leaves[0], 0, path)
+    # aliasing indices (0 mod 64) and negatives must NOT verify
+    assert not merkle.verify_proof(root_bytes, leaves[0], 64, path)
+    assert not merkle.verify_proof(root_bytes, leaves[0], 128, path)
+    assert not merkle.verify_proof(root_bytes, leaves[63], -1, path)
