@@ -327,15 +327,22 @@ def prove(levels_hh, levels_hl, idx: int) -> list[bytes]:
 
 
 def verify_proof(root: bytes, leaf: bytes, idx: int,
-                 path: list[bytes]) -> bool:
+                 path: list[bytes], nleaves: int) -> bool:
     """Check an inclusion proof against a 32-byte root (host, hashlib).
 
-    ``idx`` must lie in the tree the path describes: indices outside
-    [0, 2**len(path)) would alias mod the tree width (only the low
-    bits steer the walk), letting a forged claim verify at a
-    nonexistent position — rejected, not masked.
+    ``nleaves`` is the tree width the verifier expects (it knows the
+    snapshot's size alongside its root) and is load-bearing, not
+    advisory: without it, (a) an attacker-chosen shorter path would
+    bind against the *subtree* an interior node roots — any interior
+    digest would "verify" as a leaf (second-preimage aliasing; the
+    depth check pins len(path) to the padded tree height) — and (b)
+    indices would alias mod 2**len(path), verifying forged claims at
+    positions outside the snapshot.
     """
-    if not 0 <= idx < (1 << len(path)):
+    if nleaves <= 0 or not 0 <= idx < nleaves:
+        return False
+    depth = max(0, (int(nleaves) - 1)).bit_length()  # padded tree height
+    if len(path) != depth:
         return False
     node = leaf
     for lvl, sib in enumerate(path):

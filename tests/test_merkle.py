@@ -190,13 +190,13 @@ def test_inclusion_proofs_verify_and_reject_tampering():
     for idx in (0, 1, 31, 62, 63):
         path = merkle.prove(levels[0], levels[1], idx)
         assert len(path) == 6
-        assert merkle.verify_proof(root_bytes, leaves[idx], idx, path)
+        assert merkle.verify_proof(root_bytes, leaves[idx], idx, path, 64)
         # wrong leaf, wrong index, tampered sibling all fail
-        assert not merkle.verify_proof(root_bytes, leaves[idx ^ 1], idx, path)
-        assert not merkle.verify_proof(root_bytes, leaves[idx], idx ^ 1, path)
+        assert not merkle.verify_proof(root_bytes, leaves[idx ^ 1], idx, path, 64)
+        assert not merkle.verify_proof(root_bytes, leaves[idx], idx ^ 1, path, 64)
         bad = list(path)
         bad[3] = bytes(32)
-        assert not merkle.verify_proof(root_bytes, leaves[idx], idx, bad)
+        assert not merkle.verify_proof(root_bytes, leaves[idx], idx, bad, 64)
 
 
 def test_proof_single_leaf_tree():
@@ -205,7 +205,7 @@ def test_proof_single_leaf_tree():
     levels = merkle.build_tree(hh, hl)
     (root_bytes,) = merkle.digests_from_device(levels[0][-1], levels[1][-1])
     assert merkle.prove(levels[0], levels[1], 0) == []
-    assert merkle.verify_proof(root_bytes, leaves[0], 0, [])
+    assert merkle.verify_proof(root_bytes, leaves[0], 0, [], 1)
     with pytest.raises(IndexError):
         merkle.prove(levels[0], levels[1], 1)
 
@@ -216,8 +216,12 @@ def test_proof_rejects_out_of_range_index():
     levels = merkle.build_tree(hh, hl)
     (root_bytes,) = merkle.digests_from_device(levels[0][-1], levels[1][-1])
     path = merkle.prove(levels[0], levels[1], 0)
-    assert merkle.verify_proof(root_bytes, leaves[0], 0, path)
+    assert merkle.verify_proof(root_bytes, leaves[0], 0, path, 64)
     # aliasing indices (0 mod 64) and negatives must NOT verify
-    assert not merkle.verify_proof(root_bytes, leaves[0], 64, path)
-    assert not merkle.verify_proof(root_bytes, leaves[0], 128, path)
-    assert not merkle.verify_proof(root_bytes, leaves[63], -1, path)
+    assert not merkle.verify_proof(root_bytes, leaves[0], 64, path, 64)
+    assert not merkle.verify_proof(root_bytes, leaves[0], 128, path, 64)
+    assert not merkle.verify_proof(root_bytes, leaves[63], -1, path, 64)
+    # second-preimage aliasing: an INTERIOR node presented as a "leaf"
+    # with a truncated path must not verify (depth is pinned to nleaves)
+    interior = merkle.host_parent(leaves[0], leaves[1])
+    assert not merkle.verify_proof(root_bytes, interior, 0, path[1:], 64)
