@@ -1,0 +1,41 @@
+"""A session over asyncio streams: the event-loop transport.
+
+The asyncio analogue of examples/example_transport.py (reference
+semantics: example.js pipes both ends through any async stream).
+
+Run: JAX_PLATFORMS=cpu python examples/example_aio.py
+"""
+
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import dat_replication_protocol_tpu as protocol  # noqa: E402
+from dat_replication_protocol_tpu.session.aio import (  # noqa: E402
+    session_over_asyncio,
+)
+
+
+async def main() -> None:
+    enc, dec = protocol.encode(), protocol.decode()
+    dec.change(lambda c, done: (print(f"change: {c.key} v{c.from_}->{c.to}"),
+                                done()))
+    dec.blob(lambda b, done: b.collect(
+        lambda d: (print(f"blob: {d!r}"), done())))
+    dec.finalize(lambda done: (print("finalize"), done()))
+
+    enc.change({"key": "hello", "change": 1, "from": 0, "to": 1,
+                "value": b"world"})
+    ws = enc.blob(11)
+    ws.write(b"hello ")
+    ws.end(b"world")
+    enc.finalize()
+
+    await session_over_asyncio(enc, dec)
+    print(f"done: {dec.bytes} bytes, {dec.changes} changes, {dec.blobs} blobs")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
