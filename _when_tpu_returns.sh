@@ -18,9 +18,10 @@ def mk(chunk):
             jax.random.bits(kl, shape, dtype=jnp.uint32),
             jnp.full((8, chunk // 8), item_bytes, dtype=jnp.uint32))
 data = {c: mk(c) for c in (2048, 4096)}
-def run(tag, chunk, bi, ml):
+def run(tag, chunk, bi, ml, vs=False):
     mh, mlo, lens = data[chunk]
-    f = lambda: blake2b_native(mh, mlo, lens, block_items=bi, msg_loads=ml)
+    f = lambda: blake2b_native(mh, mlo, lens, block_items=bi, msg_loads=ml,
+                               vmem_state=vs)
     np.asarray(f()[0][:1, :1])
     dts = []
     for _ in range(3):
@@ -30,13 +31,25 @@ def run(tag, chunk, bi, ml):
         dts.append(time.perf_counter() - t0)
     g = chunk * item_bytes / statistics.median(dts) / (1 << 30)
     print(f"{tag}: {g:.2f} GiB/s (median of 3)", flush=True)
-variants = [("A c4096 bi1024 ml0", 4096, 1024, False),
-            ("K c4096 bi1024 ml1", 4096, 1024, True),
-            ("K2 c4096 bi2048 ml1", 4096, 2048, True),
-            ("K3 c2048 bi1024 ml1", 2048, 1024, True)]
+variants = [("A c4096 bi1024 ml0", 4096, 1024, False, False),
+            ("K c4096 bi1024 ml1", 4096, 1024, True, False),
+            ("K2 c4096 bi2048 ml1", 4096, 2048, True, False),
+            ("V c4096 bi1024 vmem", 4096, 1024, True, True),
+            ("V2 c4096 bi2048 vmem", 4096, 2048, True, True)]
+# correctness cross-check of the vmem_state variant on the real chip:
+# MIXED lengths below the 4-block input so the active/final/t_lo masks
+# all take both values under Mosaic (uniform 1 MiB lengths would leave
+# final always-false and active always-true)
+mh, mlo, lens = data[2048]
+mixed = jnp.arange(2048, dtype=jnp.uint32).reshape(8, 256) % jnp.uint32(513)
+ra = blake2b_native(mh[:4], mlo[:4], mixed, msg_loads=True)
+rb = blake2b_native(mh[:4], mlo[:4], mixed, msg_loads=True, vmem_state=True)
+assert np.array_equal(np.asarray(ra[0]), np.asarray(rb[0]))
+assert np.array_equal(np.asarray(ra[1]), np.asarray(rb[1]))
+print("vmem_state cross-check ok (mixed lengths)", flush=True)
 for rnd in range(2):
-    for tag, c, bi, ml in variants:
-        run(f"r{rnd} {tag}", c, bi, ml)
+    for tag, c, bi, ml, vs in variants:
+        run(f"r{rnd} {tag}", c, bi, ml, vs)
 PY
 # 2) profiler trace of the hash+cdc+merkle configs (quick shapes)
 BENCH_CONFIGS=3,4,5 timeout 900 python bench.py --quick --trace=/tmp/dat_trace 2>&1 | tail -3

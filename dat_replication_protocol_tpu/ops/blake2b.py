@@ -157,7 +157,7 @@ def _rounds_scanned(v, m, sigma=None):
 
 
 def compress_soa(h, m, t_lo, is_final, unroll: bool | None = None, sigma=None,
-                 t_hi=None):
+                 t_hi=None, lanes=None):
     """One BLAKE2b compression in SoA layout.
 
     ``h``: list of 8 (hi, lo) pairs of (B,) uint32 vectors; ``m``: list of
@@ -169,15 +169,25 @@ def compress_soa(h, m, t_lo, is_final, unroll: bool | None = None, sigma=None,
     ``unroll=None`` picks per backend: unrolled rounds on accelerators,
     scanned rounds on CPU (see the two round helpers).  Both are
     byte-exact RFC 7693.
+
+    ``lanes``: optional mutable container for the 16 working-vector
+    lanes (indexable get/set of (hi, lo) pairs — e.g. the Pallas
+    kernel's VMEM-scratch view).  The compression schedule then runs
+    against that storage instead of Python-list registers; unrolled
+    rounds only (the scanned form stacks arrays).
     """
     if unroll is None:
         unroll = jax.default_backend() != "cpu"
+    if lanes is not None and not unroll:
+        raise ValueError("a lanes container requires unrolled rounds")
     shape = t_lo.shape  # any batch shape: (B,) under scan, (8, B/8) in pallas
-    iv = [
-        (jnp.full(shape, _IV_HI[i], U32), jnp.full(shape, _IV_LO[i], U32))
-        for i in range(8)
-    ]
-    v = list(h) + iv
+    v = lanes if lanes is not None else [None] * 16
+    for i in range(8):
+        v[i] = h[i]
+        v[8 + i] = (
+            jnp.full(shape, _IV_HI[i], U32),
+            jnp.full(shape, _IV_LO[i], U32),
+        )
     v12_hi = v[12][0] if t_hi is None else v[12][0] ^ t_hi
     v[12] = (v12_hi, v[12][1] ^ t_lo)
     f = jnp.where(is_final, U32(0xFFFFFFFF), U32(0))

@@ -45,6 +45,34 @@ _LANE = 128
 _SUBLANE = 8
 
 
+class _RefLanes:
+    """Working-vector lanes resident in VMEM scratch, one load/store per
+    G access.
+
+    The 16 v-lanes (32 hi/lo u32 tiles) are the kernel's register
+    working set; together with message words they overflow the vector
+    register file (measured: doubling the tile width halves
+    throughput).  This view lets the unrolled rounds run unchanged
+    (``_g`` mutates ``v`` by Python indexing) while each lane's live
+    range shrinks to the G mixes that touch it — the scheduler chooses
+    VMEM traffic instead of spills.  Correctness relies on Pallas's
+    sequential in-kernel semantics: a G's stores are visible to the
+    next G's loads.
+    """
+
+    def __init__(self, vh_ref, vl_ref):
+        self._vh = vh_ref
+        self._vl = vl_ref
+
+    def __getitem__(self, i):
+        i = int(i)
+        return self._vh[i], self._vl[i]
+
+    def __setitem__(self, i, pair):
+        i = int(i)
+        self._vh[i], self._vl[i] = pair
+
+
 class _RefWords:
     """Lazy message-word view: ``m[w]`` issues the VMEM loads at use site.
 
@@ -68,8 +96,12 @@ class _RefWords:
 
 
 def _kernel(*refs, digest_size: int, unroll: bool = True,
-            msg_loads: bool = False):
-    if unroll:
+            msg_loads: bool = False, vmem_state: bool = False):
+    if vmem_state:
+        (len_ref, mh_ref, ml_ref, outh_ref, outl_ref,
+         sth_ref, stl_ref, vh_ref, vl_ref) = refs
+        sigma = None
+    elif unroll:
         len_ref, mh_ref, ml_ref, outh_ref, outl_ref, sth_ref, stl_ref = refs
         sigma = None
     else:
@@ -100,12 +132,14 @@ def _kernel(*refs, digest_size: int, unroll: bool = True,
     cap = (ju + U32(1)) << U32(7)
     t_lo = jnp.where(cap < lengths, cap, lengths)
 
-    h = [(sth_ref[w], stl_ref[w]) for w in range(8)]
     if msg_loads and unroll:
         m = _RefWords(mh_ref, ml_ref)
     else:
         m = [(mh_ref[0, w], ml_ref[0, w]) for w in range(16)]
-    nh = compress_soa(h, m, t_lo, final, unroll=unroll, sigma=sigma)
+    h = [(sth_ref[w], stl_ref[w]) for w in range(8)]
+    lanes = _RefLanes(vh_ref, vl_ref) if vmem_state else None
+    nh = compress_soa(h, m, t_lo, final, unroll=unroll, sigma=sigma,
+                      lanes=lanes)
     for w in range(8):
         sth_ref[w] = jnp.where(active, nh[w][0], h[w][0])
         stl_ref[w] = jnp.where(active, nh[w][1], h[w][1])
@@ -119,11 +153,12 @@ def _kernel(*refs, digest_size: int, unroll: bool = True,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("digest_size", "block_items", "interpret", "msg_loads"),
+    static_argnames=("digest_size", "block_items", "interpret", "msg_loads",
+                     "vmem_state"),
 )
 def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
                    block_items: int = 1024, interpret: bool = False,
-                   msg_loads: bool = True):
+                   msg_loads: bool = True, vmem_state: bool = False):
     """Hash in the kernel-native layout.
 
     ``mh``/``ml``: (nblocks, 16, 8, B/8) uint32 message word halves;
@@ -145,9 +180,14 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
     # Mosaic gets the straight-line unrolled rounds; the interpreter (CPU
     # tests) gets the scanned rounds, whose 12x-smaller graph sidesteps
     # the CPU backend's pathological compile of the unrolled chain
-    unroll = not interpret
+    # vmem_state mutates lane refs inside the rounds, which has no
+    # scanned formulation — it always runs unrolled (interpret included;
+    # keep interpret shapes tiny there, the CPU compile of the unrolled
+    # chain is the slow part the scanned path normally dodges)
+    unroll = (not interpret) or vmem_state
     kernel = functools.partial(
-        _kernel, digest_size=digest_size, unroll=unroll, msg_loads=msg_loads
+        _kernel, digest_size=digest_size, unroll=unroll,
+        msg_loads=msg_loads, vmem_state=vmem_state,
     )
     in_specs = [
         pl.BlockSpec((_SUBLANE, btl), lambda i, j: (0, i)),
@@ -175,7 +215,14 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
         scratch_shapes=[
             pltpu.VMEM((8, _SUBLANE, btl), jnp.uint32),
             pltpu.VMEM((8, _SUBLANE, btl), jnp.uint32),
-        ],
+        ] + (
+            [
+                pltpu.VMEM((16, _SUBLANE, btl), jnp.uint32),
+                pltpu.VMEM((16, _SUBLANE, btl), jnp.uint32),
+            ]
+            if vmem_state
+            else []
+        ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),

@@ -42,3 +42,25 @@ def test_multiblock_chaining():
     assert _run(payloads) == [
         hashlib.blake2b(p, digest_size=32).digest() for p in payloads
     ]
+
+
+def test_vmem_state_variant_matches_hashlib():
+    # the register-pressure experiment: working-vector lanes in VMEM
+    # scratch, per-G load/store.  Tiny shapes: this variant has no
+    # scanned form, so interpret compiles the unrolled chain
+    from dat_replication_protocol_tpu.ops.blake2b_pallas import (
+        blake2b_native,
+        from_native,
+        to_native,
+    )
+
+    payloads = [b"", b"x" * 7, b"y" * 128, b"z" * 200]
+    mh, ml, lengths = pack_payloads(payloads, nblocks=2)
+    mh_n, ml_n, len_n, B = to_native(
+        jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths)
+    )
+    hh, hl = blake2b_native(mh_n, ml_n, len_n, interpret=True,
+                            vmem_state=True)
+    assert digests_to_bytes(*from_native(hh, hl, B)) == [
+        hashlib.blake2b(p, digest_size=32).digest() for p in payloads
+    ]
