@@ -344,6 +344,7 @@ def bench_hash(quick: bool, backend: str) -> dict:
     )
 
     kh, kl = jax.random.split(jax.random.PRNGKey(0))
+    variant = "xla-scan"
     if use_pallas:
         from dat_replication_protocol_tpu.ops.blake2b_pallas import blake2b_native
 
@@ -351,18 +352,55 @@ def bench_hash(quick: bool, backend: str) -> dict:
         mh = jax.random.bits(kh, shape, dtype=jnp.uint32)
         ml = jax.random.bits(kl, shape, dtype=jnp.uint32)
         lengths = jnp.full((8, chunk // 8), item_bytes, dtype=jnp.uint32)
-        run = lambda: blake2b_native(mh, ml, lengths)  # noqa: E731
+        jax.block_until_ready((mh, ml))
+
+        # self-select the kernel variant: one warmed+fenced calibration
+        # rep each (register-resident vs VMEM-resident working vectors
+        # rank differently depending on the chip's scheduler; the bench
+        # should capture the best configuration, not a guess)
+        t0 = time.perf_counter()
+        best = None
+        for vs in (False, True):
+            kern = lambda vs=vs: blake2b_native(mh, ml, lengths,  # noqa: E731
+                                                vmem_state=vs)
+            try:
+                np.asarray(kern()[0][:1, :1])  # compile + warm
+                # median of 3: one rep can misprice by >2x on the
+                # shared chip (see _timed_reps) and would silently pick
+                # the wrong kernel for the whole headline measurement
+                cals = []
+                for _ in range(3):
+                    t1 = time.perf_counter()
+                    hh, hl = kern()
+                    np.asarray(hh[:1, :1])
+                    np.asarray(hl[:1, :1])
+                    cals.append(time.perf_counter() - t1)
+                cal = statistics.median(cals)
+            except Exception as e:
+                log(f"bench[hash]: variant vmem_state={vs} failed ({e})")
+                continue
+            log(f"bench[hash]: calibrate vmem_state={vs}: {cal:.3f}s/rep "
+                f"(median of 3)")
+            if best is None or cal < best[1]:
+                best = (kern, cal, vs)
+        if best is None:
+            raise RuntimeError("no hash kernel variant ran")
+        run = best[0]
+        variant = f"pallas(vmem_state={best[2]})"
+        log(
+            f"bench[hash]: compile+calibrate {time.perf_counter() - t0:.1f}s "
+            f"-> {variant}"
+        )
     else:
         shape = (chunk, nblocks, 16)
         mh = jax.random.bits(kh, shape, dtype=jnp.uint32)
         ml = jax.random.bits(kl, shape, dtype=jnp.uint32)
         lengths = jnp.full((chunk,), item_bytes, dtype=jnp.uint32)
         run = lambda: blake2b_packed(mh, ml, lengths)  # noqa: E731
-    jax.block_until_ready((mh, ml))
-
-    t0 = time.perf_counter()
-    np.asarray(run()[0])
-    log(f"bench[hash]: compile+first-run {time.perf_counter() - t0:.1f}s")
+        jax.block_until_ready((mh, ml))
+        t0 = time.perf_counter()
+        np.asarray(run()[0])
+        log(f"bench[hash]: compile+first-run {time.perf_counter() - t0:.1f}s")
 
     # completion barrier: a tiny slice of every rep's output (on the
     # tunneled axon platform block_until_ready returns before execution
@@ -424,6 +462,7 @@ def bench_hash(quick: bool, backend: str) -> dict:
         "unit": "GiB/s",
         "vs_baseline": round(gib_s / 50.0, 4),
         "aggregate_gib_s": round(total / dt / (1 << 30), 3),
+        "kernel_variant": variant,
         "e2e_host_gib_s": round(e2e_gib_s, 3),
         "h2d_mib_s": round(h2d, 1),
         "items": reps * chunk,
