@@ -3,16 +3,27 @@
 Each test mirrors one tape test: construct a real Encoder and Decoder, pipe
 them together in-process, and assert the decoded callbacks — loopback piping
 is the fake backend, exactly as in the reference.
+
+Parametrized over both backends: the north-star contract is that these
+scenarios pass UNMODIFIED with ``backend='tpu'`` (the digest pipeline
+rides alongside; wire behavior is identical).
 """
+
+import pytest
 
 import dat_replication_protocol_tpu as protocol
 from dat_replication_protocol_tpu.wire.change_codec import Change
 
 
-def test_encode_decode_changes():
+@pytest.fixture(params=["host", "tpu"])
+def ends(request):
+    return (protocol.encode(backend=request.param),
+            protocol.decode(backend=request.param))
+
+
+def test_encode_decode_changes(ends):
     # reference: test/basic.js:5-30
-    e = protocol.encode()
-    d = protocol.decode()
+    e, d = ends
     got = []
 
     d.change(lambda change, done: (got.append(change), done()))
@@ -26,10 +37,9 @@ def test_encode_decode_changes():
     ]
 
 
-def test_encode_decode_blob():
+def test_encode_decode_blob(ends):
     # reference: test/basic.js:32-51
-    e = protocol.encode()
-    d = protocol.decode()
+    e, d = ends
     got = []
 
     def on_blob(blob, done):
@@ -48,12 +58,11 @@ def test_encode_decode_blob():
     assert len(got[0]) == 11
 
 
-def test_encode_decode_mixed_blobs():
+def test_encode_decode_mixed_blobs(ends):
     # reference: test/basic.js:53-84 — the concurrency test: two blobs created
     # before either is written, writes interleaved; both must arrive intact
     # and in creation order (exercises cork/uncork, reference: encode.js:87-94).
-    e = protocol.encode()
-    d = protocol.decode()
+    e, d = ends
     expects = [b"hello world", b"HELLO WORLD"]
     got = []
 
@@ -76,11 +85,10 @@ def test_encode_decode_mixed_blobs():
     assert got == expects
 
 
-def test_encode_decode_blob_and_changes():
+def test_encode_decode_blob_and_changes(ends):
     # reference: test/basic.js:86-127 — a change submitted while a blob is
     # open must be parked and arrive after the blob (reference: encode.js:104-107).
-    e = protocol.encode()
-    d = protocol.decode()
+    e, d = ends
     order = []
 
     def on_blob(blob, done):
