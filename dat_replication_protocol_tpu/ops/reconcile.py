@@ -70,6 +70,22 @@ def diff_sketches(table_a, table_b) -> np.ndarray:
 _SUMMARIZE_JIT = None  # lazy: keep jax out of module import
 
 
+def sketch_table(rec_hh, rec_hl, slots, nslots: int):
+    """The sketch kernel: (B, 4) digest word columns + (B,) cell indices
+    -> (nslots, 8) wrapping-u32 cell table.
+
+    One owner of the word interleave ([lo k, hi k] — the host digest
+    byte order) and the scatter-add; the single-device summary and the
+    sharded mesh build (:func:`..parallel.mesh.sharded_sketch`) both
+    call this, which is what makes them byte-identical by construction.
+    """
+    import jax.numpy as jnp
+
+    words = jnp.stack([rec_hl, rec_hh], axis=2).reshape(-1, DIGEST_WORDS)
+    table = jnp.zeros((nslots, DIGEST_WORDS), dtype=jnp.uint32)
+    return table.at[slots.astype(jnp.int32)].add(words)
+
+
 def _summarize(all_hh, all_hl, n: int, log2_slots: int):
     """Device-fused summary: record digests -> sketch table, key digests
     -> slot indices.  Runs jitted so only the (tiny) slot vector and the
@@ -83,12 +99,7 @@ def _summarize(all_hh, all_hl, n: int, log2_slots: int):
     # int32 scatter index below stays non-negative), so the u64
     # lane-pair never needs materializing
     slots = all_hl[n:, 0] & jnp.uint32(nslots - 1)
-    # interleave (hl, hh) word pairs back to the host digest word order:
-    # words[2k] = lo k, words[2k+1] = hi k (see hash_extents_device)
-    words = jnp.stack([all_hl[:n], all_hh[:n]], axis=2).reshape(n, 8)
-    table = jnp.zeros((nslots, DIGEST_WORDS), dtype=jnp.uint32)
-    table = table.at[slots.astype(jnp.int32)].add(words)
-    return table, slots
+    return sketch_table(all_hh[:n], all_hl[:n], slots, nslots), slots
 
 
 class LogSummary:

@@ -9,6 +9,7 @@ from .mesh import (
     pad_batch,
     replicated,
     sharded_diff,
+    sharded_sketch,
 )
 
 __all__ = [
@@ -19,5 +20,6 @@ __all__ = [
     "pad_batch",
     "replicated",
     "sharded_diff",
+    "sharded_sketch",
     "sharded_gear_scan",
 ]

@@ -192,6 +192,61 @@ def _sharded_diff_program(mesh: Mesh):
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_sketch_program(mesh: Mesh, log2_slots: int):
+    """Jitted sharded sketch build, cached per (mesh, slot count)."""
+
+    from ..ops.reconcile import sketch_table
+
+    nslots = 1 << log2_slots
+
+    def step(rec_hh, rec_hl, slots):
+        # local partial table via the shared kernel, then: cells are
+        # wrapping-u32 sums, so a psum over chips IS the cell combine —
+        # order-independent, exact
+        return jax.lax.psum(
+            sketch_table(rec_hh, rec_hl, slots, nslots), DATA_AXIS
+        )
+
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def sharded_sketch(mesh: Mesh, rec_hh, rec_hl, slots, log2_slots: int):
+    """Key-addressed reconciliation sketch built across the mesh.
+
+    ``rec_hh/hl``: (B, 4) record digest word columns (the
+    :func:`..batch.feed.hash_extents_device` layout), sharded over
+    chips; ``slots``: (B,) cell indices (uint32/int32).  Each chip
+    scatter-adds its shard into a local table; one ``psum`` of the
+    (nslots, 8) table over ICI yields the replicated global sketch —
+    byte-identical to the single-device build
+    (:func:`..ops.reconcile._summarize`), because cells are wrapping
+    uint32 sums (commutative, associative).
+
+    The batch is zero-padded to the mesh size: a zero digest adds
+    nothing to cell 0, so padding rows cannot perturb the sketch.
+    """
+    if not 0 < log2_slots <= 31:
+        raise ValueError("log2_slots must be in [1, 31]")
+    n = mesh.devices.size
+    B = rec_hh.shape[0]
+    if B % n:
+        pad = ((0, n - B % n),)
+        rec_hh = jnp.pad(rec_hh, pad + ((0, 0),))
+        rec_hl = jnp.pad(rec_hl, pad + ((0, 0),))
+        slots = jnp.pad(slots, (0, n - B % n))
+    fn = _sharded_sketch_program(mesh, log2_slots)
+    return fn(rec_hh, rec_hl, jnp.asarray(slots))
+
+
 def sharded_diff(mesh: Mesh, a_hh, a_hl, b_hh, b_hl):
     """Tree-guided diff of two snapshots with leaves sharded over chips.
 

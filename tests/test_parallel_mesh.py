@@ -145,3 +145,25 @@ def test_sharded_gear_scan_matches_single_device():
         keep = (local >= 0) & (local < stride)
         got_cands.extend((local[keep] + t * stride).tolist())
     assert got_cands == rabin.host_candidates(data, 8)
+
+
+def test_sharded_sketch_matches_single_device():
+    import jax.numpy as jnp
+
+    from dat_replication_protocol_tpu.parallel import make_mesh, sharded_sketch
+
+    rng = np.random.default_rng(21)
+    B, log2_slots = 203, 9  # deliberately NOT a multiple of the mesh
+    rec_hh = jnp.asarray(rng.integers(0, 1 << 32, (B, 4), dtype=np.uint32))
+    rec_hl = jnp.asarray(rng.integers(0, 1 << 32, (B, 4), dtype=np.uint32))
+    slots = jnp.asarray(
+        rng.integers(0, 1 << log2_slots, B, dtype=np.uint32)
+    )
+    mesh = make_mesh(8)
+    got = sharded_sketch(mesh, rec_hh, rec_hl, slots, log2_slots)
+    # single-device reference: the same wrapping scatter-add
+    words = jnp.stack([rec_hl, rec_hh], axis=2).reshape(B, 8)
+    want = jnp.zeros((1 << log2_slots, 8), jnp.uint32).at[
+        slots.astype(jnp.int32)
+    ].add(words)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
