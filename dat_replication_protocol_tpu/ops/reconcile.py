@@ -78,11 +78,17 @@ def sketch_table(rec_hh, rec_hl, slots, nslots: int):
     byte order) and the scatter-add; the single-device summary and the
     sharded mesh build (:func:`..parallel.mesh.sharded_sketch`) both
     call this, which is what makes them byte-identical by construction.
+
+    Slots are masked to the table width here: an unmasked out-of-range
+    value would alias (negative int32 wraps to the table tail) or be
+    silently dropped by XLA's OOB-scatter semantics — either way a
+    corrupt sketch with no error.
     """
     import jax.numpy as jnp
 
     words = jnp.stack([rec_hl, rec_hh], axis=2).reshape(-1, DIGEST_WORDS)
     table = jnp.zeros((nslots, DIGEST_WORDS), dtype=jnp.uint32)
+    slots = slots.astype(jnp.uint32) & jnp.uint32(nslots - 1)
     return table.at[slots.astype(jnp.int32)].add(words)
 
 

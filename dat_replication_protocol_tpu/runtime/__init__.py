@@ -2,6 +2,7 @@
 and the composed content-addressing pipeline."""
 
 from .content import ContentSummary, content_address, delta, reassemble
+from .tree_sync import TreeSyncSession, sync as tree_sync
 from .replay import (
     ChangeColumns,
     FrameIndex,
@@ -24,4 +25,6 @@ __all__ = [
     "reassemble",
     "replay_log",
     "split_frames",
+    "TreeSyncSession",
+    "tree_sync",
 ]
