@@ -44,6 +44,23 @@ from ..utils.trace import span
 DIGEST_WORDS = 8  # 32-byte digests as 8 uint32 words
 
 
+def table_leaves(table):
+    """Sketch-table cells as Merkle leaf digest columns ``(hh, hl)``.
+
+    A cell is 32 bytes of wrapping-u32 sums — exactly digest-shaped —
+    so a sketch table is directly a Merkle leaf layer: build a tree
+    over it and two replicas can locate their differing cells REMOTELY
+    via :mod:`..runtime.tree_sync` in O(diff · log nslots) wire bytes,
+    instead of exchanging the O(nslots) table (the rateless-regime
+    refinement of the sketch protocol).  Word convention matches
+    :func:`sketch_table` ([lo k, hi k] interleave).
+    """
+    import jax.numpy as jnp
+
+    table = jnp.asarray(table)
+    return table[:, 1::2], table[:, 0::2]
+
+
 def diff_sketches(table_a, table_b) -> np.ndarray:
     """Differing slot indices between two sketches (sorted ascending).
 
@@ -56,12 +73,9 @@ def diff_sketches(table_a, table_b) -> np.ndarray:
     n = table_a.shape[0]
     if table_b.shape[0] != n:
         raise ValueError("sketches must have equal slot counts")
-    # (nslots, 8) u32 -> (nslots, 4) hi/lo pairs: words 1,3,5,7 are the
-    # "hi" halves under the (hi, lo) lane-pair convention
     with span("reconcile.diff"):
         bits, _, _ = diff_root_guided_packed(
-            table_a[:, 1::2], table_a[:, 0::2],
-            table_b[:, 1::2], table_b[:, 0::2],
+            *table_leaves(table_a), *table_leaves(table_b)
         )
         dense = unpack_mask(bits, n)
     return np.nonzero(dense)[0]

@@ -30,10 +30,19 @@ from dat_replication_protocol_tpu.runtime import (  # noqa: E402
 )
 
 
-def _session(summary):
+def _session(summary, width):
+    # both replicas must pad to a SHARED width (chunk counts that
+    # straddle a power-of-two boundary would otherwise build trees of
+    # different heights and sync() rejects them); in a real deployment
+    # the width rides with the root in the handshake
+    import jax.numpy as jnp
+
     digs = [summary.digests[i].tobytes() for i in range(summary.nchunks)]
-    hh, hl = merkle.pad_leaves(*merkle.digests_to_device(digs))
-    return TreeSyncSession(*merkle.build_tree(hh, hl))
+    hh, hl = merkle.digests_to_device(digs)
+    pad = ((0, width - summary.nchunks), (0, 0))
+    return TreeSyncSession(
+        *merkle.build_tree(jnp.pad(hh, pad), jnp.pad(hl, pad))
+    )
 
 
 def main() -> None:
@@ -45,8 +54,11 @@ def main() -> None:
     s2 = content_address(bytes(v2), avg_bits=10)
     print(f"replica A: {s1.nchunks} chunks; replica B: {s2.nchunks} chunks")
 
+    from dat_replication_protocol_tpu.utils.num import next_pow2
+
+    width = next_pow2(max(s1.nchunks, s2.nchunks))
     transcript = []
-    diff = tree_sync(_session(s1), _session(s2), transcript)
+    diff = tree_sync(_session(s1, width), _session(s2, width), transcript)
     moved = sum(nb for _, nb in transcript)
     naive = s1.nchunks * 32
     print(

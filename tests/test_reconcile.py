@@ -106,3 +106,34 @@ def test_log2_slots_bounds():
     for bad in (0, -1, 32, 40):
         with pytest.raises(ValueError, match="log2_slots"):
             reconcile.LogSummary(recs, ks, bad)
+
+
+def test_remote_sketch_diff_via_tree_sync():
+    # the fully-remote reconciliation: two replicas locate differing
+    # sketch CELLS over metered tree-sync messages (no O(nslots) table
+    # exchange), and the located cells equal the local diff_sketches
+    from dat_replication_protocol_tpu.ops import merkle
+    from dat_replication_protocol_tpu.runtime.tree_sync import (
+        TreeSyncSession,
+        sync,
+    )
+
+    keys = [b"k%04d" % i for i in range(400)]
+    a = _summ(keys, log2_slots=10)
+    b_keys = list(keys)
+    b_keys.insert(17, b"inserted-a")
+    b_keys.insert(333, b"inserted-b")
+    b = _summ(b_keys, log2_slots=10)
+
+    local = reconcile.diff_sketches(a.table, b.table).tolist()
+
+    def sess(summary):
+        hh, hl = reconcile.table_leaves(summary.table)
+        return TreeSyncSession(*merkle.build_tree(hh, hl))
+
+    transcript = []
+    remote = sync(sess(a), sess(b), transcript)
+    assert remote == local and len(local) >= 2
+    moved = sum(nb for _, nb in transcript)
+    table_bytes = (1 << 10) * 32
+    assert moved < table_bytes // 4, (moved, table_bytes)
