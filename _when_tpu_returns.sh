@@ -53,8 +53,9 @@ for rnd in range(2):
     for tag, c, bi, ml, vs in variants:
         run(f"r{rnd} {tag}", c, bi, ml, vs)
 PY
-# 2) profiler trace of the hash+cdc+merkle configs (quick shapes)
+# 2) full bench configs 3,4,5 FIRST (the headline artifacts; a re-wedge
+#    mid-script must not cost these)
+BENCH_CONFIGS=3,4,5 timeout 1500 python bench.py 2>&1 | grep -v WARNING | tail -6
+# 3) profiler trace of the device configs (quick shapes; diagnostic)
 BENCH_CONFIGS=3,4,5 timeout 900 python bench.py --quick --trace=/tmp/dat_trace 2>&1 | tail -3
 ls -la /tmp/dat_trace 2>/dev/null | head -5
-# 3) full bench configs 3,4,5
-BENCH_CONFIGS=3,4,5 timeout 1500 python bench.py 2>&1 | grep -v WARNING | tail -6
