@@ -63,9 +63,10 @@ class _HostStream:
         self.length = 0
 
     def update(self, data) -> "_HostStream":
-        data = bytes(data)
+        # hashlib consumes buffer-protocol objects directly — copying a
+        # memoryview/bytearray chunk here would tax the primary path
         self._h.update(data)
-        self.length += len(data)
+        self.length += memoryview(data).nbytes
         return self
 
     def digest(self) -> bytes:
