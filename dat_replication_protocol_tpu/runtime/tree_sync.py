@@ -69,6 +69,14 @@ class TreeSyncSession:
     def next_frontier(self, frontier: list[int], reply: bytes) -> list[int]:
         """Decode the responder's differ-bitmap into child indices."""
         kids = [c for i in frontier for c in (2 * i, 2 * i + 1)]
+        # symmetric to respond()'s request-length check: a truncated
+        # bitmap would zip() short and silently report the dropped tail
+        # as in-sync
+        if len(reply) != (len(kids) + 7) // 8:
+            raise ValueError(
+                f"differ-bitmap holds {len(reply)} bytes; frontier of "
+                f"{len(frontier)} nodes needs {(len(kids) + 7) // 8}"
+            )
         bits = np.unpackbits(
             np.frombuffer(reply, np.uint8), bitorder="little"
         )[: len(kids)]

@@ -39,7 +39,7 @@ async def send_over_async(
 ) -> None:
     """Pump ``encoder`` into an asyncio writer until EOF or destroy."""
     readable = asyncio.Event()
-    encoder._on_readable = readable.set
+    encoder._attach_readable(readable.set)
     encoder.on_error(lambda _e: readable.set())
     try:
         while True:
@@ -64,6 +64,7 @@ async def send_over_async(
                     encoder.destroy(e)
                 break
     finally:
+        encoder._detach_readable()
         try:
             if writer.can_write_eof():
                 writer.write_eof()

@@ -171,6 +171,7 @@ class Encoder:
         self.blobs = 0
         self.destroyed = False
         self.finalized = False
+        self.finished = False  # terminal: drained past finalize, or destroyed
         self._high_water = high_water
         # queue of (payload: bytes, on_consumed: OnDone); payloads are wire
         # bytes (headers and data alike).
@@ -183,11 +184,24 @@ class Encoder:
         self._parked_changes: list[tuple[bytes, OnDone]] = []
         self._drain_cbs: list[Callable[[], None]] = []
         self._error_cbs: list[Callable[[Exception | None], None]] = []
+        self._finish_cbs: list[Callable[[], None]] = []
         self._finalize_cb: OnDone = None
         # Consumer hook (set by session.pipe.Pipe): called whenever new wire
         # bytes become readable, so a connected pump keeps flowing on late
         # writes — the pull-based stand-in for Node's 'readable' event.
         self._on_readable: Optional[Callable[[], None]] = None
+
+    def _attach_readable(self, cb: Callable[[], None]) -> None:
+        """Claim the single readable-hook slot.  A second pump silently
+        overwriting the first would starve it forever — fail loudly."""
+        if self._on_readable is not None:
+            raise RuntimeError(
+                "encoder is already attached to a pump/pipe; detach it first"
+            )
+        self._on_readable = cb
+
+    def _detach_readable(self) -> None:
+        self._on_readable = None
 
     # -- public API ---------------------------------------------------------
 
@@ -243,9 +257,11 @@ class Encoder:
             )
         self.finalized = True
         self._finalize_cb = on_flush
-        if not self._queue and on_flush is not None:
-            cb, self._finalize_cb = self._finalize_cb, None
-            cb()
+        if not self._queue:
+            if on_flush is not None:
+                cb, self._finalize_cb = self._finalize_cb, None
+                cb()
+            self._fire_finish()
         if self._on_readable is not None:
             self._on_readable()  # let a connected pump observe EOF
 
@@ -286,9 +302,11 @@ class Encoder:
             cbs, self._drain_cbs = self._drain_cbs, []
             for cb in cbs:
                 cb()
-        if self.finalized and not self._queue and self._finalize_cb is not None:
-            cb, self._finalize_cb = self._finalize_cb, None
-            cb()
+        if self.finalized and not self._queue:
+            if self._finalize_cb is not None:
+                cb, self._finalize_cb = self._finalize_cb, None
+                cb()
+            self._fire_finish()
         return bytes(out)
 
     @property
@@ -307,6 +325,24 @@ class Encoder:
 
     def on_error(self, cb: Callable[[Exception | None], None]) -> None:
         self._error_cbs.append(cb)
+
+    def on_finish(self, cb: Callable[[], None]) -> None:
+        """Terminal lifecycle hook, the encoder-side 'close': fires exactly
+        once, after the finalized session has fully drained OR after destroy
+        (in which case error callbacks fire first — the reference's
+        'error' then 'close' ordering, reference: encode.js:73-74)."""
+        if self.finished:
+            cb()
+        else:
+            self._finish_cbs.append(cb)
+
+    def _fire_finish(self) -> None:
+        if self.finished:
+            return
+        self.finished = True
+        cbs, self._finish_cbs = self._finish_cbs, []
+        for cb in cbs:
+            cb()
 
     def destroy(self, err: Exception | None = None) -> None:
         """Fail-fast teardown: destroys every open blob writer
@@ -329,6 +365,7 @@ class Encoder:
         cbs, self._drain_cbs = self._drain_cbs, []
         for cb in cbs:
             cb()
+        self._fire_finish()
 
     # -- internal -----------------------------------------------------------
 

@@ -41,12 +41,16 @@ class Pipe:
     def pump(self) -> bool:
         """Move bytes until the source is dry, the sink stalls, or EOF.
         Returns True when the session fully completed."""
-        if self._pumping or self.done or self._eof_sent:
+        if self._pumping:
             return self.done
+        if self.done or self._eof_sent:
+            self._release()  # a dead pipe must not hold the encoder's
+            return self.done  # exclusive hook (destroy between pumps)
         self._pumping = True
         try:
             while True:
                 if self.decoder.destroyed or self.encoder.destroyed:
+                    self._release()  # the encoder may outlive this pipe
                     break
                 if not self.decoder.writable():
                     # Park: continue pumping when the app drains the decoder.
@@ -55,6 +59,7 @@ class Pipe:
                 data = self.encoder.read(self.chunk_size)
                 if data is None:  # EOF
                     self._eof_sent = True
+                    self._release()
                     self.decoder.end()
                     break
                 if not data:
@@ -63,6 +68,14 @@ class Pipe:
         finally:
             self._pumping = False
         return self.done
+
+    def _release(self) -> None:
+        """Free the encoder's readable-hook slot once this pipe can never
+        pump again, so a later pump/transport may claim the encoder
+        (attach is exclusive and fails loudly on double-claim)."""
+        # == not `is`: each `self.pump` access builds a fresh bound method
+        if self.encoder._on_readable == self.pump:
+            self.encoder._detach_readable()
 
     def _on_drain(self) -> None:
         self.pump()
@@ -73,6 +86,9 @@ def pipe(encoder: Encoder, decoder: Decoder, chunk_size: int = DEFAULT_CHUNK) ->
     or call ``p.pump()`` again after late writes (mirrors that Node pipes are
     pull-driven and keep flowing as more data is produced)."""
     p = Pipe(encoder, decoder, chunk_size)
-    encoder._on_readable = p.pump
+    encoder._attach_readable(p.pump)
+    # a decoder torn down outside an active pump must still free the
+    # encoder's exclusive hook immediately (not on some later pump call)
+    decoder.on_error(lambda _e: p._release())
     p.pump()
     return p

@@ -59,7 +59,7 @@ def send_over(
     on the way out so the peer observes EOF.
     """
     readable = threading.Event()
-    encoder._on_readable = readable.set
+    encoder._attach_readable(readable.set)
     encoder.on_error(lambda _e: readable.set())
     try:
         while True:
@@ -75,6 +75,7 @@ def send_over(
                 continue
             write_bytes(bytes(data))
     finally:
+        encoder._detach_readable()
         if close is not None:
             try:
                 close()

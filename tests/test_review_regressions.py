@@ -247,3 +247,107 @@ def test_truncated_fixed_width_fields_raise():
             decode_change(bad)
         ok = base + bytes([(7 << 3) | wire_type]) + b"\x00" * nbytes
         decode_change(ok)  # fully-present unknown field still skips cleanly
+
+# -- round-4 lifecycle / advisor fixes ---------------------------------------
+
+
+def test_encoder_on_finish_after_finalize_and_drain():
+    """The encoder-side 'close' (reference: encode.js) fires once the
+    finalized session has fully drained — not before."""
+    e = protocol.encode()
+    seen = []
+    e.on_finish(lambda: seen.append("finish"))
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    assert seen == []  # bytes still buffered
+    while (c := e.read()) not in (None, b""):
+        pass
+    assert seen == ["finish"]
+    assert e.finished
+    # late registration on a finished encoder fires immediately
+    e.on_finish(lambda: seen.append("late"))
+    assert seen == ["finish", "late"]
+
+
+def test_encoder_destroy_fires_error_then_finish():
+    """Teardown ordering parity: 'error' before 'close'
+    (reference: encode.js:73-74)."""
+    e = protocol.encode()
+    order = []
+    e.on_error(lambda err: order.append(("error", type(err).__name__)))
+    e.on_finish(lambda: order.append(("finish", None)))
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    e.destroy(RuntimeError("boom"))
+    assert order == [("error", "RuntimeError"), ("finish", None)]
+    # destroy after a clean finish must not re-fire
+    e2 = protocol.encode()
+    n = []
+    e2.on_finish(lambda: n.append(1))
+    e2.finalize()
+    assert n == [1]
+    e2.destroy()
+    assert n == [1]
+
+
+def test_encoder_immediate_finalize_fires_finish():
+    e = protocol.encode()
+    seen = []
+    e.on_finish(lambda: seen.append(1))
+    e.finalize()  # nothing queued: drained already
+    assert seen == [1]
+
+
+def test_encoder_double_pump_attach_fails_loudly():
+    """Advisor: a second pump must not silently clobber the first's
+    readable hook (which would park it forever)."""
+    e = protocol.encode()
+    e._attach_readable(lambda: None)
+    with pytest.raises(RuntimeError, match="already attached"):
+        e._attach_readable(lambda: None)
+    e._detach_readable()
+    e._attach_readable(lambda: None)  # re-attach after detach is fine
+
+
+def test_tree_sync_truncated_reply_rejected():
+    """Advisor: a truncated differ-bitmap must raise, not silently report
+    the dropped tail as in-sync."""
+    from dat_replication_protocol_tpu.ops import merkle
+    from dat_replication_protocol_tpu.runtime.tree_sync import TreeSyncSession
+
+    hh, hl = merkle.digests_to_device([bytes([i]) * 32 for i in range(16)])
+    lvh, lvl = merkle.build_tree(hh, hl)
+    s = TreeSyncSession(lvh, lvl)
+    frontier = list(range(8))  # 16 kids -> 2 bitmap bytes
+    with pytest.raises(ValueError, match="differ-bitmap"):
+        s.next_frontier(frontier, b"\x00")  # one byte short
+
+
+def test_pipe_releases_encoder_hook_after_eof():
+    """A completed pipe must free the encoder's readable slot so a later
+    transport pump can claim it (attach is exclusive)."""
+    e = protocol.encode()
+    d = protocol.decode()
+    d.change(lambda c, done: done())
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    p = protocol.pipe(e, d)
+    assert p.done
+    e._attach_readable(lambda: None)  # must not raise after EOF release
+
+
+def test_pipe_releases_encoder_hook_on_decoder_destroy():
+    """A decoder destroyed outside an active pump frees the encoder's
+    readable slot at once — re-piping to a fresh decoder must work."""
+    e = protocol.encode()
+    d = protocol.decode()
+    d.change(lambda c, done: done())
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    protocol.pipe(e, d)
+    d.destroy(RuntimeError("app error outside pump"))
+    d2 = protocol.decode()
+    got = []
+    d2.change(lambda c, done: (got.append(c.key), done()))
+    e.change({"key": "k2", "change": 2, "from": 1, "to": 2})
+    e.finalize()
+    protocol.pipe(e, d2)  # must not raise; pumps the remaining frames
+    assert got == ["k2"]
