@@ -1,9 +1,12 @@
 #!/bin/bash
 # Fire the full device measurements the moment the tunnel answers.
+# Round-4 agenda (VERDICT items 1 and 4): BLAKE2b variant sweep first
+# (it decides the headline kernel), then the full bench capture, then
+# the CDC ceiling diagnosis, then a profiler trace.
 cd "$(dirname "$0")"
 set -x
-# 1) hash kernel variant sweep: msg_loads x block_items, interleaved
-#    twice to denoise the shared chip
+# 1) hash kernel variant sweep: msg_loads x block_items x vmem_state,
+#    interleaved twice to denoise the shared chip
 timeout 900 python - <<'PY' 2>&1 | grep -v WARNING
 import time, statistics, numpy as np, jax, jax.numpy as jnp
 from dat_replication_protocol_tpu.ops.blake2b_pallas import blake2b_native
@@ -38,8 +41,7 @@ variants = [("A c4096 bi1024 ml0", 4096, 1024, False, False),
             ("V2 c4096 bi2048 vmem", 4096, 2048, True, True)]
 # correctness cross-check of the vmem_state variant on the real chip:
 # MIXED lengths below the 4-block input so the active/final/t_lo masks
-# all take both values under Mosaic (uniform 1 MiB lengths would leave
-# final always-false and active always-true)
+# all take both values under Mosaic
 kh, kl = jax.random.split(jax.random.PRNGKey(9))
 xh = jax.random.bits(kh, (4, 16, 8, 256), dtype=jnp.uint32)
 xl = jax.random.bits(kl, (4, 16, 8, 256), dtype=jnp.uint32)
@@ -53,9 +55,40 @@ for rnd in range(2):
     for tag, c, bi, ml, vs in variants:
         run(f"r{rnd} {tag}", c, bi, ml, vs)
 PY
-# 2) full bench configs 3,4,5 FIRST (the headline artifacts; a re-wedge
+# 2) full bench configs 3,4,5 (the headline artifacts; a re-wedge
 #    mid-script must not cost these)
-BENCH_CONFIGS=3,4,5 timeout 1500 python bench.py 2>&1 | grep -v WARNING | tail -6
-# 3) profiler trace of the device configs (quick shapes; diagnostic)
+BENCH_CONFIGS=3,4,5 timeout 1800 python bench.py 2>&1 | grep -v WARNING | tail -8
+# 3) CDC ceiling diagnosis by elimination: each diag variant carves one
+#    suspect out of the inner loop (output wrong by design) — the delta
+#    vs baseline prices that suspect.  Plus ilp/block_tiles spread.
+timeout 900 python - <<'PY' 2>&1 | grep -v WARNING
+import time, statistics, numpy as np, jax, jax.numpy as jnp
+from dat_replication_protocol_tpu.ops.rabin_pallas import gear_candidates_native
+from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
+enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
+stride = 1 << 17
+T = (2 << 30) // stride  # 2 GiB of tiles so bt16384 divides T
+ng, gw = stride // 256, 64
+w = jax.random.bits(jax.random.PRNGKey(3), (ng, gw, 8, T // 8), dtype=jnp.uint32)
+jax.block_until_ready(w)
+def run(tag, **kw):
+    f = jax.jit(lambda x: jnp.sum(gear_candidates_native(x, 13, **kw)))
+    np.asarray(f(w))
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); np.asarray(f(w))
+        dts.append(time.perf_counter() - t0)
+    g = w.nbytes / statistics.median(dts) / (1 << 30)
+    print(f"cdc {tag}: {g:.2f} GiB/s (median of 3)", flush=True)
+for rnd in range(2):
+    run(f"r{rnd} base ilp8 bt8192")
+    run(f"r{rnd} nomul", diag="nomul")
+    run(f"r{rnd} nostore", diag="nostore")
+    run(f"r{rnd} noextract", diag="noextract")
+    run(f"r{rnd} ilp4", ilp=4)
+    run(f"r{rnd} ilp16 bt16384", ilp=16, block_tiles=16384)
+    run(f"r{rnd} bt4096 ilp4", ilp=4, block_tiles=4096)
+PY
+# 4) profiler trace of the device configs (quick shapes; diagnostic)
 BENCH_CONFIGS=3,4,5 timeout 900 python bench.py --quick --trace=/tmp/dat_trace 2>&1 | tail -3
 ls -la /tmp/dat_trace 2>/dev/null | head -5

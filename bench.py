@@ -511,18 +511,23 @@ def bench_hash(quick: bool, backend: str) -> dict:
     # link it was measured over.
     from dat_replication_protocol_tpu.batch.feed import hash_extents
 
-    e2e_items = 64 if quick else 256
+    # sized so the feed layer's pipelining actually engages: with
+    # pipeline_bytes=16 MiB the 1024-item batch splits into multiple
+    # chunks whose uploads stream under earlier chunks' compute (on the
+    # TPU path the pallas item floor makes the chunks wider — still >= 2)
+    e2e_items = 128 if quick else 1024
     e2e_item = 1 << 18  # 256 KiB
+    e2e_pipe = {"pipeline_bytes": 16 << 20}
     buf = np.random.default_rng(1).integers(
         0, 256, e2e_items * e2e_item, dtype=np.uint8
     )
     offs = np.arange(e2e_items, dtype=np.int64) * e2e_item
     lens = np.full(e2e_items, e2e_item, dtype=np.int64)
-    hash_extents(buf, offs, lens)  # warmup/compile at the FULL batch
-    # shape: a smaller warmup would leave the timed call paying a fresh
-    # jit specialization and mislabel compile time as pipeline time
+    hash_extents(buf, offs, lens, **e2e_pipe)  # warmup/compile at the FULL
+    # batch shape: a smaller warmup would leave the timed call paying a
+    # fresh jit specialization and mislabel compile time as pipeline time
     t0 = time.perf_counter()
-    digs = hash_extents(buf, offs, lens)
+    digs = hash_extents(buf, offs, lens, **e2e_pipe)
     e2e_dt = time.perf_counter() - t0
     assert len(digs) == e2e_items
     e2e_gib_s = buf.nbytes / e2e_dt / (1 << 30)
@@ -532,9 +537,15 @@ def bench_hash(quick: bool, backend: str) -> dict:
     t0 = time.perf_counter()
     np.asarray(x[:8])
     h2d = (probe_bytes / (1 << 20)) / (time.perf_counter() - t0)
+    # overlap factor: e2e throughput as a fraction of the measured link —
+    # with H2D staged under compute (batch/feed pipelining) a link-bound
+    # path should sit near 1.0; round 3 measured 0.03-0.3 with nothing
+    # overlapped
+    e2e_vs_link = (e2e_gib_s * 1024) / h2d
     log(
         f"bench[hash]: e2e host->digest {e2e_gib_s:.3f} GiB/s "
-        f"({buf.nbytes >> 20} MiB; link h2d ~{h2d:.0f} MiB/s)"
+        f"({buf.nbytes >> 20} MiB; link h2d ~{h2d:.0f} MiB/s; "
+        f"{e2e_vs_link:.2f}x link)"
     )
     return {
         "metric": "blake2b_batched_blob_hash_throughput",
@@ -545,6 +556,7 @@ def bench_hash(quick: bool, backend: str) -> dict:
         "kernel_variant": variant,
         "e2e_host_gib_s": round(e2e_gib_s, 3),
         "h2d_mib_s": round(h2d, 1),
+        "e2e_vs_link": round(e2e_vs_link, 3),
         "items": reps * chunk,
         "item_bytes": item_bytes,
     }

@@ -29,7 +29,8 @@ _SUBLANE = 8
 _LANE = 128
 
 
-def _kernel(wref, oref, sth_ref, stl_ref, *, avg_bits: int, ilp: int):
+def _kernel(wref, oref, sth_ref, stl_ref, *, avg_bits: int, ilp: int,
+            diag: str = ""):
     """``ilp`` independent lane-chunks are updated per unrolled byte step.
 
     The gear chain is strictly serial per lane (each byte's state update
@@ -38,6 +39,13 @@ def _kernel(wref, oref, sth_ref, stl_ref, *, avg_bits: int, ilp: int):
     instruction stream pipelines K chains through the VPU — classic
     software ILP, done manually because Mosaic schedules within, not
     across, whole-array ops.
+
+    ``diag`` (measurement-only; output is WRONG under any non-empty
+    value) carves one suspect out of the loop so a device sweep can
+    attribute the kernel's ceiling by elimination:
+    ``'nomul'`` replaces the two u32 multiplies with adds, ``'nostore'``
+    drops the packed-mask stores and their lane concatenates,
+    ``'noextract'`` skips the byte shift/mask unpack.
     """
     j = pl.program_id(1)
     mask = U32((1 << avg_bits) - 1)
@@ -51,6 +59,20 @@ def _kernel(wref, oref, sth_ref, stl_ref, *, avg_bits: int, ilp: int):
     def chunk(a, k):
         return a[:, k * btl : (k + 1) * btl]
 
+    def step(hh, hl, byte):
+        if diag == "nomul":
+            from .rabin import _C1, _C2
+
+            v = byte + U32(1)
+            gl = v + U32(int(_C1))
+            gh = v + U32(int(_C2))
+            sh = (hh << U32(1)) | (hl >> U32(31))
+            sl = hl << U32(1)
+            lo = sl + gl
+            carry = (lo < sl).astype(U32)
+            return sh + gh + carry, lo
+        return _gear_step(hh, hl, byte)
+
     hh = [chunk(sth_ref[0], k) for k in range(ilp)]
     hl = [chunk(stl_ref[0], k) for k in range(ilp)]
     acc = [jnp.zeros_like(hh[0]) for _ in range(ilp)]
@@ -60,30 +82,41 @@ def _kernel(wref, oref, sth_ref, stl_ref, *, avg_bits: int, ilp: int):
         word = wref[0, w]
         for s in range(4):
             for k in range(ilp):
-                byte = (chunk(word, k) >> U32(8 * s)) & U32(0xFF)
-                hh[k], hl[k] = _gear_step(hh[k], hl[k], byte)
+                if diag == "noextract":
+                    byte = chunk(word, k)
+                else:
+                    byte = (chunk(word, k) >> U32(8 * s)) & U32(0xFF)
+                hh[k], hl[k] = step(hh[k], hl[k], byte)
                 hit = (hh[k] & mask) == U32(0)
                 acc[k] = acc[k] | (hit.astype(U32) << U32(bit))
             bit += 1
             if bit == PACK:
-                oref[0, pword] = jnp.concatenate(acc, axis=-1)
+                if diag != "nostore":
+                    oref[0, pword] = jnp.concatenate(acc, axis=-1)
                 acc = [jnp.zeros_like(hh[0]) for _ in range(ilp)]
                 bit = 0
                 pword += 1
+    if diag == "nostore":  # one write keeps the block defined
+        oref[0, 0] = jnp.concatenate(acc, axis=-1)
     sth_ref[0] = jnp.concatenate(hh, axis=-1)
     stl_ref[0] = jnp.concatenate(hl, axis=-1)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("avg_bits", "block_tiles", "interpret", "ilp")
+    jax.jit,
+    static_argnames=("avg_bits", "block_tiles", "interpret", "ilp", "diag"),
 )
 def gear_candidates_native(words, avg_bits: int = 13,
                            block_tiles: int = 8192, interpret: bool = False,
-                           ilp: int = 8):
+                           ilp: int = 8, diag: str = ""):
     """``words``: (ngroups, GROUP/4, 8, T/8) uint32 -> packed bitmask
     ``(ngroups, GROUP/PACK, 8, T/8)``; bit for byte j of tile t is word
     ``j//PACK`` bit ``j%PACK`` at the tile's (sublane, lane) slot.
     """
+    if diag not in ("", "nomul", "nostore", "noextract"):
+        # a typo'd diag silently timing the baseline would poison the
+        # by-elimination sweep captured in a scarce TPU window
+        raise ValueError(f"unknown diag variant {diag!r}")
     ng, gw, s, tl = words.shape
     if gw != GROUP // 4 or s != _SUBLANE:
         raise ValueError(f"expected (ng, {GROUP // 4}, 8, T/8); got {words.shape}")
@@ -98,7 +131,7 @@ def gear_candidates_native(words, avg_bits: int = 13,
             f"block_tiles/8={btl} must split into {ilp} lane-multiples"
         )
     grid = (tl // btl, ng)
-    kernel = functools.partial(_kernel, avg_bits=avg_bits, ilp=ilp)
+    kernel = functools.partial(_kernel, avg_bits=avg_bits, ilp=ilp, diag=diag)
     return pl.pallas_call(
         kernel,
         grid=grid,
