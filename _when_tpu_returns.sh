@@ -21,10 +21,10 @@ def mk(chunk):
             jax.random.bits(kl, shape, dtype=jnp.uint32),
             jnp.full((8, chunk // 8), item_bytes, dtype=jnp.uint32))
 data = {4096: mk(4096)}
-def run(tag, chunk, bi, ml, vs=False):
+def run(tag, chunk, bi, ml, vs=False, sl=False):
     mh, mlo, lens = data[chunk]
     f = lambda: blake2b_native(mh, mlo, lens, block_items=bi, msg_loads=ml,
-                               vmem_state=vs)
+                               vmem_state=vs, state_loads=sl)
     np.asarray(f()[0][:1, :1])
     dts = []
     for _ in range(3):
@@ -34,11 +34,14 @@ def run(tag, chunk, bi, ml, vs=False):
         dts.append(time.perf_counter() - t0)
     g = chunk * item_bytes / statistics.median(dts) / (1 << 30)
     print(f"{tag}: {g:.2f} GiB/s (median of 3)", flush=True)
-variants = [("A c4096 bi1024 ml0", 4096, 1024, False, False),
-            ("K c4096 bi1024 ml1", 4096, 1024, True, False),
-            ("K2 c4096 bi2048 ml1", 4096, 2048, True, False),
-            ("V c4096 bi1024 vmem", 4096, 1024, True, True),
-            ("V2 c4096 bi2048 vmem", 4096, 2048, True, True)]
+variants = [("A c4096 bi1024 ml0", 4096, 1024, False, False, False),
+            ("K c4096 bi1024 ml1", 4096, 1024, True, False, False),
+            ("K2 c4096 bi2048 ml1", 4096, 2048, True, False, False),
+            ("S c4096 bi1024 ml1 sl1", 4096, 1024, True, False, True),
+            ("V c4096 bi1024 vmem", 4096, 1024, True, True, False),
+            ("V2 c4096 bi2048 vmem", 4096, 2048, True, True, False),
+            ("VS c4096 bi1024 vmem sl1", 4096, 1024, True, True, True),
+            ("VS2 c4096 bi2048 vmem sl1", 4096, 2048, True, True, True)]
 # correctness cross-check of the vmem_state variant on the real chip:
 # MIXED lengths below the 4-block input so the active/final/t_lo masks
 # all take both values under Mosaic
@@ -47,13 +50,15 @@ xh = jax.random.bits(kh, (4, 16, 8, 256), dtype=jnp.uint32)
 xl = jax.random.bits(kl, (4, 16, 8, 256), dtype=jnp.uint32)
 mixed = jnp.arange(2048, dtype=jnp.uint32).reshape(8, 256) % jnp.uint32(513)
 ra = blake2b_native(xh, xl, mixed, msg_loads=True)
-rb = blake2b_native(xh, xl, mixed, msg_loads=True, vmem_state=True)
-assert np.array_equal(np.asarray(ra[0]), np.asarray(rb[0]))
-assert np.array_equal(np.asarray(ra[1]), np.asarray(rb[1]))
-print("vmem_state cross-check ok (mixed lengths)", flush=True)
+for kw in ({"vmem_state": True}, {"state_loads": True},
+           {"vmem_state": True, "state_loads": True}):
+    rb = blake2b_native(xh, xl, mixed, msg_loads=True, **kw)
+    assert np.array_equal(np.asarray(ra[0]), np.asarray(rb[0])), kw
+    assert np.array_equal(np.asarray(ra[1]), np.asarray(rb[1])), kw
+print("variant cross-checks ok (mixed lengths, on-chip)", flush=True)
 for rnd in range(2):
-    for tag, c, bi, ml, vs in variants:
-        run(f"r{rnd} {tag}", c, bi, ml, vs)
+    for tag, c, bi, ml, vs, sl in variants:
+        run(f"r{rnd} {tag}", c, bi, ml, vs, sl)
 PY
 # 2) full bench configs 3,4,5 (the headline artifacts; a re-wedge
 #    mid-script must not cost these)

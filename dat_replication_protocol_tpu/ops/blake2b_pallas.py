@@ -73,6 +73,32 @@ class _RefLanes:
         self._vh[i], self._vl[i] = pair
 
 
+class _RefState:
+    """Lazy chaining-state view: ``h[i]`` loads from VMEM at use site.
+
+    ``compress_soa`` touches h twice — initializing v[0..7] before the
+    rounds and xoring into the result after them — yet an eagerly-loaded
+    h pins 16 hi/lo vregs across all 12 rounds for those two uses.
+    Loading at the use sites makes h's live ranges two short windows the
+    scheduler can place freely (the third read, _kernel's active-mask
+    select, re-loads the same scratch).
+    """
+
+    def __init__(self, sth_ref, stl_ref):
+        self._sh = sth_ref
+        self._sl = stl_ref
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        i = int(i)
+        return self._sh[i], self._sl[i]
+
+    def __iter__(self):
+        return (self[i] for i in range(8))
+
+
 class _RefWords:
     """Lazy message-word view: ``m[w]`` issues the VMEM loads at use site.
 
@@ -96,7 +122,8 @@ class _RefWords:
 
 
 def _kernel(*refs, digest_size: int, unroll: bool = True,
-            msg_loads: bool = False, vmem_state: bool = False):
+            msg_loads: bool = False, vmem_state: bool = False,
+            state_loads: bool = False):
     if vmem_state:
         (len_ref, mh_ref, ml_ref, outh_ref, outl_ref,
          sth_ref, stl_ref, vh_ref, vl_ref) = refs
@@ -136,7 +163,10 @@ def _kernel(*refs, digest_size: int, unroll: bool = True,
         m = _RefWords(mh_ref, ml_ref)
     else:
         m = [(mh_ref[0, w], ml_ref[0, w]) for w in range(16)]
-    h = [(sth_ref[w], stl_ref[w]) for w in range(8)]
+    if state_loads and unroll:
+        h = _RefState(sth_ref, stl_ref)
+    else:
+        h = [(sth_ref[w], stl_ref[w]) for w in range(8)]
     lanes = _RefLanes(vh_ref, vl_ref) if vmem_state else None
     nh = compress_soa(h, m, t_lo, final, unroll=unroll, sigma=sigma,
                       lanes=lanes)
@@ -154,11 +184,12 @@ def _kernel(*refs, digest_size: int, unroll: bool = True,
 @functools.partial(
     jax.jit,
     static_argnames=("digest_size", "block_items", "interpret", "msg_loads",
-                     "vmem_state"),
+                     "vmem_state", "state_loads"),
 )
 def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
                    block_items: int = 1024, interpret: bool = False,
-                   msg_loads: bool = True, vmem_state: bool = False):
+                   msg_loads: bool = True, vmem_state: bool = False,
+                   state_loads: bool = False):
     """Hash in the kernel-native layout.
 
     ``mh``/``ml``: (nblocks, 16, 8, B/8) uint32 message word halves;
@@ -180,14 +211,17 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
     # Mosaic gets the straight-line unrolled rounds; the interpreter (CPU
     # tests) gets the scanned rounds, whose 12x-smaller graph sidesteps
     # the CPU backend's pathological compile of the unrolled chain
-    # vmem_state mutates lane refs inside the rounds, which has no
-    # scanned formulation — it always runs unrolled (interpret included;
-    # keep interpret shapes tiny there, the CPU compile of the unrolled
-    # chain is the slow part the scanned path normally dodges)
-    unroll = (not interpret) or vmem_state
+    # vmem_state mutates lane refs inside the rounds and state_loads
+    # reads h refs lazily — neither has a scanned formulation, so both
+    # force unrolled rounds (interpret included; keep interpret shapes
+    # tiny there, the CPU compile of the unrolled chain is the slow part
+    # the scanned path normally dodges).  Without the state_loads term
+    # the interpret-mode tests would silently exercise the eager path.
+    unroll = (not interpret) or vmem_state or state_loads
     kernel = functools.partial(
         _kernel, digest_size=digest_size, unroll=unroll,
         msg_loads=msg_loads, vmem_state=vmem_state,
+        state_loads=state_loads,
     )
     in_specs = [
         pl.BlockSpec((_SUBLANE, btl), lambda i, j: (0, i)),

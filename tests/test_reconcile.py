@@ -201,3 +201,29 @@ def test_reconcile_rate_floor():
     rate = 2 * n / dt
     assert b"new-row" in out["b_keys"]
     assert rate > 300_000, f"reconcile at {rate:,.0f} records/s"
+
+
+def test_native_blake2b_fuzz_vs_hashlib():
+    """Property fuzz: the native RFC 7693 implementation must agree with
+    hashlib on arbitrary sizes incl. block-boundary straddles."""
+    import hashlib
+
+    import numpy as np
+    import pytest
+
+    from dat_replication_protocol_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(11)
+    sizes = [0, 1, 63, 64, 65, 127, 128, 129, 255, 256, 257, 4095, 4096,
+             10_000] + [int(rng.integers(0, 20_000)) for _ in range(40)]
+    payloads = [rng.integers(0, 256, s, dtype=np.uint8).tobytes()
+                for s in sizes]
+    buf = np.frombuffer(b"".join(payloads), np.uint8)
+    lens = np.array([len(p) for p in payloads], dtype=np.int64)
+    offs = np.cumsum(lens) - lens
+    out = native.hash_many(buf, offs, lens)
+    for i, p in enumerate(payloads):
+        assert out[i].tobytes() == hashlib.blake2b(
+            p, digest_size=32).digest(), f"size {len(p)}"
