@@ -440,16 +440,26 @@ def bench_hash(quick: bool, backend: str) -> dict:
         # should capture the best configuration, not a guess)
         t0 = time.perf_counter()
         best = None
-        golden = None  # baseline digest slice: variants must reproduce it
+        golden = None  # digest output of a TESTED variant: others must
+        # reproduce it.  Every composition except (False, True) has a
+        # CPU byte-exactness test (test_blake2b_pallas.py), so any of
+        # those may anchor; (False, True) is covered ONLY by this guard
+        # and never anchors.
+        cpu_tested = {(False, False), (True, False), (True, True)}
         for vs, sl in ((False, False), (False, True), (True, False),
                        (True, True)):
             kern = lambda vs=vs, sl=sl: blake2b_native(  # noqa: E731
                 mh, ml, lengths, vmem_state=vs, state_loads=sl)
             try:
+                if golden is None and (vs, sl) not in cpu_tested:
+                    log(f"bench[hash]: no tested baseline compiled yet; "
+                        f"skipping unanchorable variant vmem={vs} sloads={sl}")
+                    continue
                 hh, hl = kern()  # compile + warm
-                probe = (np.asarray(hh[:, :8, :1]), np.asarray(hl[:, :8, :1]))
+                probe = (np.asarray(hh), np.asarray(hl))  # FULL digests:
+                # a lane-partial miscompile must not slip past the guard
                 if golden is None:
-                    golden = probe  # (False, False) is the tested baseline
+                    golden = probe
                 elif not (np.array_equal(golden[0], probe[0])
                           and np.array_equal(golden[1], probe[1])):
                     # never self-select a miscompiled variant for the

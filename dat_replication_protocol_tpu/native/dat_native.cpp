@@ -146,18 +146,15 @@ enum {
   TAG_VALUE = (6 << 3) | 2,
 };
 
-// Decode n Change payloads into columnar arrays.
-//
-// Absent optional fields get len -1 (host maps to ''/b'').  Unknown fields
-// are skipped per proto2.  Returns 0, or a negative error with err_index
-// set to the offending record.
-int64_t dat_decode_changes(const uint8_t* buf, const int64_t* starts,
-                           const int64_t* lens, int64_t n, uint32_t* change,
-                           uint32_t* from_v, uint32_t* to_v, int64_t* key_off,
-                           int64_t* key_len, int64_t* sub_off,
-                           int64_t* sub_len, int64_t* val_off,
-                           int64_t* val_len, int64_t* err_index) {
-  for (int64_t r = 0; r < n; ++r) {
+// Decode Change payloads [lo, hi) into columnar arrays; returns the index
+// of the first corrupt record in the range, or -1 if all decode.  The
+// rows are independent, so ranges parallelize (dat_decode_changes_mt).
+static int64_t decode_changes_range(
+    const uint8_t* buf, const int64_t* starts, const int64_t* lens,
+    int64_t lo, int64_t hi, uint32_t* change, uint32_t* from_v,
+    uint32_t* to_v, int64_t* key_off, int64_t* key_len, int64_t* sub_off,
+    int64_t* sub_len, int64_t* val_off, int64_t* val_len) {
+  for (int64_t r = lo; r < hi; ++r) {
     int64_t i = starts[r];
     const int64_t end = i + lens[r];
     bool has_key = false, has_change = false, has_from = false, has_to = false;
@@ -225,7 +222,27 @@ int64_t dat_decode_changes(const uint8_t* buf, const int64_t* starts,
     if (!has_key || !has_change || !has_from || !has_to) goto bad;
     continue;
   bad:
-    *err_index = r;
+    return r;
+  }
+  return -1;
+}
+
+// Decode n Change payloads into columnar arrays (serial entry point).
+//
+// Absent optional fields get len -1 (host maps to ''/b'').  Unknown fields
+// are skipped per proto2.  Returns 0, or a negative error with err_index
+// set to the offending record.
+int64_t dat_decode_changes(const uint8_t* buf, const int64_t* starts,
+                           const int64_t* lens, int64_t n, uint32_t* change,
+                           uint32_t* from_v, uint32_t* to_v, int64_t* key_off,
+                           int64_t* key_len, int64_t* sub_off,
+                           int64_t* sub_len, int64_t* val_off,
+                           int64_t* val_len, int64_t* err_index) {
+  int64_t bad = decode_changes_range(buf, starts, lens, 0, n, change, from_v,
+                                     to_v, key_off, key_len, sub_off, sub_len,
+                                     val_off, val_len);
+  if (bad >= 0) {
+    *err_index = bad;
     return DAT_ERR_BAD_RECORD;
   }
   return 0;
@@ -327,6 +344,7 @@ int64_t dat_encode_changes(const uint8_t* src, int64_t n,
 // ---------------------------------------------------------------------------
 
 #include <cstring>
+#include <atomic>
 #include <thread>
 #include <vector>
 
@@ -490,6 +508,43 @@ int64_t dat_sketch(const uint8_t* buf, const int64_t* rec_offs,
     for (int k = 0; k < 8; ++k) cell[k] += w[k];
   }
   delete[] scratch;
+  return 0;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Thread-parallel dat_decode_changes: rows are independent, so ranges
+// decode concurrently via parallel_for; the reported error is the
+// MINIMUM offending index across ranges (atomic fetch-min), preserving
+// the serial entry point's first-corrupt-record semantics.
+// nthreads <= 0 = auto.
+int64_t dat_decode_changes_mt(const uint8_t* buf, const int64_t* starts,
+                              const int64_t* lens, int64_t n,
+                              uint32_t* change, uint32_t* from_v,
+                              uint32_t* to_v, int64_t* key_off,
+                              int64_t* key_len, int64_t* sub_off,
+                              int64_t* sub_len, int64_t* val_off,
+                              int64_t* val_len, int64_t* err_index,
+                              int64_t nthreads) {
+  std::atomic<int64_t> first(INT64_MAX);
+  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi) {
+    int64_t bad = decode_changes_range(buf, starts, lens, lo, hi, change,
+                                       from_v, to_v, key_off, key_len,
+                                       sub_off, sub_len, val_off, val_len);
+    if (bad >= 0) {
+      int64_t cur = first.load(std::memory_order_relaxed);
+      while (bad < cur &&
+             !first.compare_exchange_weak(cur, bad,
+                                          std::memory_order_relaxed)) {
+      }
+    }
+  });
+  if (first.load() != INT64_MAX) {
+    *err_index = first.load();
+    return DAT_ERR_BAD_RECORD;
+  }
   return 0;
 }
 

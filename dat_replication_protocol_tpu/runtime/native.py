@@ -89,6 +89,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
         _U8P, ctypes.c_int64,
     ]
+    lib.dat_decode_changes_mt.restype = ctypes.c_int64
+    lib.dat_decode_changes_mt.argtypes = [
+        _U8P, _I64P, _I64P, ctypes.c_int64,
+        _U32P, _U32P, _U32P,
+        _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
     lib.dat_blake2b_many.restype = ctypes.c_int64
     lib.dat_blake2b_many.argtypes = [
         _U8P, _I64P, _I64P, ctypes.c_int64, _U8P, ctypes.c_int64,

@@ -194,13 +194,13 @@ def decode_change_columns(buf: np.ndarray, starts: np.ndarray,
     lib = native.get_lib()
     if lib is not None and n:
         err = ctypes.c_int64(-1)
-        rc = lib.dat_decode_changes(
+        rc = lib.dat_decode_changes_mt(
             buf, starts, lens, n,
             cols.change, cols.from_, cols.to,
             cols.key_off, cols.key_len,
             cols.sub_off, cols.sub_len,
             cols.val_off, cols.val_len,
-            ctypes.byref(err),
+            ctypes.byref(err), native._nthreads(),
         )
         if rc != 0:
             raise ProtocolError(

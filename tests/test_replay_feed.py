@@ -320,3 +320,39 @@ def test_encode_change_columns_mixed_log_keeps_changes_only():
     assert replay.encode_change_columns(cols) == c1 + c2
     empty_cols, _ = replay.replay_log(np.frombuffer(blob, np.uint8))
     assert replay.encode_change_columns(empty_cols) == b""
+
+
+def test_parallel_decode_matches_serial_and_reports_first_error(monkeypatch):
+    """dat_decode_changes_mt must produce identical columns to the serial
+    path and report the FIRST corrupt record index even when a later
+    thread's range also holds corruption."""
+    import numpy as np
+    import pytest
+
+    from dat_replication_protocol_tpu.runtime import native, replay
+    from dat_replication_protocol_tpu.wire.change_codec import encode_change
+    from dat_replication_protocol_tpu.wire.framing import TYPE_CHANGE, frame
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    monkeypatch.setenv("DAT_NTHREADS", "4")  # force the fan-out path
+    recs = [frame(TYPE_CHANGE, encode_change({
+        "key": f"k{i}", "change": i, "from": i, "to": i + 1,
+        "value": b"v" * (i % 7),
+    })) for i in range(20_000)]
+    buf = np.frombuffer(b"".join(recs), np.uint8)
+    cols, frames = replay.replay_log(buf)
+    assert len(cols) == 20_000
+    assert cols.row(12_345).key == "k12345"
+
+    # corrupt two records in different thread ranges; the reported index
+    # must be the earlier one
+    offs = np.cumsum([len(r) for r in recs])
+    mutable = bytearray(b"".join(recs))
+    for victim in (5_000, 15_000):
+        start = offs[victim - 1] if victim else 0
+        mutable[start + 2] = 0x07  # wire-type 7: invalid
+    bad = np.frombuffer(bytes(mutable), np.uint8)
+    fi = replay.split_frames(bad)
+    with pytest.raises(replay.ProtocolError, match="index 5000"):
+        replay.decode_change_columns(bad, fi.starts, fi.lens)
