@@ -53,6 +53,7 @@ enum {
   DAT_ERR_CAPACITY = -2,
   DAT_ERR_BAD_VARINT = -3,
   DAT_ERR_BAD_RECORD = -4,
+  DAT_ERR_NOMEM = -5,
 };
 
 // Split a multibuffer stream into frames.
@@ -270,6 +271,59 @@ inline int64_t write_uvarint(uint8_t* dst, int64_t i, uint64_t v) {
   return i;
 }
 
+// proto payload size of record r (fields in ascending field-number order,
+// absent optionals omitted) — shared by the serial and parallel encoders.
+inline int64_t change_payload_size(int64_t r, const uint32_t* change,
+                                   const uint32_t* from_v,
+                                   const uint32_t* to_v,
+                                   const int64_t* key_len,
+                                   const int64_t* sub_len,
+                                   const int64_t* val_len) {
+  int64_t psize = 0;
+  if (sub_len[r] >= 0) psize += 1 + uvarint_size(sub_len[r]) + sub_len[r];
+  psize += 1 + uvarint_size(key_len[r]) + key_len[r];
+  psize += 1 + uvarint_size(change[r]);
+  psize += 1 + uvarint_size(from_v[r]);
+  psize += 1 + uvarint_size(to_v[r]);
+  if (val_len[r] >= 0) psize += 1 + uvarint_size(val_len[r]) + val_len[r];
+  return psize;
+}
+
+// Encode record r's full frame at dst[w]; returns the new write offset.
+// TAG_* come from the file-scope enum shared with the decoder.
+int64_t encode_change_at(const uint8_t* src, int64_t r, int64_t psize,
+                         const uint32_t* change, const uint32_t* from_v,
+                         const uint32_t* to_v, const int64_t* key_off,
+                         const int64_t* key_len, const int64_t* sub_off,
+                         const int64_t* sub_len, const int64_t* val_off,
+                         const int64_t* val_len, uint8_t* dst, int64_t w) {
+  w = write_uvarint(dst, w, psize + 1);
+  dst[w++] = 1;  // TYPE_CHANGE
+  if (sub_len[r] >= 0) {
+    dst[w++] = TAG_SUBSET;
+    w = write_uvarint(dst, w, sub_len[r]);
+    for (int64_t k = 0; k < sub_len[r]; ++k) dst[w + k] = src[sub_off[r] + k];
+    w += sub_len[r];
+  }
+  dst[w++] = TAG_KEY;
+  w = write_uvarint(dst, w, key_len[r]);
+  for (int64_t k = 0; k < key_len[r]; ++k) dst[w + k] = src[key_off[r] + k];
+  w += key_len[r];
+  dst[w++] = TAG_CHANGE;
+  w = write_uvarint(dst, w, change[r]);
+  dst[w++] = TAG_FROM;
+  w = write_uvarint(dst, w, from_v[r]);
+  dst[w++] = TAG_TO;
+  w = write_uvarint(dst, w, to_v[r]);
+  if (val_len[r] >= 0) {
+    dst[w++] = TAG_VALUE;
+    w = write_uvarint(dst, w, val_len[r]);
+    for (int64_t k = 0; k < val_len[r]; ++k) dst[w + k] = src[val_off[r] + k];
+    w += val_len[r];
+  }
+  return w;
+}
+
 }  // namespace
 
 extern "C" {
@@ -288,44 +342,12 @@ int64_t dat_encode_changes(const uint8_t* src, int64_t n,
                            int64_t cap) {
   int64_t w = 0;
   for (int64_t r = 0; r < n; ++r) {
-    // payload size
-    int64_t psize = 0;
-    if (sub_len[r] >= 0)
-      psize += 1 + uvarint_size(sub_len[r]) + sub_len[r];
-    psize += 1 + uvarint_size(key_len[r]) + key_len[r];
-    psize += 1 + uvarint_size(change[r]);
-    psize += 1 + uvarint_size(from_v[r]);
-    psize += 1 + uvarint_size(to_v[r]);
-    if (val_len[r] >= 0)
-      psize += 1 + uvarint_size(val_len[r]) + val_len[r];
+    int64_t psize = change_payload_size(r, change, from_v, to_v, key_len,
+                                        sub_len, val_len);
     int64_t need = uvarint_size(psize + 1) + 1 + psize;
     if (w + need > cap) return DAT_ERR_CAPACITY;
-    w = write_uvarint(dst, w, psize + 1);
-    dst[w++] = 1;  // TYPE_CHANGE
-    if (sub_len[r] >= 0) {
-      dst[w++] = TAG_SUBSET;
-      w = write_uvarint(dst, w, sub_len[r]);
-      for (int64_t k = 0; k < sub_len[r]; ++k)
-        dst[w + k] = src[sub_off[r] + k];
-      w += sub_len[r];
-    }
-    dst[w++] = TAG_KEY;
-    w = write_uvarint(dst, w, key_len[r]);
-    for (int64_t k = 0; k < key_len[r]; ++k) dst[w + k] = src[key_off[r] + k];
-    w += key_len[r];
-    dst[w++] = TAG_CHANGE;
-    w = write_uvarint(dst, w, change[r]);
-    dst[w++] = TAG_FROM;
-    w = write_uvarint(dst, w, from_v[r]);
-    dst[w++] = TAG_TO;
-    w = write_uvarint(dst, w, to_v[r]);
-    if (val_len[r] >= 0) {
-      dst[w++] = TAG_VALUE;
-      w = write_uvarint(dst, w, val_len[r]);
-      for (int64_t k = 0; k < val_len[r]; ++k)
-        dst[w + k] = src[val_off[r] + k];
-      w += val_len[r];
-    }
+    w = encode_change_at(src, r, psize, change, from_v, to_v, key_off,
+                         key_len, sub_off, sub_len, val_off, val_len, dst, w);
   }
   return w;
 }
@@ -487,7 +509,7 @@ int64_t dat_sketch(const uint8_t* buf, const int64_t* rec_offs,
                    const int64_t* key_lens, int64_t n, int64_t log2_slots,
                    uint32_t* table, uint32_t* slots, int64_t nthreads) {
   uint8_t* scratch = new (std::nothrow) uint8_t[static_cast<size_t>(n) * 32];
-  if (scratch == nullptr && n > 0) return DAT_ERR_CAPACITY;
+  if (scratch == nullptr && n > 0) return DAT_ERR_NOMEM;
   const uint32_t mask = (log2_slots >= 32)
                             ? 0xffffffffu
                             : ((1u << log2_slots) - 1u);
@@ -546,6 +568,53 @@ int64_t dat_decode_changes_mt(const uint8_t* buf, const int64_t* starts,
     return DAT_ERR_BAD_RECORD;
   }
   return 0;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Thread-parallel bulk encode: pass 1 sizes every frame concurrently, a
+// serial prefix sum assigns offsets, pass 2 writes every frame at its
+// offset concurrently.  Byte-identical to dat_encode_changes (same
+// helpers).  Returns bytes written, or DAT_ERR_CAPACITY.
+int64_t dat_encode_changes_mt(const uint8_t* src, int64_t n,
+                              const uint32_t* change, const uint32_t* from_v,
+                              const uint32_t* to_v, const int64_t* key_off,
+                              const int64_t* key_len, const int64_t* sub_off,
+                              const int64_t* sub_len, const int64_t* val_off,
+                              const int64_t* val_len, uint8_t* dst,
+                              int64_t cap, int64_t nthreads) {
+  int64_t* offs = new (std::nothrow) int64_t[static_cast<size_t>(n) + 1];
+  if (offs == nullptr) return DAT_ERR_NOMEM;
+  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t psize = change_payload_size(r, change, from_v, to_v, key_len,
+                                          sub_len, val_len);
+      offs[r] = uvarint_size(psize + 1) + 1 + psize;
+    }
+  });
+  int64_t total = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    int64_t sz = offs[r];
+    offs[r] = total;
+    total += sz;
+  }
+  offs[n] = total;
+  if (total > cap) {
+    delete[] offs;
+    return DAT_ERR_CAPACITY;
+  }
+  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t psize = change_payload_size(r, change, from_v, to_v, key_len,
+                                          sub_len, val_len);
+      encode_change_at(src, r, psize, change, from_v, to_v, key_off, key_len,
+                       sub_off, sub_len, val_off, val_len, dst, offs[r]);
+    }
+  });
+  delete[] offs;
+  return total;
 }
 
 }  // extern "C"

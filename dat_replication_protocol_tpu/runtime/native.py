@@ -33,6 +33,7 @@ ERR_TRUNCATED = -1
 ERR_CAPACITY = -2
 ERR_BAD_VARINT = -3
 ERR_BAD_RECORD = -4
+ERR_NOMEM = -5
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -88,6 +89,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _U32P, _U32P, _U32P,
         _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
         _U8P, ctypes.c_int64,
+    ]
+    lib.dat_encode_changes_mt.restype = ctypes.c_int64
+    lib.dat_encode_changes_mt.argtypes = [
+        _U8P, ctypes.c_int64,
+        _U32P, _U32P, _U32P,
+        _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
+        _U8P, ctypes.c_int64, ctypes.c_int64,
     ]
     lib.dat_decode_changes_mt.restype = ctypes.c_int64
     lib.dat_decode_changes_mt.argtypes = [

@@ -320,8 +320,9 @@ def _native_encode(lib, src, payload_bytes: int, n, chg, frm, tov,
     64/record is safe) + error check."""
     cap = int(payload_bytes + n * 64 + 64)
     dst = np.empty(cap, np.uint8)
-    w = lib.dat_encode_changes(
-        src, n, chg, frm, tov, koff, klen, soff, slen, voff, vlen, dst, cap
+    w = lib.dat_encode_changes_mt(
+        src, n, chg, frm, tov, koff, klen, soff, slen, voff, vlen, dst, cap,
+        native._nthreads(),
     )
     if w < 0:
         raise RuntimeError(f"native encode failed (code {w})")

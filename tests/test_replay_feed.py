@@ -356,3 +356,31 @@ def test_parallel_decode_matches_serial_and_reports_first_error(monkeypatch):
     fi = replay.split_frames(bad)
     with pytest.raises(replay.ProtocolError, match="index 5000"):
         replay.decode_change_columns(bad, fi.starts, fi.lens)
+
+
+def test_parallel_encode_byte_identical(monkeypatch):
+    """dat_encode_changes_mt (size pass + prefix sum + parallel write)
+    must be byte-identical to the serial encoder and to the per-record
+    Python codec, across absent/present-empty optionals."""
+    import numpy as np
+    import pytest
+
+    from dat_replication_protocol_tpu.runtime import native, replay
+    from dat_replication_protocol_tpu.wire.change_codec import encode_change
+    from dat_replication_protocol_tpu.wire.framing import TYPE_CHANGE, frame
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    monkeypatch.setenv("DAT_NTHREADS", "4")
+    recs = []
+    for i in range(30_000):
+        r = {"key": f"key-{i}", "change": i, "from": i, "to": i + 1}
+        if i % 3 == 0:
+            r["value"] = b"v" * (i % 11)  # incl. present-empty at i%11==0
+        if i % 5 == 0:
+            r["subset"] = "s" * (i % 4)
+        recs.append(r)
+    expected = b"".join(frame(TYPE_CHANGE, encode_change(r)) for r in recs)
+    cols, _ = replay.replay_log(np.frombuffer(expected, np.uint8))
+    assert replay.encode_change_columns(cols) == expected
+    assert replay.encode_change_log(recs) == expected
