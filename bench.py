@@ -638,6 +638,40 @@ def bench_cdc(quick: bool, backend: str) -> dict:
     slab_bytes = slab_mib << 20
     avg_bits = 13
 
+    if not on_tpu:
+        from dat_replication_protocol_tpu.runtime import native
+        from dat_replication_protocol_tpu.utils.routing import prefer_host
+
+        # the branch label must match what chunk_stream will actually
+        # route to: prefer_host consults the same decision (and the
+        # DAT_DEVICE_CDC override) chunk_stream does
+        if prefer_host("DAT_DEVICE_CDC") and native.available():
+            # the engine the routing layer actually picks on a CPU host:
+            # the native C gear scan + native greedy select through
+            # chunk_stream ("batch or stay home" — the XLA-scan path
+            # measures ~0.0002 GiB/s here and represents nothing a user
+            # would run)
+            host_mib = _env_int("BENCH_CDC_HOST_MIB", 64 if quick else 256)
+            data = np.random.default_rng(7).integers(
+                0, 256, host_mib << 20, dtype=np.uint8
+            )
+            rabin.chunk_stream(data[: 4 << 20], avg_bits=avg_bits)  # warm
+            t0 = time.perf_counter()
+            cuts = rabin.chunk_stream(data, avg_bits=avg_bits)
+            dt = time.perf_counter() - t0
+            gib_s = data.nbytes / dt / (1 << 30)
+            log(f"bench[cdc]: native host engine {gib_s:.2f} GiB/s "
+                f"({len(cuts)} chunks / {host_mib} MiB)")
+            return {
+                "metric": "cdc_chunking_throughput",
+                "value": round(gib_s, 3),
+                "unit": "GiB/s",
+                "vs_baseline": None,
+                "volume_gib": round(data.nbytes / (1 << 30), 2),
+                "engine": "native-host",
+                "chunks": len(cuts),
+            }
+
     # the blob lives in HBM (the framework's hot path hashes/chunks data
     # that the feed layer already staged on device); the timed loop is
     # kernel + on-device sparse extraction + O(candidates) D2H + greedy

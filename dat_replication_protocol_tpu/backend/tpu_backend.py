@@ -62,30 +62,13 @@ def _device_hash_begin_factory():
     ``=0`` forces the host engine."""
     import os  # noqa: PLC0415
 
-    force = os.environ.get("DAT_DEVICE_HASH")
-    if force == "0":
+    from ..utils.routing import prefer_host  # noqa: PLC0415
+
+    if prefer_host("DAT_DEVICE_HASH"):
         return None
     try:
         from ..ops.blake2b import blake2b_batch_begin  # noqa: PLC0415
 
-        if force == "1":
-            return blake2b_batch_begin
-        import jax  # noqa: PLC0415
-
-        # Read the CONFIGURED platform rather than calling
-        # jax.default_backend(): the latter initializes the backend in
-        # this process, which on a wedged device tunnel hangs with no
-        # timeout (observed >6h) — inside a constructor whose job here
-        # is merely to *route*.  A configured platform string decides
-        # without any init; only when nothing is configured (jax picks
-        # from locally present plugins — nothing to wedge on) do we ask
-        # the initialized backend.
-        cfg = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS")
-        if cfg:
-            first = cfg.split(",")[0].strip().lower()
-            return None if first == "cpu" else blake2b_batch_begin
-        if jax.default_backend() == "cpu":
-            return None
         return blake2b_batch_begin
     except Exception:
         return None

@@ -588,6 +588,30 @@ def chunk_stream(
         return []
 
     thin_bits = max(min_size, 1).bit_length() - 1  # floor log2: W <= min_size
+
+    # "batch or stay home": on a CPU-only jax the XLA-scan formulation
+    # of the gear loop is catastrophically slow (~0.0002 GiB/s e2e
+    # measured), while the native C table-driven scan does ~1.2 GiB/s
+    # per core — same seeded-stream definition, identical candidates
+    # (tested).  DAT_DEVICE_CDC=1/0 overrides.
+    from ..utils.routing import prefer_host
+
+    if prefer_host("DAT_DEVICE_CDC"):
+        from ..runtime import native
+
+        # mirror the device path's thinning clamps (candidates_begin):
+        # <5 -> no thinning, cap at 16 AND at tile_bytes' largest
+        # power-of-two divisor — so host and device paths produce
+        # identical candidate sets and therefore identical cuts for any
+        # tile_bytes
+        tz = (tile_bytes & -tile_bytes).bit_length() - 1
+        host_thin_bits = min(thin_bits, tz, 16) if thin_bits >= 5 else -1
+        if host_thin_bits < 5:
+            host_thin_bits = -1
+        cands = native.gear_candidates(buf, avg_bits, host_thin_bits)
+        if cands is not None:
+            return _greedy_select(cands, length, min_size, max_size)
+
     candidates = _device_candidates(
         buf, avg_bits, tile_bytes, slab_tiles, thin_bits
     )

@@ -104,6 +104,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
     ]
+    lib.dat_gear_candidates.restype = ctypes.c_int64
+    lib.dat_gear_candidates.argtypes = [
+        _U8P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        _I64P, ctypes.c_int64,
+    ]
     lib.dat_blake2b_many.restype = ctypes.c_int64
     lib.dat_blake2b_many.argtypes = [
         _U8P, _I64P, _I64P, ctypes.c_int64, _U8P, ctypes.c_int64,
@@ -186,3 +191,25 @@ def sketch(buf: np.ndarray, rec_offs, rec_lens, key_offs, key_lens,
     if rc != 0:
         return None
     return table, slots
+
+
+def gear_candidates(buf: np.ndarray, avg_bits: int, thin_bits: int = -1):
+    """Host gear CDC candidate scan (seeded-stream definition); sorted
+    absolute positions as int64, or None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    n = len(buf)
+    cap = max(256, (n >> max(avg_bits - 2, 0)) + 16)
+    if thin_bits >= 0:
+        cap = min(cap, (n >> thin_bits) + 16)
+    while True:
+        out = np.empty(cap, dtype=np.int64)
+        rc = lib.dat_gear_candidates(buf, n, avg_bits, thin_bits, out, cap)
+        if rc == ERR_CAPACITY:
+            cap *= 4
+            continue
+        if rc < 0:
+            return None
+        return out[:rc]

@@ -1,0 +1,35 @@
+"""Engine routing: device batches when an accelerator backs jax, host
+engines otherwise — "batch or stay home" (DESIGN.md §2 rule 0).
+
+One owner of the hang-safe backend decision: reading the CONFIGURED
+platform string decides without initializing any backend (an in-process
+init on a wedged device tunnel hangs with no timeout — observed >6h);
+only when nothing is configured (jax picks from locally present
+plugins, nothing to wedge on) is the initialized backend consulted.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def prefer_host(force_env: str) -> bool:
+    """True when host engines should take batch work on this host.
+
+    ``force_env`` names an override variable: ``"1"`` forces the device
+    path, ``"0"`` forces the host path (tests / experiments).
+    """
+    force = os.environ.get(force_env)
+    if force == "0":
+        return True
+    if force == "1":
+        return False
+    try:
+        import jax  # noqa: PLC0415
+
+        cfg = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS")
+        if cfg:
+            return cfg.split(",")[0].strip().lower() == "cpu"
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return True

@@ -182,3 +182,35 @@ def test_first_hit_pallas_interpret_matches_tiled():
     tiled = np.asarray(rabin.gear_first_tiled(words, 8))
     pallas = np.asarray(gear_first_pallas(words, 8, interpret=True))
     assert np.array_equal(tiled, pallas)
+
+
+def test_host_and_device_chunk_stream_identical(monkeypatch):
+    """The CPU-routed native gear scan must produce the exact cuts the
+    device slab path produces — same seeded-stream candidates, same
+    thinning policy, same greedy select."""
+    import numpy as np
+    import pytest
+
+    from dat_replication_protocol_tpu.ops import rabin
+    from dat_replication_protocol_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(21)
+    # ~640 KiB: crosses several 128 KiB tiles (prefix/thinning seams)
+    # while keeping the deliberately-slow forced XLA leg affordable
+    data = rng.integers(0, 256, 5 * (1 << 17) + 777, dtype=np.uint8)
+    monkeypatch.setenv("DAT_DEVICE_CDC", "0")  # force host scan
+    host_cuts = rabin.chunk_stream(data, avg_bits=10)
+    monkeypatch.setenv("DAT_DEVICE_CDC", "1")  # force device slab path
+    dev_cuts = rabin.chunk_stream(data, avg_bits=10)
+    assert list(host_cuts) == list(dev_cuts)
+    assert host_cuts[-1] == len(data)
+    # tiny min_size exercises the no-thinning clamp on both paths
+    monkeypatch.setenv("DAT_DEVICE_CDC", "0")
+    h2 = rabin.chunk_stream(data[: 1 << 17], avg_bits=6, min_size=16,
+                            max_size=1 << 12)
+    monkeypatch.setenv("DAT_DEVICE_CDC", "1")
+    d2 = rabin.chunk_stream(data[: 1 << 17], avg_bits=6, min_size=16,
+                            max_size=1 << 12)
+    assert list(h2) == list(d2)
