@@ -214,3 +214,17 @@ def test_hash_engine_routing_follows_backend(monkeypatch):
     assert tb._device_hash_begin_factory() is not None  # forced device path
     monkeypatch.setenv("DAT_DEVICE_HASH", "0")
     assert tb._device_hash_begin_factory() is None
+
+
+def test_prefer_host_override_combinations(monkeypatch):
+    """prefer_host: env override wins, then the configured platform
+    string, and the decision never initializes a device backend."""
+    from dat_replication_protocol_tpu.utils.routing import prefer_host
+
+    monkeypatch.setenv("X_ROUTE", "0")
+    assert prefer_host("X_ROUTE") is True  # forced host
+    monkeypatch.setenv("X_ROUTE", "1")
+    assert prefer_host("X_ROUTE") is False  # forced device
+    monkeypatch.delenv("X_ROUTE", raising=False)
+    # test env configures the cpu platform (conftest): host wins
+    assert prefer_host("X_ROUTE") is True
