@@ -297,6 +297,40 @@ def diff_leaves(a_digests: list[bytes], b_digests: list[bytes]) -> list[int]:
     return np.nonzero(np.asarray(mask)[: len(a_digests)])[0].tolist()
 
 
+def diff_snapshots(a_hh, a_hl, b_hh, b_hl) -> np.ndarray:
+    """Differing leaf indices between two LOCAL equal-width snapshots,
+    routed by backend ("batch or stay home", DESIGN.md §2 rule 0):
+
+    * accelerator-backed jax — the tree-guided packed diff
+      (:func:`diff_root_guided_packed`): compare work stays in HBM and
+      one bit per leaf crosses D2H;
+    * CPU-backed jax — one vectorized elementwise compare: when both
+      snapshots already sit in host memory the tree build buys nothing
+      locally (the O(diff · log n) walk is the *device* and *remote*
+      story — :mod:`..runtime.tree_sync` for the wire).
+
+    ``DAT_DEVICE_MERKLE=1/0`` overrides.  Both paths return identical
+    indices (tested).
+    """
+    from ..utils.routing import prefer_host
+
+    n = a_hh.shape[0]
+    if b_hh.shape[0] != n:
+        raise ValueError("snapshots must have equal (padded) leaf counts")
+    if n & (n - 1):
+        # enforce the device branch's precondition on BOTH paths: code
+        # developed against the host compare must not start crashing the
+        # moment it runs on an accelerator
+        raise ValueError(f"leaf count {n} is not a power of two; pad first")
+    if prefer_host("DAT_DEVICE_MERKLE"):
+        a1, a2 = np.asarray(a_hh), np.asarray(a_hl)
+        b1, b2 = np.asarray(b_hh), np.asarray(b_hl)
+        dense = ((a1 != b1) | (a2 != b2)).any(axis=1)
+        return np.nonzero(dense)[0]
+    bits, _, _ = diff_root_guided_packed(a_hh, a_hl, b_hh, b_hl)
+    return np.nonzero(unpack_mask(bits, n))[0]
+
+
 def prove(levels_hh, levels_hl, idx: int) -> list[bytes]:
     """Inclusion proof for leaf ``idx``: the sibling digest per level.
 

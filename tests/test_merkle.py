@@ -225,3 +225,36 @@ def test_proof_rejects_out_of_range_index():
     # with a truncated path must not verify (depth is pinned to nleaves)
     interior = merkle.host_parent(leaves[0], leaves[1])
     assert not merkle.verify_proof(root_bytes, interior, 0, path[1:], 64)
+
+
+def test_diff_snapshots_routes_identically(monkeypatch):
+    """The routed local diff must return the same indices from both the
+    host compare and the tree-guided device path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dat_replication_protocol_tpu.ops import merkle
+
+    keys = jax.random.split(jax.random.PRNGKey(2), 3)
+    n = 1 << 10
+    a_hh = jax.random.bits(keys[0], (n, 4), dtype=jnp.uint32)
+    a_hl = jax.random.bits(keys[1], (n, 4), dtype=jnp.uint32)
+    flip = jax.random.bernoulli(keys[2], 0.02, (n, 1))
+    flip_lo = jax.random.bernoulli(jax.random.PRNGKey(7), 0.02, (n, 1))
+    b_hh = jnp.where(flip, a_hh ^ 1, a_hh)
+    b_hl = jnp.where(flip_lo, a_hl ^ 1, a_hl)  # differences in BOTH halves
+    monkeypatch.setenv("DAT_DEVICE_MERKLE", "0")
+    host = merkle.diff_snapshots(a_hh, a_hl, b_hh, b_hl)
+    monkeypatch.setenv("DAT_DEVICE_MERKLE", "1")
+    tree = merkle.diff_snapshots(a_hh, a_hl, b_hh, b_hl)
+    assert np.array_equal(host, tree)
+    assert len(host) == int((flip | flip_lo).sum())
+    # unpadded widths must fail identically on both paths
+    import pytest
+
+    for env in ("0", "1"):
+        monkeypatch.setenv("DAT_DEVICE_MERKLE", env)
+        with pytest.raises(ValueError, match="power of two"):
+            merkle.diff_snapshots(a_hh[:1000], a_hl[:1000],
+                                  b_hh[:1000], b_hl[:1000])
