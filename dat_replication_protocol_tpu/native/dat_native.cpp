@@ -366,6 +366,7 @@ int64_t dat_encode_changes(const uint8_t* src, int64_t n,
 // ---------------------------------------------------------------------------
 
 #include <cstring>
+#include <algorithm>
 #include <atomic>
 #include <thread>
 #include <vector>
@@ -454,8 +455,11 @@ void b2b_hash256(const uint8_t* data, int64_t len, uint8_t out[32]) {
 inline int pick_threads(int64_t requested, int64_t n, int64_t min_per) {
   int64_t hw = static_cast<int64_t>(std::thread::hardware_concurrency());
   if (hw <= 0) hw = 1;
+  // an EXPLICIT request is honored even past the core count
+  // (oversubscription is merely slower; it also lets the parallel
+  // paths be exercised on single-core test machines) — only the auto
+  // default clamps to the hardware
   int64_t t = requested > 0 ? requested : hw;
-  if (t > hw) t = hw;
   if (t > n / min_per) t = n / min_per;  // don't spawn for tiny batches
   return static_cast<int>(t < 1 ? 1 : t);
 }
@@ -619,20 +623,56 @@ int64_t dat_encode_changes_mt(const uint8_t* src, int64_t n,
 
 }  // extern "C"
 
+namespace {
+
+// One gear scan over buf[lo, hi): h is fully determined by the WINDOW
+// bytes preceding a position (contributions shift out after 64 steps),
+// so any range can be scanned independently by warming the state from
+// the 64 bytes before it — the same seeding trick the device tiling
+// uses, which is what makes the "rolling" scan embarrassingly parallel.
+// Emits into vec (window-thinned locally; window straddles across
+// range boundaries are resolved by the caller's merge).
+void gear_scan_range(const uint8_t* buf, int64_t lo, int64_t hi,
+                     const uint64_t* tab, uint32_t mask, int64_t thin_bits,
+                     std::vector<int64_t>* vec) {
+  uint64_t h = 0;
+  if (lo == 0) {
+    for (int64_t k = 0; k < 64; ++k) h = (h << 1) + tab[0];  // zero seed
+  } else {
+    for (int64_t k = lo - 64; k < lo; ++k) h = (h << 1) + tab[buf[k]];
+  }
+  int64_t last_win = -1;
+  for (int64_t j = lo; j < hi; ++j) {
+    h = (h << 1) + tab[buf[j]];
+    if (((static_cast<uint32_t>(h >> 32)) & mask) == 0) {
+      if (thin_bits >= 0) {
+        int64_t win = j >> thin_bits;
+        if (win == last_win) continue;
+        last_win = win;
+      }
+      vec->push_back(j);
+    }
+  }
+}
+
+}  // namespace
+
 extern "C" {
 
 // Host gear CDC scan: the seeded-stream definition (ops/rabin.py
-// host_candidates) in one C pass — h seeded by WINDOW zero-byte
-// updates, then per byte h = (h << 1) + g[b], candidate where the top
-// word masks to zero.  g[b] = (b+1)*C1 | ((b+1)*C2 << 32) is a 256-entry
-// table, so the loop is ~4 ops/byte.  thin_bits >= 0 keeps only the
-// first candidate per aligned 2**thin_bits window (the chunking policy);
-// pass -1 for every candidate.  Returns the candidate count (<= cap;
-// DAT_ERR_CAPACITY on overflow).  Serves CPU-routed chunk_stream —
-// "batch or stay home" applies to chunking like hashing: the XLA scan
-// formulation of this loop measures ~0.0002 GiB/s e2e on a CPU host.
+// host_candidates) — per byte h = (h << 1) + g[b], candidate where the
+// top word masks to zero.  g[b] = (b+1)*C1 | ((b+1)*C2 << 32) is a
+// 256-entry table, so the loop is ~4 ops/byte (~1.2 GiB/s per core),
+// and ranges scan thread-parallel (see gear_scan_range).  thin_bits >=
+// 0 keeps only the first candidate per aligned 2**thin_bits window (the
+// chunking policy); pass -1 for every candidate.  Returns the candidate
+// count (<= cap; DAT_ERR_CAPACITY on overflow).  Serves CPU-routed
+// chunk_stream — "batch or stay home" applies to chunking like hashing:
+// the XLA scan formulation of this loop measures ~0.0002 GiB/s e2e on a
+// CPU host.
 int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
-                            int64_t thin_bits, int64_t* out, int64_t cap) {
+                            int64_t thin_bits, int64_t* out, int64_t cap,
+                            int64_t nthreads) {
   const uint32_t c1 = 0x9E3779B1u, c2 = 0x85EBCA77u;
   uint64_t tab[256];
   for (uint32_t b = 0; b < 256; ++b) {
@@ -641,13 +681,31 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
     tab[b] = lo | (hi << 32);
   }
   const uint32_t mask = (1u << avg_bits) - 1u;
-  uint64_t h = 0;
-  for (int64_t k = 0; k < 64; ++k) h = (h << 1) + tab[0];  // WINDOW seed
+  int nt = pick_threads(nthreads, n, 1 << 22);  // >= 4 MiB per thread
+  if (nt <= 1) {
+    // serial fast path: write straight into out, fail fast on overflow
+    std::vector<int64_t> v;
+    v.reserve(static_cast<size_t>(cap < 4096 ? cap : 4096));
+    gear_scan_range(buf, 0, n, tab, mask, thin_bits, &v);
+    if (static_cast<int64_t>(v.size()) > cap) return DAT_ERR_CAPACITY;
+    std::copy(v.begin(), v.end(), out);
+    return static_cast<int64_t>(v.size());
+  }
+  // parallel_for owns the fan-out; ranges recover their slot from lo
+  // (same per arithmetic parallel_for uses for the same nt)
+  std::vector<std::vector<int64_t>> found(static_cast<size_t>(nt));
+  int64_t per = (n + nt - 1) / nt;
+  parallel_for(n, nt, 1 << 22, [&](int64_t lo, int64_t hi) {
+    gear_scan_range(buf, lo, hi, tab, mask, thin_bits, &found[lo / per]);
+  });
+  // merge, resolving window straddles at range seams: thinning keeps
+  // the FIRST candidate per aligned window, so a later range's
+  // candidate in a window already owned by the previous range is
+  // dropped (identical to the serial scan's output)
   int64_t m = 0;
   int64_t last_win = -1;
-  for (int64_t j = 0; j < n; ++j) {
-    h = (h << 1) + tab[buf[j]];
-    if (((static_cast<uint32_t>(h >> 32)) & mask) == 0) {
+  for (auto& v : found) {
+    for (int64_t j : v) {
       if (thin_bits >= 0) {
         int64_t win = j >> thin_bits;
         if (win == last_win) continue;

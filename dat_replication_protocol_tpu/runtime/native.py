@@ -107,7 +107,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dat_gear_candidates.restype = ctypes.c_int64
     lib.dat_gear_candidates.argtypes = [
         _U8P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-        _I64P, ctypes.c_int64,
+        _I64P, ctypes.c_int64, ctypes.c_int64,
     ]
     lib.dat_blake2b_many.restype = ctypes.c_int64
     lib.dat_blake2b_many.argtypes = [
@@ -206,7 +206,8 @@ def gear_candidates(buf: np.ndarray, avg_bits: int, thin_bits: int = -1):
         cap = min(cap, (n >> thin_bits) + 16)
     while True:
         out = np.empty(cap, dtype=np.int64)
-        rc = lib.dat_gear_candidates(buf, n, avg_bits, thin_bits, out, cap)
+        rc = lib.dat_gear_candidates(buf, n, avg_bits, thin_bits, out, cap,
+                                     _nthreads())
         if rc == ERR_CAPACITY:
             cap *= 4
             continue

@@ -214,3 +214,25 @@ def test_host_and_device_chunk_stream_identical(monkeypatch):
     d2 = rabin.chunk_stream(data[: 1 << 17], avg_bits=6, min_size=16,
                             max_size=1 << 12)
     assert list(h2) == list(d2)
+
+
+def test_parallel_gear_scan_matches_serial(monkeypatch):
+    """The thread-parallel host scan (range seeding from the preceding
+    WINDOW bytes + seam-resolving thinned merge) must be byte-identical
+    to the serial scan, incl. windows straddling range boundaries."""
+    import numpy as np
+    import pytest
+
+    from dat_replication_protocol_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(31)
+    # > 16 MiB so pick_threads engages multiple ranges at DAT_NTHREADS=4
+    data = rng.integers(0, 256, (24 << 20) + 999, dtype=np.uint8)
+    for thin in (-1, 8, 11):
+        monkeypatch.setenv("DAT_NTHREADS", "1")
+        serial = native.gear_candidates(data, 12, thin)
+        monkeypatch.setenv("DAT_NTHREADS", "4")
+        par = native.gear_candidates(data, 12, thin)
+        assert np.array_equal(serial, par), f"thin_bits={thin}"
