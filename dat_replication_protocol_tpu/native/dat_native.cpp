@@ -455,31 +455,35 @@ void b2b_hash256(const uint8_t* data, int64_t len, uint8_t out[32]) {
 inline int pick_threads(int64_t requested, int64_t n, int64_t min_per) {
   int64_t hw = static_cast<int64_t>(std::thread::hardware_concurrency());
   if (hw <= 0) hw = 1;
-  // an EXPLICIT request is honored even past the core count
-  // (oversubscription is merely slower; it also lets the parallel
-  // paths be exercised on single-core test machines) — only the auto
-  // default clamps to the hardware
+  // an EXPLICIT request may exceed the core count (bounded
+  // oversubscription is merely slower, and it lets the parallel paths
+  // be exercised on single-core test machines), but never unboundedly:
+  // a huge DAT_NTHREADS must not hit the OS thread limit and abort the
+  // process mid-spawn.  Only the auto default clamps to the hardware.
   int64_t t = requested > 0 ? requested : hw;
+  int64_t ceil_t = hw * 4 < 64 ? hw * 4 : 64;
+  if (requested > 0 && t > ceil_t) t = ceil_t;
   if (t > n / min_per) t = n / min_per;  // don't spawn for tiny batches
   return static_cast<int>(t < 1 ? 1 : t);
 }
 
-// Run work(lo, hi) over [0, n) split across threads (serial when one
+// Run work(lo, hi, k) over [0, n) split across threads (serial when one
 // suffices) — the one owner of the fan-out/join used by every parallel
-// entry point.
+// entry point.  ``k`` is the chunk index (< the nt pick_threads chose),
+// so callers with per-chunk state never re-derive the split arithmetic.
 template <class F>
 void parallel_for(int64_t n, int64_t nthreads, int64_t min_per, F work) {
   int nt = pick_threads(nthreads, n, min_per);
   if (nt <= 1) {
-    work(static_cast<int64_t>(0), n);
+    work(static_cast<int64_t>(0), n, 0);
     return;
   }
   std::vector<std::thread> ts;
   int64_t per = (n + nt - 1) / nt;
-  for (int k = 0; k < nt; ++k) {
+  for (int64_t k = 0; k < nt; ++k) {
     int64_t lo = k * per, hi = lo + per > n ? n : lo + per;
     if (lo >= hi) break;
-    ts.emplace_back(work, lo, hi);
+    ts.emplace_back(work, lo, hi, k);
   }
   for (auto& th : ts) th.join();
 }
@@ -493,7 +497,7 @@ extern "C" {
 int64_t dat_blake2b_many(const uint8_t* buf, const int64_t* offs,
                          const int64_t* lens, int64_t n, uint8_t* out,
                          int64_t nthreads) {
-  parallel_for(n, nthreads, 64, [&](int64_t lo, int64_t hi) {
+  parallel_for(n, nthreads, 64, [&](int64_t lo, int64_t hi, int64_t) {
     for (int64_t r = lo; r < hi; ++r)
       b2b_hash256(buf + offs[r], lens[r], out + r * 32);
   });
@@ -517,7 +521,7 @@ int64_t dat_sketch(const uint8_t* buf, const int64_t* rec_offs,
   const uint32_t mask = (log2_slots >= 32)
                             ? 0xffffffffu
                             : ((1u << log2_slots) - 1u);
-  parallel_for(n, nthreads, 64, [&](int64_t lo, int64_t hi) {
+  parallel_for(n, nthreads, 64, [&](int64_t lo, int64_t hi, int64_t) {
     uint8_t kd[32];
     for (int64_t r = lo; r < hi; ++r) {
       b2b_hash256(buf + rec_offs[r], rec_lens[r], scratch + r * 32);
@@ -555,7 +559,7 @@ int64_t dat_decode_changes_mt(const uint8_t* buf, const int64_t* starts,
                               int64_t* val_len, int64_t* err_index,
                               int64_t nthreads) {
   std::atomic<int64_t> first(INT64_MAX);
-  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi) {
+  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi, int64_t) {
     int64_t bad = decode_changes_range(buf, starts, lens, lo, hi, change,
                                        from_v, to_v, key_off, key_len,
                                        sub_off, sub_len, val_off, val_len);
@@ -591,7 +595,7 @@ int64_t dat_encode_changes_mt(const uint8_t* src, int64_t n,
                               int64_t cap, int64_t nthreads) {
   int64_t* offs = new (std::nothrow) int64_t[static_cast<size_t>(n) + 1];
   if (offs == nullptr) return DAT_ERR_NOMEM;
-  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi) {
+  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi, int64_t) {
     for (int64_t r = lo; r < hi; ++r) {
       int64_t psize = change_payload_size(r, change, from_v, to_v, key_len,
                                           sub_len, val_len);
@@ -609,7 +613,7 @@ int64_t dat_encode_changes_mt(const uint8_t* src, int64_t n,
     delete[] offs;
     return DAT_ERR_CAPACITY;
   }
-  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi) {
+  parallel_for(n, nthreads, 4096, [&](int64_t lo, int64_t hi, int64_t) {
     for (int64_t r = lo; r < hi; ++r) {
       int64_t psize = change_payload_size(r, change, from_v, to_v, key_len,
                                           sub_len, val_len);
@@ -630,17 +634,20 @@ namespace {
 // so any range can be scanned independently by warming the state from
 // the 64 bytes before it — the same seeding trick the device tiling
 // uses, which is what makes the "rolling" scan embarrassingly parallel.
-// Emits into vec (window-thinned locally; window straddles across
-// range boundaries are resolved by the caller's merge).
-void gear_scan_range(const uint8_t* buf, int64_t lo, int64_t hi,
-                     const uint64_t* tab, uint32_t mask, int64_t thin_bits,
-                     std::vector<int64_t>* vec) {
+// Emits window-thinned positions into dst[0..cap) (straddles across
+// range boundaries are resolved by the caller's merge); returns the
+// count, or -1 the moment cap would overflow — fail-fast, bounded
+// memory, no throwing allocations (the file's nothrow convention).
+int64_t gear_scan_range(const uint8_t* buf, int64_t lo, int64_t hi,
+                        const uint64_t* tab, uint32_t mask,
+                        int64_t thin_bits, int64_t* dst, int64_t cap) {
   uint64_t h = 0;
   if (lo == 0) {
     for (int64_t k = 0; k < 64; ++k) h = (h << 1) + tab[0];  // zero seed
   } else {
     for (int64_t k = lo - 64; k < lo; ++k) h = (h << 1) + tab[buf[k]];
   }
+  int64_t m = 0;
   int64_t last_win = -1;
   for (int64_t j = lo; j < hi; ++j) {
     h = (h << 1) + tab[buf[j]];
@@ -650,9 +657,11 @@ void gear_scan_range(const uint8_t* buf, int64_t lo, int64_t hi,
         if (win == last_win) continue;
         last_win = win;
       }
-      vec->push_back(j);
+      if (m >= cap) return -1;
+      dst[m++] = j;
     }
   }
+  return m;
 }
 
 }  // namespace
@@ -673,6 +682,10 @@ extern "C" {
 int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
                             int64_t thin_bits, int64_t* out, int64_t cap,
                             int64_t nthreads) {
+  // (1u << 32) is undefined behavior; reject out-of-range parameters
+  // instead of silently computing with a garbage mask
+  if (avg_bits < 1 || avg_bits > 31 || thin_bits > 31 || cap < 0)
+    return DAT_ERR_BAD_RECORD;
   const uint32_t c1 = 0x9E3779B1u, c2 = 0x85EBCA77u;
   uint64_t tab[256];
   for (uint32_t b = 0; b < 256; ++b) {
@@ -684,37 +697,43 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
   int nt = pick_threads(nthreads, n, 1 << 22);  // >= 4 MiB per thread
   if (nt <= 1) {
     // serial fast path: write straight into out, fail fast on overflow
-    std::vector<int64_t> v;
-    v.reserve(static_cast<size_t>(cap < 4096 ? cap : 4096));
-    gear_scan_range(buf, 0, n, tab, mask, thin_bits, &v);
-    if (static_cast<int64_t>(v.size()) > cap) return DAT_ERR_CAPACITY;
-    std::copy(v.begin(), v.end(), out);
-    return static_cast<int64_t>(v.size());
+    int64_t m = gear_scan_range(buf, 0, n, tab, mask, thin_bits, out, cap);
+    return m < 0 ? DAT_ERR_CAPACITY : m;
   }
-  // parallel_for owns the fan-out; ranges recover their slot from lo
-  // (same per arithmetic parallel_for uses for the same nt)
-  std::vector<std::vector<int64_t>> found(static_cast<size_t>(nt));
-  int64_t per = (n + nt - 1) / nt;
-  parallel_for(n, nt, 1 << 22, [&](int64_t lo, int64_t hi) {
-    gear_scan_range(buf, lo, hi, tab, mask, thin_bits, &found[lo / per]);
+  // parallel: each chunk writes a bounded slab slice (cap entries per
+  // chunk — any one chunk exceeding the caller's whole budget is
+  // already an overflow); counts come back per chunk, the thinned
+  // merge resolves window straddles at the seams so the output equals
+  // the serial scan's exactly
+  int64_t* slab = new (std::nothrow) int64_t[static_cast<size_t>(nt) * cap];
+  if (slab == nullptr && nt * cap > 0) return DAT_ERR_NOMEM;
+  std::vector<int64_t> counts(static_cast<size_t>(nt), 0);
+  parallel_for(n, nt, 1 << 22, [&](int64_t lo, int64_t hi, int64_t k) {
+    counts[k] = gear_scan_range(buf, lo, hi, tab, mask, thin_bits,
+                                slab + k * cap, cap);
   });
-  // merge, resolving window straddles at range seams: thinning keeps
-  // the FIRST candidate per aligned window, so a later range's
-  // candidate in a window already owned by the previous range is
-  // dropped (identical to the serial scan's output)
   int64_t m = 0;
   int64_t last_win = -1;
-  for (auto& v : found) {
-    for (int64_t j : v) {
+  for (int k = 0; k < nt; ++k) {
+    if (counts[k] < 0) {
+      delete[] slab;
+      return DAT_ERR_CAPACITY;
+    }
+    for (int64_t i = 0; i < counts[k]; ++i) {
+      int64_t j = slab[k * cap + i];
       if (thin_bits >= 0) {
         int64_t win = j >> thin_bits;
         if (win == last_win) continue;
         last_win = win;
       }
-      if (m >= cap) return DAT_ERR_CAPACITY;
+      if (m >= cap) {
+        delete[] slab;
+        return DAT_ERR_CAPACITY;
+      }
       out[m++] = j;
     }
   }
+  delete[] slab;
   return m;
 }
 

@@ -196,6 +196,10 @@ def sketch(buf: np.ndarray, rec_offs, rec_lens, key_offs, key_lens,
 def gear_candidates(buf: np.ndarray, avg_bits: int, thin_bits: int = -1):
     """Host gear CDC candidate scan (seeded-stream definition); sorted
     absolute positions as int64, or None when unavailable."""
+    if not 1 <= avg_bits <= 31:
+        raise ValueError("avg_bits must be in [1, 31]")
+    if thin_bits > 31:
+        raise ValueError("thin_bits must be < 32")
     lib = get_lib()
     if lib is None:
         return None
