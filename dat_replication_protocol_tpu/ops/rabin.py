@@ -347,6 +347,22 @@ def _popcount32(x):
     return (x * U32(0x01010101)) >> U32(24)
 
 
+def _clamp_thin_bits(thin_bits: int | None, stride: int) -> int | None:
+    """One owner of the thinning-policy clamps: the host scan and the
+    device tiles must produce IDENTICAL candidate sets, so both routes
+    apply exactly these rules.  None = no thinning.
+
+    * windows below 32 bytes can't cover a packed word: no thinning;
+    * the window must divide the tile (stride's largest power-of-two
+      divisor) and fit the u16 in-window offset range (<= 16).
+    """
+    if thin_bits is None or thin_bits < 5:
+        return None
+    tz = (stride & -stride).bit_length() - 1
+    thin_bits = min(thin_bits, tz, 16)
+    return thin_bits if thin_bits >= 5 else None
+
+
 def candidates_begin(words, nbytes: int, avg_bits: int = 13,
                      tile_bytes: int = 1 << 17,
                      prefix: np.ndarray | None = None,
@@ -400,16 +416,7 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
     if pad > 0:
         words = jnp.concatenate([words, jnp.zeros((pad,), U32)])
 
-    if thin_bits is not None:
-        if thin_bits < 5:  # window must cover at least one packed word
-            thin_bits = None
-        else:
-            # window must divide the tile: clamp to stride's largest
-            # power-of-two divisor (and the u16 in-window offset range)
-            tz = (stride & -stride).bit_length() - 1
-            thin_bits = min(thin_bits, tz, 16)
-            if thin_bits < 5:
-                thin_bits = None
+    thin_bits = _clamp_thin_bits(thin_bits, stride)
 
     use_pallas = jax.default_backend() == "tpu"
     # expected candidates ~= nbytes / 2**avg_bits (sparse).  4x margin,
@@ -599,16 +606,13 @@ def chunk_stream(
     if prefer_host("DAT_DEVICE_CDC"):
         from ..runtime import native
 
-        # mirror the device path's thinning clamps (candidates_begin):
-        # <5 -> no thinning, cap at 16 AND at tile_bytes' largest
-        # power-of-two divisor — so host and device paths produce
-        # identical candidate sets and therefore identical cuts for any
-        # tile_bytes
-        tz = (tile_bytes & -tile_bytes).bit_length() - 1
-        host_thin_bits = min(thin_bits, tz, 16) if thin_bits >= 5 else -1
-        if host_thin_bits < 5:
-            host_thin_bits = -1
-        cands = native.gear_candidates(buf, avg_bits, host_thin_bits)
+        # the SAME thinning clamps as the device tiles (one owner:
+        # _clamp_thin_bits) so host and device produce identical
+        # candidate sets and therefore identical cuts for any tile_bytes
+        clamped = _clamp_thin_bits(thin_bits, tile_bytes)
+        cands = native.gear_candidates(
+            buf, avg_bits, -1 if clamped is None else clamped
+        )
         if cands is not None:
             return _greedy_select(cands, length, min_size, max_size)
 
