@@ -5,6 +5,9 @@
 # the CDC ceiling diagnosis, then a profiler trace.
 cd "$(dirname "$0")"
 set -x
+# 0) insurance first: a minimal quick TPU capture (~3 min) so even a
+#    window that dies mid-sweep leaves a backend=tpu artifact
+BENCH_CONFIGS=3 BENCH_DEADLINE=400 timeout 420 python bench.py --quick 2>&1 | tail -3
 # 1) hash kernel variant sweep: msg_loads x block_items x vmem_state,
 #    interleaved twice to denoise the shared chip
 timeout 900 python - <<'PY' 2>&1 | grep -v WARNING
