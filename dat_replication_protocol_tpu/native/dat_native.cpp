@@ -490,6 +490,191 @@ void parallel_for(int64_t n, int64_t nthreads, int64_t min_per, F work) {
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// 4-way multi-buffer BLAKE2b (AVX2): four independent streams interleaved
+// in ymm 64-bit lanes — the host-engine analogue of the device kernel's
+// SoA batching.  Hashing one stream is inherently serial; hashing a BATCH
+// is lane-parallel, so the 12 rounds run once per 4 blocks.  Ragged
+// lengths are handled by lane refill: when a lane's stream finishes, its
+// digest is extracted and the lane reloads the next job (per-lane t
+// counters and final-block masks are just vectors).  Guarded by a
+// runtime cpuid check; the scalar loop remains the portable path.
+// ---------------------------------------------------------------------------
+
+#if (defined(__x86_64__) || defined(_M_X64)) && \
+    (defined(__GNUC__) || defined(__clang__))
+#include <immintrin.h>
+
+namespace {
+
+// per-lane stream state for the 4-way engine
+struct B2bLane {
+  const uint8_t* data = nullptr;
+  int64_t len = 0;
+  int64_t off = 0;  // bytes consumed so far (multiple of 128)
+  uint8_t* out = nullptr;
+  bool active = false;
+};
+
+__attribute__((target("avx2"))) inline __m256i ror64x4(__m256i x, int r) {
+  if (r == 32) return _mm256_shuffle_epi32(x, _MM_SHUFFLE(2, 3, 0, 1));
+  if (r == 24) {
+    const __m256i m = _mm256_setr_epi8(
+        3, 4, 5, 6, 7, 0, 1, 2, 11, 12, 13, 14, 15, 8, 9, 10,
+        3, 4, 5, 6, 7, 0, 1, 2, 11, 12, 13, 14, 15, 8, 9, 10);
+    return _mm256_shuffle_epi8(x, m);
+  }
+  if (r == 16) {
+    const __m256i m = _mm256_setr_epi8(
+        2, 3, 4, 5, 6, 7, 0, 1, 10, 11, 12, 13, 14, 15, 8, 9,
+        2, 3, 4, 5, 6, 7, 0, 1, 10, 11, 12, 13, 14, 15, 8, 9);
+    return _mm256_shuffle_epi8(x, m);
+  }
+  // r == 63: rotl1
+  return _mm256_or_si256(_mm256_srli_epi64(x, 63), _mm256_add_epi64(x, x));
+}
+
+// one compression over 4 interleaved states; m[16] message vectors,
+// t = per-lane byte counters, fmask = per-lane all-ones where final
+__attribute__((target("avx2")))
+void b2b_compress4(__m256i h[8], const __m256i m[16], __m256i t,
+                   __m256i fmask) {
+  __m256i v[16];
+  for (int i = 0; i < 8; ++i) v[i] = h[i];
+  for (int i = 0; i < 8; ++i) v[8 + i] = _mm256_set1_epi64x(
+      static_cast<long long>(B2B_IV[i]));
+  v[12] = _mm256_xor_si256(v[12], t);
+  v[14] = _mm256_xor_si256(v[14], fmask);
+#define DAT_G4(a, b, c, d, x, y)                        \
+  v[a] = _mm256_add_epi64(_mm256_add_epi64(v[a], v[b]), (x)); \
+  v[d] = ror64x4(_mm256_xor_si256(v[d], v[a]), 32);     \
+  v[c] = _mm256_add_epi64(v[c], v[d]);                  \
+  v[b] = ror64x4(_mm256_xor_si256(v[b], v[c]), 24);     \
+  v[a] = _mm256_add_epi64(_mm256_add_epi64(v[a], v[b]), (y)); \
+  v[d] = ror64x4(_mm256_xor_si256(v[d], v[a]), 16);     \
+  v[c] = _mm256_add_epi64(v[c], v[d]);                  \
+  v[b] = ror64x4(_mm256_xor_si256(v[b], v[c]), 63);
+  for (int r = 0; r < 12; ++r) {
+    const uint8_t* s = B2B_SIGMA[r];
+    DAT_G4(0, 4, 8, 12, m[s[0]], m[s[1]])
+    DAT_G4(1, 5, 9, 13, m[s[2]], m[s[3]])
+    DAT_G4(2, 6, 10, 14, m[s[4]], m[s[5]])
+    DAT_G4(3, 7, 11, 15, m[s[6]], m[s[7]])
+    DAT_G4(0, 5, 10, 15, m[s[8]], m[s[9]])
+    DAT_G4(1, 6, 11, 12, m[s[10]], m[s[11]])
+    DAT_G4(2, 7, 8, 13, m[s[12]], m[s[13]])
+    DAT_G4(3, 4, 9, 14, m[s[14]], m[s[15]])
+  }
+#undef DAT_G4
+  for (int i = 0; i < 8; ++i)
+    h[i] = _mm256_xor_si256(h[i], _mm256_xor_si256(v[i], v[8 + i]));
+}
+
+// Hash extents buf[offs[i] .. offs[i]+lens[i]) for i in [0, njobs),
+// digests to outbase + i*32, 4 lanes at a time with lane refill.
+__attribute__((target("avx2")))
+void b2b_many_avx2(const uint8_t* buf, const int64_t* offs,
+                   const int64_t* lens, int64_t njobs, uint8_t* outbase) {
+  if (njobs <= 0) return;
+  B2bLane lanes[4];
+  __m256i h[8];
+  alignas(32) uint64_t hbuf[8][4] = {};  // zeroed: idle lanes load defined
+  alignas(32) uint8_t pad[4][128];       // bytes even before first reset
+  int64_t next = 0;
+  const uint64_t param = 0x01010000ULL ^ 32ULL;
+
+  auto reset_lane = [&](int L) -> bool {
+    if (next >= njobs) {
+      lanes[L].active = false;
+      return false;
+    }
+    lanes[L] = {buf + offs[next], lens[next], 0, outbase + next * 32, true};
+    ++next;
+    for (int w = 0; w < 8; ++w)
+      hbuf[w][L] = B2B_IV[w] ^ (w == 0 ? param : 0ULL);
+    return true;
+  };
+
+  for (int L = 0; L < 4; ++L) reset_lane(L);
+  for (int w = 0; w < 8; ++w)
+    h[w] = _mm256_load_si256(reinterpret_cast<const __m256i*>(hbuf[w]));
+
+  while (lanes[0].active || lanes[1].active || lanes[2].active ||
+         lanes[3].active) {
+    // stage one block per lane; inactive lanes chew a zero block
+    const uint8_t* blk[4];
+    alignas(32) uint64_t tv[4];
+    alignas(32) uint64_t fv[4];
+    bool finishing[4];
+    for (int L = 0; L < 4; ++L) {
+      B2bLane& ln = lanes[L];
+      if (!ln.active) {
+        std::memset(pad[L], 0, 128);
+        blk[L] = pad[L];
+        tv[L] = 0;
+        fv[L] = 0;  // never final: state is discarded at refill anyway
+        finishing[L] = false;
+        continue;
+      }
+      int64_t rem = ln.len - ln.off;
+      if (rem > 128) {
+        blk[L] = ln.data + ln.off;
+        ln.off += 128;
+        tv[L] = static_cast<uint64_t>(ln.off);
+        fv[L] = 0;
+        finishing[L] = false;
+      } else {  // final block (rem in [0, 128]; 0 only for empty input)
+        std::memset(pad[L], 0, 128);
+        if (rem > 0) std::memcpy(pad[L], ln.data + ln.off, rem);
+        blk[L] = pad[L];
+        tv[L] = static_cast<uint64_t>(ln.len);
+        fv[L] = ~0ULL;
+        finishing[L] = true;
+      }
+    }
+    __m256i m[16];
+    for (int w = 0; w < 16; ++w)
+      m[w] = _mm256_set_epi64x(
+          static_cast<long long>(load64(blk[3] + 8 * w)),
+          static_cast<long long>(load64(blk[2] + 8 * w)),
+          static_cast<long long>(load64(blk[1] + 8 * w)),
+          static_cast<long long>(load64(blk[0] + 8 * w)));
+    b2b_compress4(
+        h, m,
+        _mm256_load_si256(reinterpret_cast<const __m256i*>(tv)),
+        _mm256_load_si256(reinterpret_cast<const __m256i*>(fv)));
+    if (finishing[0] || finishing[1] || finishing[2] || finishing[3]) {
+      // spill the state ONCE, then extract+reset every finishing lane
+      // in the spilled rows (a per-lane re-spill would clobber an
+      // earlier lane's freshly reset IVs), then reload
+      for (int w = 0; w < 8; ++w)
+        _mm256_store_si256(reinterpret_cast<__m256i*>(hbuf[w]), h[w]);
+      for (int L = 0; L < 4; ++L) {
+        if (!finishing[L]) continue;
+        for (int w = 0; w < 4; ++w)
+          std::memcpy(lanes[L].out + 8 * w, &hbuf[w][L], 8);
+        reset_lane(L);
+      }
+      for (int w = 0; w < 8; ++w)
+        h[w] = _mm256_load_si256(reinterpret_cast<const __m256i*>(hbuf[w]));
+    }
+  }
+}
+
+inline bool have_avx2() {
+  static const bool ok = __builtin_cpu_supports("avx2");
+  return ok;
+}
+
+}  // namespace
+#else
+namespace {
+inline bool have_avx2() { return false; }
+inline void b2b_many_avx2(const uint8_t*, const int64_t*, const int64_t*,
+                          int64_t, uint8_t*) {}
+}  // namespace
+#endif
+
 extern "C" {
 
 // Digest n extents of buf: out[r*32..] = BLAKE2b-256(buf[offs[r] ..
@@ -498,6 +683,10 @@ int64_t dat_blake2b_many(const uint8_t* buf, const int64_t* offs,
                          const int64_t* lens, int64_t n, uint8_t* out,
                          int64_t nthreads) {
   parallel_for(n, nthreads, 64, [&](int64_t lo, int64_t hi, int64_t) {
+    if (have_avx2()) {
+      b2b_many_avx2(buf, offs + lo, lens + lo, hi - lo, out + lo * 32);
+      return;
+    }
     for (int64_t r = lo; r < hi; ++r)
       b2b_hash256(buf + offs[r], lens[r], out + r * 32);
   });
@@ -522,6 +711,24 @@ int64_t dat_sketch(const uint8_t* buf, const int64_t* rec_offs,
                             ? 0xffffffffu
                             : ((1u << log2_slots) - 1u);
   parallel_for(n, nthreads, 64, [&](int64_t lo, int64_t hi, int64_t) {
+    int64_t cnt = hi - lo;
+    if (have_avx2()) {
+      // 4-way engine over records (straight into scratch) and keys
+      // (into a range-local buffer the slot extraction reads)
+      uint8_t* kds = new (std::nothrow) uint8_t[static_cast<size_t>(cnt) * 32];
+      if (kds != nullptr) {
+        b2b_many_avx2(buf, rec_offs + lo, rec_lens + lo, cnt,
+                      scratch + lo * 32);
+        b2b_many_avx2(buf, key_offs + lo, key_lens + lo, cnt, kds);
+        for (int64_t r = lo; r < hi; ++r) {
+          uint32_t s;
+          std::memcpy(&s, kds + (r - lo) * 32, 4);
+          slots[r] = s & mask;
+        }
+        delete[] kds;
+        return;
+      }  // allocation failed: scalar path below still succeeds
+    }
     uint8_t kd[32];
     for (int64_t r = lo; r < hi; ++r) {
       b2b_hash256(buf + rec_offs[r], rec_lens[r], scratch + r * 32);
