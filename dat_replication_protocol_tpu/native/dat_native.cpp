@@ -845,15 +845,23 @@ namespace {
 // range boundaries are resolved by the caller's merge); returns the
 // count, or -1 the moment cap would overflow — fail-fast, bounded
 // memory, no throwing allocations (the file's nothrow convention).
-int64_t gear_scan_range(const uint8_t* buf, int64_t lo, int64_t hi,
-                        const uint64_t* tab, uint32_t mask,
-                        int64_t thin_bits, int64_t* dst, int64_t cap) {
+// Gear state at position lo: warmed from the preceding WINDOW bytes
+// (the zero seed at the stream head) — one owner for every scan path.
+inline uint64_t gear_seed(const uint8_t* buf, int64_t lo,
+                          const uint64_t* tab) {
   uint64_t h = 0;
   if (lo == 0) {
-    for (int64_t k = 0; k < 64; ++k) h = (h << 1) + tab[0];  // zero seed
+    for (int64_t k = 0; k < 64; ++k) h = (h << 1) + tab[0];
   } else {
     for (int64_t k = lo - 64; k < lo; ++k) h = (h << 1) + tab[buf[k]];
   }
+  return h;
+}
+
+int64_t gear_scan_range(const uint8_t* buf, int64_t lo, int64_t hi,
+                        const uint64_t* tab, uint32_t mask,
+                        int64_t thin_bits, int64_t* dst, int64_t cap) {
+  uint64_t h = gear_seed(buf, lo, tab);
   int64_t m = 0;
   int64_t last_win = -1;
   for (int64_t j = lo; j < hi; ++j) {
@@ -869,6 +877,64 @@ int64_t gear_scan_range(const uint8_t* buf, int64_t lo, int64_t hi,
     }
   }
   return m;
+}
+
+// Four independent sub-range chains interleaved in one loop: a single
+// gear chain is latency-bound on h -> h (the byte/table loads are off
+// the critical path), so interleaving converts the scan to
+// throughput-bound — the scalar-ILP analogue of the Pallas kernel's
+// ilp chunks.  Each chain seeds from its preceding WINDOW bytes and
+// emits (window-thinned) into its own dst slab; the caller's merge
+// resolves straddles at every seam.  cnts[c] = -1 flags slab overflow.
+void gear_scan_range4(const uint8_t* buf, const int64_t* qlo,
+                      const int64_t* qhi, const uint64_t* tab, uint32_t mask,
+                      int64_t thin_bits, int64_t* dst, int64_t cap,
+                      int64_t* cnts) {
+  uint64_t h[4];
+  int64_t j[4], lw[4], m[4];
+  for (int c = 0; c < 4; ++c) {
+    h[c] = gear_seed(buf, qlo[c], tab);
+    j[c] = qlo[c];
+    lw[c] = -1;
+    m[c] = 0;
+  }
+  auto emit = [&](int c, int64_t pos) {
+    if (m[c] < 0) return;  // STICKY overflow poison: a non-sticky check
+    // would pass -1 < cap, write dst[c*cap - 1] (heap underflow /
+    // cross-chain corruption) and silently reset the count
+    if (thin_bits >= 0) {
+      int64_t win = pos >> thin_bits;
+      if (win == lw[c]) return;
+      lw[c] = win;
+    }
+    if (m[c] >= cap) {
+      m[c] = -1;
+      return;
+    }
+    dst[c * cap + m[c]] = pos;
+    ++m[c];
+  };
+  int64_t steps = qhi[0] - qlo[0];
+  for (int c = 1; c < 4; ++c)
+    if (qhi[c] - qlo[c] < steps) steps = qhi[c] - qlo[c];
+  for (int64_t st = 0; st < steps; ++st) {
+    // four independent chains per iteration: the compiler schedules the
+    // loads of chain c+1 under the shift+add of chain c
+    for (int c = 0; c < 4; ++c) {
+      uint64_t hh = (h[c] << 1) + tab[buf[j[c]]];
+      h[c] = hh;
+      if (((static_cast<uint32_t>(hh >> 32)) & mask) == 0) emit(c, j[c]);
+      ++j[c];
+    }
+  }
+  for (int c = 0; c < 4; ++c) {  // ragged tails finish serially
+    uint64_t hh = h[c];
+    for (int64_t p = j[c]; p < qhi[c]; ++p) {
+      hh = (hh << 1) + tab[buf[p]];
+      if (((static_cast<uint32_t>(hh >> 32)) & mask) == 0) emit(c, p);
+    }
+    cnts[c] = m[c];  // -1 (sticky poison) or the chain's count
+  }
 }
 
 }  // namespace
@@ -902,32 +968,38 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
   }
   const uint32_t mask = (1u << avg_bits) - 1u;
   int nt = pick_threads(nthreads, n, 1 << 22);  // >= 4 MiB per thread
-  if (nt <= 1) {
-    // serial fast path: write straight into out, fail fast on overflow
+  if (n < (1 << 16)) {
+    // tiny input: one plain chain, write straight into out, fail fast
     int64_t m = gear_scan_range(buf, 0, n, tab, mask, thin_bits, out, cap);
     return m < 0 ? DAT_ERR_CAPACITY : m;
   }
-  // parallel: each chunk writes a bounded slab slice (cap entries per
-  // chunk — any one chunk exceeding the caller's whole budget is
-  // already an overflow); counts come back per chunk, the thinned
-  // merge resolves window straddles at the seams so the output equals
-  // the serial scan's exactly
-  int64_t* slab = new (std::nothrow) int64_t[static_cast<size_t>(nt) * cap];
-  if (slab == nullptr && nt * cap > 0) return DAT_ERR_NOMEM;
-  std::vector<int64_t> counts(static_cast<size_t>(nt), 0);
+  // every thread chunk runs FOUR interleaved sub-range chains
+  // (gear_scan_range4); each of the nt*4 quarters writes a bounded slab
+  // slice and the thinned merge resolves window straddles at every
+  // seam, so the output equals the single-chain scan's exactly
+  int64_t nq = static_cast<int64_t>(nt) * 4;
+  int64_t* slab = new (std::nothrow) int64_t[static_cast<size_t>(nq) * cap];
+  if (slab == nullptr && nq * cap > 0) return DAT_ERR_NOMEM;
+  std::vector<int64_t> counts(static_cast<size_t>(nq), 0);
   parallel_for(n, nt, 1 << 22, [&](int64_t lo, int64_t hi, int64_t k) {
-    counts[k] = gear_scan_range(buf, lo, hi, tab, mask, thin_bits,
-                                slab + k * cap, cap);
+    int64_t qlo[4], qhi[4];
+    int64_t qlen = (hi - lo) / 4;
+    for (int c = 0; c < 4; ++c) {
+      qlo[c] = lo + c * qlen;
+      qhi[c] = c == 3 ? hi : qlo[c] + qlen;
+    }
+    gear_scan_range4(buf, qlo, qhi, tab, mask, thin_bits,
+                     slab + k * 4 * cap, cap, counts.data() + k * 4);
   });
   int64_t m = 0;
   int64_t last_win = -1;
-  for (int k = 0; k < nt; ++k) {
-    if (counts[k] < 0) {
+  for (int64_t q = 0; q < nq; ++q) {
+    if (counts[q] < 0) {
       delete[] slab;
       return DAT_ERR_CAPACITY;
     }
-    for (int64_t i = 0; i < counts[k]; ++i) {
-      int64_t j = slab[k * cap + i];
+    for (int64_t i = 0; i < counts[q]; ++i) {
+      int64_t j = slab[q * cap + i];
       if (thin_bits >= 0) {
         int64_t win = j >> thin_bits;
         if (win == last_win) continue;
