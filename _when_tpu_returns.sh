@@ -96,6 +96,30 @@ for rnd in range(2):
     run(f"r{rnd} ilp4", ilp=4)
     run(f"r{rnd} ilp16 bt16384", ilp=16, block_tiles=16384)
     run(f"r{rnd} bt4096 ilp4", ilp=4, block_tiles=4096)
+
+# e2e route comparison: bitmask+window-reduce (new default) vs the
+# first-hit kernel (old fast path) through the real candidates_begin ->
+# greedy pipeline on a 1 GiB device-resident slab
+import os
+from dat_replication_protocol_tpu.ops import rabin
+slab_b = 1 << 30
+words_s = jax.random.bits(jax.random.PRNGKey(5), (slab_b // 4,),
+                          dtype=jnp.uint32)
+jax.block_until_ready(words_s)
+for env in ("0", "1"):
+    os.environ["DAT_CDC_FIRST_KERNEL"] = env
+    def e2e():
+        c = rabin.candidates_begin(words_s, slab_b, 13, thin_bits=11)
+        return rabin._greedy_select(c(), slab_b, 1 << 11, 1 << 15)
+    e2e()
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); e2e()
+        dts.append(time.perf_counter() - t0)
+    g = slab_b / statistics.median(dts) / (1 << 30)
+    print(f"cdc e2e first_kernel={env}: {g:.2f} GiB/s (median of 3)",
+          flush=True)
+os.environ.pop("DAT_CDC_FIRST_KERNEL", None)
 PY
 # 4) profiler trace of the device configs (quick shapes; diagnostic)
 BENCH_CONFIGS=3,4,5 timeout 900 python bench.py --quick --trace=/tmp/dat_trace 2>&1 | tail -3

@@ -968,8 +968,12 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
   }
   const uint32_t mask = (1u << avg_bits) - 1u;
   int nt = pick_threads(nthreads, n, 1 << 22);  // >= 4 MiB per thread
-  if (n < (1 << 16)) {
-    // tiny input: one plain chain, write straight into out, fail fast
+  if (n < (1 << 16) || nthreads == 1) {
+    // one plain chain, straight into out, fail fast — for tiny inputs,
+    // and as the independently-implemented reference when a caller
+    // EXPLICITLY requests one thread (the serial-vs-parallel tests
+    // depend on this route not sharing the quartering/merge machinery;
+    // auto on a 1-core host still gets the 4-chain ILP path below)
     int64_t m = gear_scan_range(buf, 0, n, tab, mask, thin_bits, out, cap);
     return m < 0 ? DAT_ERR_CAPACITY : m;
   }
@@ -978,8 +982,13 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
   // slice and the thinned merge resolves window straddles at every
   // seam, so the output equals the single-chain scan's exactly
   int64_t nq = static_cast<int64_t>(nt) * 4;
-  int64_t* slab = new (std::nothrow) int64_t[static_cast<size_t>(nq) * cap];
-  if (slab == nullptr && nq * cap > 0) return DAT_ERR_NOMEM;
+  // quarters share their chunk's cap budget (a lone chain legitimately
+  // holding more than cap/4 trips ERR_CAPACITY and the caller's
+  // geometric retry resolves it) — per-quarter FULL budgets would 4x
+  // the transient slab for no correctness gain
+  int64_t qcap = cap / 4 + 1;
+  int64_t* slab = new (std::nothrow) int64_t[static_cast<size_t>(nq) * qcap];
+  if (slab == nullptr && nq * qcap > 0) return DAT_ERR_NOMEM;
   std::vector<int64_t> counts(static_cast<size_t>(nq), 0);
   parallel_for(n, nt, 1 << 22, [&](int64_t lo, int64_t hi, int64_t k) {
     int64_t qlo[4], qhi[4];
@@ -989,7 +998,7 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
       qhi[c] = c == 3 ? hi : qlo[c] + qlen;
     }
     gear_scan_range4(buf, qlo, qhi, tab, mask, thin_bits,
-                     slab + k * 4 * cap, cap, counts.data() + k * 4);
+                     slab + k * 4 * qcap, qcap, counts.data() + k * 4);
   });
   int64_t m = 0;
   int64_t last_win = -1;
@@ -999,7 +1008,7 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
       return DAT_ERR_CAPACITY;
     }
     for (int64_t i = 0; i < counts[q]; ++i) {
-      int64_t j = slab[q * cap + i];
+      int64_t j = slab[q * qcap + i];
       if (thin_bits >= 0) {
         int64_t win = j >> thin_bits;
         if (win == last_win) continue;

@@ -182,6 +182,19 @@ def gear_first_tiled(words, avg_bits: int = 13):
 # ---------------------------------------------------------------------------
 
 
+def _first_bit_per_window(wins):
+    """First set-bit offset per window row of packed uint32 words, or
+    ``1 << 30`` for empty windows — the ONE owner of the windowed
+    first-candidate reduction (the thinning fast path and the exact
+    extractor's small-window mode both ride it)."""
+    wnz = wins != U32(0)
+    first_w = jnp.argmax(wnz, axis=1).astype(jnp.int32)
+    wval = jnp.take_along_axis(wins, first_w[:, None], axis=1)[:, 0]
+    lsb = wval & (U32(0) - wval)
+    bitpos = _popcount32(lsb - U32(1)).astype(jnp.int32)
+    return jnp.where(jnp.any(wnz, axis=1), first_w * PACK + bitpos, 1 << 30)
+
+
 def _build_rows(words_padded, pre_row, T: int, stride: int):
     """[context GROUP | payload] rows, (T, _PREFIX_WORDS + stride/4).
 
@@ -206,11 +219,11 @@ def _build_rows(words_padded, pre_row, T: int, stride: int):
 @functools.partial(
     jax.jit,
     static_argnames=("T", "stride", "avg_bits", "cap2", "use_pallas",
-                     "thin_bits"),
+                     "thin_bits", "first_kernel"),
 )
 def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
                        avg_bits: int, cap2: int, use_pallas: bool,
-                       thin_bits: int = 11):
+                       thin_bits: int = 11, first_kernel: bool = False):
     """Thinned candidate extraction: occupancy bitmap + in-window offsets.
 
     **Candidate thinning**: at most the *first* candidate in each aligned
@@ -220,9 +233,20 @@ def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
     cut to an equivalent in-window neighbor.  Deterministic for a given
     stream; documented policy, not an approximation knob.
 
-    The kernel is the first-hit-per-GROUP variant (1/8 the output volume
-    of the bitmask kernel); window reduction is a min over groups.  The
-    host result rides in two dense-free pieces —
+    Two equivalent kernel routes (``first_kernel``):
+
+    * ``False`` (default) — the BITMASK kernel + a vectorized
+      first-set-bit reduction per window.  The first-hit kernel's
+      per-byte ``where`` chain lengthens the gear loop's serial
+      dependency (the scan's actual binder), while the bitmask kernel's
+      ``or``-accumulate does not — the reduction over packed words is
+      ~1 op per 32 bytes, off the critical path.  8x the kernel OUTPUT
+      volume, but that output never leaves the device.
+    * ``True`` — the first-hit-per-GROUP kernel + a min over groups
+      (1/8 the kernel output volume; kept for measurement comparison —
+      DAT_CDC_FIRST_KERNEL=1).
+
+    The host result rides in two dense-free pieces —
 
     * ``occ``: (ceil(nwin/32),) uint32 — bit w set iff window w holds a
       candidate (fixed 1 bit per window: 64 KiB/GiB at 2 KiB windows);
@@ -234,20 +258,31 @@ def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
     count (and the cap2-overflow check) from popcounting ``occ``.
     """
     rows = _build_rows(words_padded, pre_row, T, stride)
-    if use_pallas:
-        from .rabin_pallas import gear_first_pallas
+    if first_kernel:
+        if use_pallas:
+            from .rabin_pallas import gear_first_pallas
 
-        firsts = gear_first_pallas(rows, avg_bits)
+            firsts = gear_first_pallas(rows, avg_bits)
+        else:
+            firsts = gear_first_tiled(rows, avg_bits)
+        vg = firsts[:, 1:]  # drop warm-up group 0; (T, stride/GROUP)
+        flatg = vg.reshape(-1).astype(jnp.int32)
+        gpw = (1 << thin_bits) // GROUP  # groups per window
+        wins = flatg.reshape(-1, gpw)
+        gidx = jnp.arange(gpw, dtype=jnp.int32) * GROUP
+        hitpos = jnp.where(wins < NO_HIT, wins + gidx[None, :], 1 << 30)
+        first = jnp.min(hitpos, axis=1)  # in-window first-candidate offset
     else:
-        firsts = gear_first_tiled(rows, avg_bits)
-    vg = firsts[:, 1:]  # drop warm-up group 0; (T, stride/GROUP)
-    flatg = vg.reshape(-1).astype(jnp.int32)
-    gpw = (1 << thin_bits) // GROUP  # groups per window
-    wins = flatg.reshape(-1, gpw)
-    nwin = wins.shape[0]
-    gidx = jnp.arange(gpw, dtype=jnp.int32) * GROUP
-    hitpos = jnp.where(wins < NO_HIT, wins + gidx[None, :], 1 << 30)
-    first = jnp.min(hitpos, axis=1)  # in-window offset of first candidate
+        if use_pallas:
+            from .rabin_pallas import gear_candidates_pallas
+
+            bits = gear_candidates_pallas(rows, avg_bits)
+        else:
+            bits = gear_candidates_tiled(rows, avg_bits)
+        vw = bits[:, _PREFIX // PACK : _PREFIX // PACK + stride // PACK]
+        wpw = (1 << thin_bits) // PACK  # packed words per window
+        first = _first_bit_per_window(vw.reshape(-1, wpw))
+    nwin = first.shape[0]
     has = first < (1 << 30)
     hasp = has
     if nwin % 32:
@@ -306,16 +341,11 @@ def _extract_candidates(words_padded, pre_row, T: int, stride: int,
 
     if thin_bits is not None:
         W = 1 << thin_bits  # window bytes; PACK-aligned power of two
-        wpw = W // PACK  # packed words per window
-        wins = flat.reshape(-1, wpw)  # (nwin, wpw)
-        wnz = wins != U32(0)
-        has = jnp.any(wnz, axis=1)
-        first_w = jnp.argmax(wnz, axis=1).astype(jnp.int32)
-        wval = jnp.take_along_axis(wins, first_w[:, None], axis=1)[:, 0]
-        lsb = wval & (U32(0) - wval)
-        bitpos = _popcount32(lsb - U32(1)).astype(jnp.int32)
+        wins = flat.reshape(-1, W // PACK)  # (nwin, wpw)
+        inwin = _first_bit_per_window(wins)
+        has = inwin < (1 << 30)
         nwin = wins.shape[0]
-        pos = jnp.arange(nwin, dtype=jnp.int32) * W + first_w * PACK + bitpos
+        pos = jnp.arange(nwin, dtype=jnp.int32) * W + inwin
         ncand = jnp.sum(has.astype(jnp.int32))
         (widx,) = jnp.nonzero(has, size=cap2, fill_value=0)
         return pos[widx], ncand, ncand
@@ -426,10 +456,16 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
         cap0 = min(cap0, (T * stride) >> thin_bits)
 
     if thin_bits is not None and thin_bits >= 8:
-        # fast path: first-hit kernel + occupancy/offsets transfer
+        # fast path: windowed first-candidate extraction + occ/offsets
+        # transfer (kernel route per _extract_first_occ; the env knob is
+        # for on-device measurement comparison)
+        import os
+
+        fk = os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
         with span("cdc.dispatch"):
             first = _extract_first_occ(
-                words, pre, T, stride, avg_bits, cap0, use_pallas, thin_bits
+                words, pre, T, stride, avg_bits, cap0, use_pallas,
+                thin_bits, first_kernel=fk,
             )
 
         def collect() -> np.ndarray:
@@ -445,7 +481,7 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
                     cap *= 4
                     _, offs = _extract_first_occ(
                         words, pre, T, stride, avg_bits, cap, use_pallas,
-                        thin_bits,
+                        thin_bits, first_kernel=fk,
                     )
                 out = (winidx << thin_bits) + np.asarray(
                     offs[: len(winidx)], dtype=np.int64

@@ -236,3 +236,20 @@ def test_parallel_gear_scan_matches_serial(monkeypatch):
         monkeypatch.setenv("DAT_NTHREADS", "4")
         par = native.gear_candidates(data, 12, thin)
         assert np.array_equal(serial, par), f"thin_bits={thin}"
+
+
+def test_first_occ_kernel_routes_identical(monkeypatch):
+    """Both _extract_first_occ kernel routes (bitmask+window-reduce vs
+    first-hit kernel) must produce identical occ/offs — and the cuts
+    must match the host reference either way."""
+    import numpy as np
+
+    from dat_replication_protocol_tpu.ops import rabin
+
+    data = _data(6 * 4096 + 321, seed=13)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    ref = rabin.host_thin(rabin.host_candidates(data, 8), 8)
+    for env in ("0", "1"):
+        monkeypatch.setenv("DAT_CDC_FIRST_KERNEL", env)
+        got = rabin._device_candidates(buf, 8, 1 << 12, 4, thin_bits=8)
+        assert got.tolist() == ref, f"first_kernel={env}"
