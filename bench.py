@@ -741,6 +741,35 @@ def bench_cdc(quick: bool, backend: str) -> dict:
             collect(), slab_bytes, 1 << (avg_bits - 2), 1 << (avg_bits + 2)
         )
 
+    # self-select the extraction route (bitmask kernel + window reduce
+    # vs the first-hit kernel): the serial-chain analysis favors the
+    # bitmask route, but the bench should capture the best configuration
+    # the chip actually delivers, not a prediction (same policy as the
+    # hash kernel calibration; both routes are byte-identical — tested)
+    if "DAT_CDC_FIRST_KERNEL" not in os.environ:
+        cal = {}
+        for fk in ("0", "1"):
+            os.environ["DAT_CDC_FIRST_KERNEL"] = fk
+            try:
+                finish(begin())  # compile + warm
+                # median of 3: one congestion spike must not lock the
+                # slower route in for the whole headline (same policy
+                # as the hash kernel calibration)
+                dts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    finish(begin())
+                    dts.append(time.perf_counter() - t0)
+                cal[fk] = statistics.median(dts)
+            except Exception as e:
+                log(f"bench[cdc]: route first_kernel={fk} failed ({e})")
+        if cal:
+            pick = min(cal, key=cal.get)
+            os.environ["DAT_CDC_FIRST_KERNEL"] = pick
+            log(f"bench[cdc]: route calibration {cal} -> first_kernel={pick}")
+        else:
+            os.environ.pop("DAT_CDC_FIRST_KERNEL", None)
+
     cuts = finish(begin())  # warmup/compile
     nchunks = len(cuts)
     # depth-2 pipeline: slab N's position D2H rides under slab N+1's scan,
@@ -790,6 +819,9 @@ def bench_cdc(quick: bool, backend: str) -> dict:
         "vs_baseline": None,
         "volume_gib": round(total / (1 << 30), 2),
         "kernel_only_gib_s": round(kernel_gib_s, 3),
+        "extract_route": ("first-hit kernel"
+                          if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
+                          else "bitmask+window-reduce"),
         "chunks_per_slab": nchunks,
     }
 

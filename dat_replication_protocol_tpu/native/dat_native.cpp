@@ -968,12 +968,13 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
   }
   const uint32_t mask = (1u << avg_bits) - 1u;
   int nt = pick_threads(nthreads, n, 1 << 22);  // >= 4 MiB per thread
-  if (n < (1 << 16) || nthreads == 1) {
+  if (n < (1 << 16) || nthreads < -1) {
     // one plain chain, straight into out, fail fast — for tiny inputs,
-    // and as the independently-implemented reference when a caller
-    // EXPLICITLY requests one thread (the serial-vs-parallel tests
-    // depend on this route not sharing the quartering/merge machinery;
-    // auto on a 1-core host still gets the 4-chain ILP path below)
+    // and as the independently-implemented reference route (nthreads
+    // < -1, a test-only sentinel: the equivalence tests need a path
+    // that shares none of the quartering/merge machinery).  Explicit
+    // nthreads=1 keeps the 4-chain ILP scan on its single thread —
+    // bounding CPU usage must not cost the interleave speedup.
     int64_t m = gear_scan_range(buf, 0, n, tab, mask, thin_bits, out, cap);
     return m < 0 ? DAT_ERR_CAPACITY : m;
   }

@@ -193,9 +193,14 @@ def sketch(buf: np.ndarray, rec_offs, rec_lens, key_offs, key_lens,
     return table, slots
 
 
-def gear_candidates(buf: np.ndarray, avg_bits: int, thin_bits: int = -1):
+def gear_candidates(buf: np.ndarray, avg_bits: int, thin_bits: int = -1,
+                    serial_reference: bool = False):
     """Host gear CDC candidate scan (seeded-stream definition); sorted
-    absolute positions as int64, or None when unavailable."""
+    absolute positions as int64, or None when unavailable.
+
+    ``serial_reference=True`` forces the independently-implemented
+    single-chain route (tests compare the 4-chain machinery against it;
+    never faster, only simpler)."""
     if not 1 <= avg_bits <= 31:
         raise ValueError("avg_bits must be in [1, 31]")
     if thin_bits > 31:
@@ -211,7 +216,7 @@ def gear_candidates(buf: np.ndarray, avg_bits: int, thin_bits: int = -1):
     while True:
         out = np.empty(cap, dtype=np.int64)
         rc = lib.dat_gear_candidates(buf, n, avg_bits, thin_bits, out, cap,
-                                     _nthreads())
+                                     -2 if serial_reference else _nthreads())
         if rc == ERR_CAPACITY:
             cap *= 4
             continue

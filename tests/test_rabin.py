@@ -231,8 +231,8 @@ def test_parallel_gear_scan_matches_serial(monkeypatch):
     # > 16 MiB so pick_threads engages multiple ranges at DAT_NTHREADS=4
     data = rng.integers(0, 256, (24 << 20) + 999, dtype=np.uint8)
     for thin in (-1, 8, 11):
-        monkeypatch.setenv("DAT_NTHREADS", "1")
-        serial = native.gear_candidates(data, 12, thin)
+        serial = native.gear_candidates(data, 12, thin,
+                                        serial_reference=True)
         monkeypatch.setenv("DAT_NTHREADS", "4")
         par = native.gear_candidates(data, 12, thin)
         assert np.array_equal(serial, par), f"thin_bits={thin}"
