@@ -1,0 +1,83 @@
+"""Device experiment: blocks_per_step structural variant of the BLAKE2b
+kernel (VERDICT round-3 item 1: "attempt one structural change").
+
+Measures bps in {1, 2, 4, 8} interleaved twice (median of 3 each) on the
+config-3 shape, cross-checks byte-exactness on-chip with mixed lengths,
+and captures a profiler trace of the baseline and best variant.
+"""
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dat_replication_protocol_tpu.ops.blake2b_pallas import blake2b_native
+from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
+
+enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
+
+item_bytes = 1 << 20
+nblocks = item_bytes // 128
+chunk = 4096
+
+kh, kl = jax.random.split(jax.random.PRNGKey(0))
+shape = (nblocks, 16, 8, chunk // 8)
+mh = jax.random.bits(kh, shape, dtype=jnp.uint32)
+ml = jax.random.bits(kl, shape, dtype=jnp.uint32)
+lens = jnp.full((8, chunk // 8), item_bytes, dtype=jnp.uint32)
+jax.block_until_ready((mh, ml))
+
+# on-chip byte-exactness first: mixed lengths below a 4-block input so
+# active/final masks take both values at every sub-block position
+xh = jax.random.bits(kh, (4, 16, 8, 256), dtype=jnp.uint32)
+xl = jax.random.bits(kl, (4, 16, 8, 256), dtype=jnp.uint32)
+mixed = jnp.arange(2048, dtype=jnp.uint32).reshape(8, 256) % jnp.uint32(513)
+ra = blake2b_native(xh, xl, mixed, msg_loads=True)
+for bps in (2, 4):
+    for vs in (False, True):
+        rb = blake2b_native(xh, xl, mixed, msg_loads=True, vmem_state=vs,
+                            blocks_per_step=bps)
+        assert np.array_equal(np.asarray(ra[0]), np.asarray(rb[0])), (bps, vs)
+        assert np.array_equal(np.asarray(ra[1]), np.asarray(rb[1])), (bps, vs)
+print("bps cross-checks ok (mixed lengths, on-chip)", flush=True)
+
+
+def run(tag, **kw):
+    f = lambda: blake2b_native(mh, ml, lens, **kw)
+    np.asarray(f()[0][:1, :1])
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        hh, hl = f()
+        np.asarray(hh[:1, :1]); np.asarray(hl[:1, :1])
+        dts.append(time.perf_counter() - t0)
+    g = chunk * item_bytes / statistics.median(dts) / (1 << 30)
+    print(f"{tag}: {g:.2f} GiB/s (median of 3)", flush=True)
+    return g
+
+
+variants = [
+    ("bps1 ml1", dict(msg_loads=True)),
+    ("bps2 ml1", dict(msg_loads=True, blocks_per_step=2)),
+    ("bps4 ml1", dict(msg_loads=True, blocks_per_step=4)),
+    ("bps8 ml1", dict(msg_loads=True, blocks_per_step=8)),
+    ("bps2 vmem", dict(msg_loads=True, vmem_state=True, blocks_per_step=2)),
+    ("bps4 vmem", dict(msg_loads=True, vmem_state=True, blocks_per_step=4)),
+]
+best, best_g = None, 0.0
+for rnd in range(2):
+    for tag, kw in variants:
+        g = run(f"r{rnd} {tag}", **kw)
+        if g > best_g:
+            best, best_g = (tag, kw), g
+print(f"best: {best[0]} at {best_g:.2f} GiB/s", flush=True)
+
+# profiler trace: baseline and best, 2 reps each
+trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/blake2b_trace"
+with jax.profiler.trace(trace_dir):
+    for kw in (dict(msg_loads=True), best[1]):
+        hh, hl = blake2b_native(mh, ml, lens, **kw)
+        np.asarray(hh[:1, :1])
+print(f"trace written to {trace_dir}", flush=True)

@@ -112,18 +112,20 @@ class _RefWords:
     spilling a hot value.
     """
 
-    def __init__(self, mh_ref, ml_ref):
+    def __init__(self, mh_ref, ml_ref, k: int = 0):
         self._mh = mh_ref
         self._ml = ml_ref
+        self._k = k
 
     def __getitem__(self, w):
         w = int(w)
-        return self._mh[0, w], self._ml[0, w]
+        return self._mh[self._k, w], self._ml[self._k, w]
 
 
 def _kernel(*refs, digest_size: int, unroll: bool = True,
             msg_loads: bool = False, vmem_state: bool = False,
-            state_loads: bool = False):
+            state_loads: bool = False, blocks_per_step: int = 1,
+            g_interleave: bool = False):
     if vmem_state:
         (len_ref, mh_ref, ml_ref, outh_ref, outl_ref,
          sth_ref, stl_ref, vh_ref, vl_ref) = refs
@@ -150,29 +152,62 @@ def _kernel(*refs, digest_size: int, unroll: bool = True,
             stl_ref[w] = jnp.full(shape, lo, U32)
 
     lengths = len_ref[:]
-    ju = j.astype(U32)
     # where-based max/min: Mosaic has no arith.maxui/minui legalization
     nb_ceil = (lengths + U32(127)) >> U32(7)
     item_blocks = jnp.where(nb_ceil == U32(0), U32(1), nb_ceil)
-    active = ju < item_blocks
-    final = ju == item_blocks - U32(1)
-    cap = (ju + U32(1)) << U32(7)
-    t_lo = jnp.where(cap < lengths, cap, lengths)
 
-    if msg_loads and unroll:
-        m = _RefWords(mh_ref, ml_ref)
+    if blocks_per_step == 1:
+        ju = j.astype(U32)
+        active = ju < item_blocks
+        final = ju == item_blocks - U32(1)
+        cap = (ju + U32(1)) << U32(7)
+        t_lo = jnp.where(cap < lengths, cap, lengths)
+
+        if msg_loads and unroll:
+            m = _RefWords(mh_ref, ml_ref)
+        else:
+            m = [(mh_ref[0, w], ml_ref[0, w]) for w in range(16)]
+        if state_loads and unroll:
+            h = _RefState(sth_ref, stl_ref)
+        else:
+            h = [(sth_ref[w], stl_ref[w]) for w in range(8)]
+        lanes = _RefLanes(vh_ref, vl_ref) if vmem_state else None
+        nh = compress_soa(h, m, t_lo, final, unroll=unroll, sigma=sigma,
+                          lanes=lanes, g_interleave=g_interleave)
+        for w in range(8):
+            sth_ref[w] = jnp.where(active, nh[w][0], h[w][0])
+            stl_ref[w] = jnp.where(active, nh[w][1], h[w][1])
     else:
-        m = [(mh_ref[0, w], ml_ref[0, w]) for w in range(16)]
-    if state_loads and unroll:
-        h = _RefState(sth_ref, stl_ref)
-    else:
+        # multi-block step: chain h through registers across the
+        # sub-blocks, touching the VMEM chaining scratch once per step
+        # instead of once per block — the structural variant pricing
+        # per-grid-step overhead (mask recompute is per sub-block, but
+        # state load/store, pl.when dispatch, and Mosaic's step
+        # prologue/epilogue amortize over blocks_per_step compressions)
         h = [(sth_ref[w], stl_ref[w]) for w in range(8)]
-    lanes = _RefLanes(vh_ref, vl_ref) if vmem_state else None
-    nh = compress_soa(h, m, t_lo, final, unroll=unroll, sigma=sigma,
-                      lanes=lanes)
-    for w in range(8):
-        sth_ref[w] = jnp.where(active, nh[w][0], h[w][0])
-        stl_ref[w] = jnp.where(active, nh[w][1], h[w][1])
+        lanes = _RefLanes(vh_ref, vl_ref) if vmem_state else None
+        for k in range(blocks_per_step):
+            ju = (j * blocks_per_step + k).astype(U32)
+            active = ju < item_blocks
+            final = ju == item_blocks - U32(1)
+            cap = (ju + U32(1)) << U32(7)
+            t_lo = jnp.where(cap < lengths, cap, lengths)
+            if msg_loads:
+                m = _RefWords(mh_ref, ml_ref, k)
+            else:
+                m = [(mh_ref[k, w], ml_ref[k, w]) for w in range(16)]
+            nh = compress_soa(h, m, t_lo, final, unroll=True, lanes=lanes,
+                              g_interleave=g_interleave)
+            h = [
+                (
+                    jnp.where(active, nh[w][0], h[w][0]),
+                    jnp.where(active, nh[w][1], h[w][1]),
+                )
+                for w in range(8)
+            ]
+        for w in range(8):
+            sth_ref[w] = h[w][0]
+            stl_ref[w] = h[w][1]
 
     @pl.when(j == nb - 1)
     def _emit():
@@ -184,12 +219,14 @@ def _kernel(*refs, digest_size: int, unroll: bool = True,
 @functools.partial(
     jax.jit,
     static_argnames=("digest_size", "block_items", "interpret", "msg_loads",
-                     "vmem_state", "state_loads"),
+                     "vmem_state", "state_loads", "blocks_per_step",
+                     "g_interleave"),
 )
 def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
                    block_items: int = 1024, interpret: bool = False,
                    msg_loads: bool = True, vmem_state: bool = False,
-                   state_loads: bool = False):
+                   state_loads: bool = False, blocks_per_step: int = 1,
+                   g_interleave: bool = False):
     """Hash in the kernel-native layout.
 
     ``mh``/``ml``: (nblocks, 16, 8, B/8) uint32 message word halves;
@@ -197,6 +234,11 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
     ``block_items`` (and ``block_items`` of 8*128).  Returns digest words
     as ``(hh, hl)``, each (8, 8, B/8): word-major, batch split like the
     input.
+
+    ``blocks_per_step`` > 1 compresses that many consecutive message
+    blocks per grid step with the chaining state held in registers
+    between them (``nblocks`` must divide evenly); it prices Mosaic's
+    per-grid-step overhead against register pressure.
     """
     nb, _, s, bl = mh.shape
     if s != _SUBLANE:
@@ -206,8 +248,17 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
     btl = block_items // _SUBLANE
     if bl % btl:
         raise ValueError(f"B/8={bl} not a multiple of tile width {btl}")
+    if blocks_per_step < 1 or nb % blocks_per_step:
+        raise ValueError(
+            f"blocks_per_step={blocks_per_step} must divide nblocks={nb}"
+        )
+    if state_loads and blocks_per_step > 1:
+        # the multi-block branch chains h through registers and never
+        # consults the lazy-state view; refuse rather than silently
+        # benchmark identical code under two variant labels
+        raise ValueError("state_loads has no effect with blocks_per_step > 1")
 
-    grid = (bl // btl, nb)
+    grid = (bl // btl, nb // blocks_per_step)
     # Mosaic gets the straight-line unrolled rounds; the interpreter (CPU
     # tests) gets the scanned rounds, whose 12x-smaller graph sidesteps
     # the CPU backend's pathological compile of the unrolled chain
@@ -217,16 +268,18 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
     # tiny there, the CPU compile of the unrolled chain is the slow part
     # the scanned path normally dodges).  Without the state_loads term
     # the interpret-mode tests would silently exercise the eager path.
-    unroll = (not interpret) or vmem_state or state_loads
+    unroll = (not interpret) or vmem_state or state_loads or blocks_per_step > 1
     kernel = functools.partial(
         _kernel, digest_size=digest_size, unroll=unroll,
         msg_loads=msg_loads, vmem_state=vmem_state,
-        state_loads=state_loads,
+        state_loads=state_loads, blocks_per_step=blocks_per_step,
+        g_interleave=g_interleave,
     )
+    bps = blocks_per_step
     in_specs = [
         pl.BlockSpec((_SUBLANE, btl), lambda i, j: (0, i)),
-        pl.BlockSpec((1, 16, _SUBLANE, btl), lambda i, j: (j, 0, 0, i)),
-        pl.BlockSpec((1, 16, _SUBLANE, btl), lambda i, j: (j, 0, 0, i)),
+        pl.BlockSpec((bps, 16, _SUBLANE, btl), lambda i, j: (j, 0, 0, i)),
+        pl.BlockSpec((bps, 16, _SUBLANE, btl), lambda i, j: (j, 0, 0, i)),
     ]
     inputs = [lengths, mh, ml]
     if not unroll:
