@@ -1,0 +1,19 @@
+#!/bin/bash
+# Probe the tunnel every ~5 min (subprocess probe, 100 s cap — a wedged
+# tunnel hangs rather than erroring); the moment a probe EXECUTES a
+# device op, fire _when_tpu_returns.sh once and exit.  Round-3/4 wedge
+# signature: platform initializes, first compute hangs forever.
+cd "$(dirname "$0")"
+while true; do
+  if timeout 100 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = np.asarray(jnp.arange(8) * 2)
+assert x[3] == 6
+" >/dev/null 2>&1; then
+    echo "$(date -u) tunnel answered; firing capture" >> /tmp/tpu_watch.log
+    bash _when_tpu_returns.sh >> /tmp/tpu_watch.log 2>&1
+    exit 0
+  fi
+  echo "$(date -u) probe failed" >> /tmp/tpu_watch.log
+  sleep 300
+done

@@ -1,126 +1,16 @@
 #!/bin/bash
-# Fire the full device measurements the moment the tunnel answers.
-# Round-4 agenda (VERDICT items 1 and 4): BLAKE2b variant sweep first
-# (it decides the headline kernel), then the full bench capture, then
-# the CDC ceiling diagnosis, then a profiler trace.
+# Fire the full device capture the moment the tunnel answers.
+# Round-4 late agenda: the variant sweep, CDC diagnosis, and structural
+# experiments already ran in the 03:30-05:20 UTC window (results in
+# PERF.md + BENCH_builder_r04_tpu_{early,final}.json).  What remains is
+# ONE clean, uncontended, full-bench capture with the pipelined-fence
+# methodology — nothing else may run on the chip while this does.
 cd "$(dirname "$0")"
 set -x
 # 0) insurance first: a minimal quick TPU capture (~3 min) so even a
-#    window that dies mid-sweep leaves a backend=tpu artifact
+#    window that dies mid-run leaves a backend=tpu artifact
 BENCH_CONFIGS=3 BENCH_DEADLINE=400 timeout 420 python bench.py --quick 2>&1 | tail -3
-# 1) hash kernel variant sweep: msg_loads x block_items x vmem_state,
-#    interleaved twice to denoise the shared chip
-timeout 900 python - <<'PY' 2>&1 | grep -v WARNING
-import time, statistics, numpy as np, jax, jax.numpy as jnp
-from dat_replication_protocol_tpu.ops.blake2b_pallas import blake2b_native
-from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
-enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
-item_bytes = 1 << 20
-nblocks = item_bytes // 128
-def mk(chunk):
-    kh, kl = jax.random.split(jax.random.PRNGKey(0))
-    shape = (nblocks, 16, 8, chunk // 8)
-    return (jax.random.bits(kh, shape, dtype=jnp.uint32),
-            jax.random.bits(kl, shape, dtype=jnp.uint32),
-            jnp.full((8, chunk // 8), item_bytes, dtype=jnp.uint32))
-data = {4096: mk(4096)}
-def run(tag, chunk, bi, ml, vs=False, sl=False):
-    mh, mlo, lens = data[chunk]
-    f = lambda: blake2b_native(mh, mlo, lens, block_items=bi, msg_loads=ml,
-                               vmem_state=vs, state_loads=sl)
-    np.asarray(f()[0][:1, :1])
-    dts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        hh, hl = f()
-        np.asarray(hh[:1, :1]); np.asarray(hl[:1, :1])
-        dts.append(time.perf_counter() - t0)
-    g = chunk * item_bytes / statistics.median(dts) / (1 << 30)
-    print(f"{tag}: {g:.2f} GiB/s (median of 3)", flush=True)
-variants = [("A c4096 bi1024 ml0", 4096, 1024, False, False, False),
-            ("K c4096 bi1024 ml1", 4096, 1024, True, False, False),
-            ("K2 c4096 bi2048 ml1", 4096, 2048, True, False, False),
-            ("S c4096 bi1024 ml1 sl1", 4096, 1024, True, False, True),
-            ("V c4096 bi1024 vmem", 4096, 1024, True, True, False),
-            ("V2 c4096 bi2048 vmem", 4096, 2048, True, True, False),
-            ("VS c4096 bi1024 vmem sl1", 4096, 1024, True, True, True),
-            ("VS2 c4096 bi2048 vmem sl1", 4096, 2048, True, True, True)]
-# correctness cross-check of the vmem_state variant on the real chip:
-# MIXED lengths below the 4-block input so the active/final/t_lo masks
-# all take both values under Mosaic
-kh, kl = jax.random.split(jax.random.PRNGKey(9))
-xh = jax.random.bits(kh, (4, 16, 8, 256), dtype=jnp.uint32)
-xl = jax.random.bits(kl, (4, 16, 8, 256), dtype=jnp.uint32)
-mixed = jnp.arange(2048, dtype=jnp.uint32).reshape(8, 256) % jnp.uint32(513)
-ra = blake2b_native(xh, xl, mixed, msg_loads=True)
-for kw in ({"vmem_state": True}, {"state_loads": True},
-           {"vmem_state": True, "state_loads": True}):
-    rb = blake2b_native(xh, xl, mixed, msg_loads=True, **kw)
-    assert np.array_equal(np.asarray(ra[0]), np.asarray(rb[0])), kw
-    assert np.array_equal(np.asarray(ra[1]), np.asarray(rb[1])), kw
-print("variant cross-checks ok (mixed lengths, on-chip)", flush=True)
-for rnd in range(2):
-    for tag, c, bi, ml, vs, sl in variants:
-        run(f"r{rnd} {tag}", c, bi, ml, vs, sl)
-PY
-# 2) full bench configs 3,4,5 (the headline artifacts; a re-wedge
-#    mid-script must not cost these)
-BENCH_CONFIGS=3,4,5 timeout 1800 python bench.py 2>&1 | grep -v WARNING | tail -8
-# 3) CDC ceiling diagnosis by elimination: each diag variant carves one
-#    suspect out of the inner loop (output wrong by design) — the delta
-#    vs baseline prices that suspect.  Plus ilp/block_tiles spread.
-timeout 900 python - <<'PY' 2>&1 | grep -v WARNING
-import time, statistics, numpy as np, jax, jax.numpy as jnp
-from dat_replication_protocol_tpu.ops.rabin_pallas import gear_candidates_native
-from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
-enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
-stride = 1 << 17
-T = (2 << 30) // stride  # 2 GiB of tiles so bt16384 divides T
-ng, gw = stride // 256, 64
-w = jax.random.bits(jax.random.PRNGKey(3), (ng, gw, 8, T // 8), dtype=jnp.uint32)
-jax.block_until_ready(w)
-def run(tag, **kw):
-    f = jax.jit(lambda x: jnp.sum(gear_candidates_native(x, 13, **kw)))
-    np.asarray(f(w))
-    dts = []
-    for _ in range(3):
-        t0 = time.perf_counter(); np.asarray(f(w))
-        dts.append(time.perf_counter() - t0)
-    g = w.nbytes / statistics.median(dts) / (1 << 30)
-    print(f"cdc {tag}: {g:.2f} GiB/s (median of 3)", flush=True)
-for rnd in range(2):
-    run(f"r{rnd} base ilp8 bt8192")
-    run(f"r{rnd} nomul", diag="nomul")
-    run(f"r{rnd} nostore", diag="nostore")
-    run(f"r{rnd} noextract", diag="noextract")
-    run(f"r{rnd} ilp4", ilp=4)
-    run(f"r{rnd} ilp16 bt16384", ilp=16, block_tiles=16384)
-    run(f"r{rnd} bt4096 ilp4", ilp=4, block_tiles=4096)
-
-# e2e route comparison: bitmask+window-reduce (new default) vs the
-# first-hit kernel (old fast path) through the real candidates_begin ->
-# greedy pipeline on a 1 GiB device-resident slab
-import os
-from dat_replication_protocol_tpu.ops import rabin
-slab_b = 1 << 30
-words_s = jax.random.bits(jax.random.PRNGKey(5), (slab_b // 4,),
-                          dtype=jnp.uint32)
-jax.block_until_ready(words_s)
-for env in ("0", "1"):
-    os.environ["DAT_CDC_FIRST_KERNEL"] = env
-    def e2e():
-        c = rabin.candidates_begin(words_s, slab_b, 13, thin_bits=11)
-        return rabin._greedy_select(c(), slab_b, 1 << 11, 1 << 15)
-    e2e()
-    dts = []
-    for _ in range(3):
-        t0 = time.perf_counter(); e2e()
-        dts.append(time.perf_counter() - t0)
-    g = slab_b / statistics.median(dts) / (1 << 30)
-    print(f"cdc e2e first_kernel={env}: {g:.2f} GiB/s (median of 3)",
-          flush=True)
-os.environ.pop("DAT_CDC_FIRST_KERNEL", None)
-PY
-# 4) profiler trace of the device configs (quick shapes; diagnostic)
-BENCH_CONFIGS=3,4,5 timeout 900 python bench.py --quick --trace=/tmp/dat_trace 2>&1 | tail -3
-ls -la /tmp/dat_trace 2>/dev/null | head -5
+# 1) the full five-config capture (compile cache is warm for every
+#    shape from the earlier window, so this should fit well inside the
+#    default deadline)
+timeout 2400 python bench.py 2>&1 | grep -v WARNING | tail -6

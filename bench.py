@@ -75,8 +75,13 @@ def _timed_reps_pipelined(dispatch, fence, reps: int, depth: int = 2):
     if os.environ.get("BENCH_SERIAL_FENCE") == "1":
         return _timed_reps(lambda: fence(dispatch()), reps)
     depth = max(1, depth)
+    # priming rep, fenced untimed: without it the FIRST timed span has
+    # no older rep completing under it and eats the full fence RTT the
+    # helper exists to hide — at reps=2 that biases the median ~25%
+    primer = dispatch()
     inflight = [dispatch() for _ in range(min(depth, reps))]
     launched = len(inflight)
+    fence(primer)
     dts = []
     t_prev = time.perf_counter()
     while inflight:
@@ -88,6 +93,13 @@ def _timed_reps_pipelined(dispatch, fence, reps: int, depth: int = 2):
             inflight.append(dispatch())
             launched += 1
     return dts
+
+
+def _fence_mode() -> str:
+    """Recorded in every device-config result: pipelined vs serial fence
+    numbers differ ~1.7x on the tunneled link, so cross-round artifact
+    comparisons must not mix them blindly."""
+    return "serial" if os.environ.get("BENCH_SERIAL_FENCE") == "1" else "pipelined"
 
 
 def _env_int(name, default):
@@ -677,6 +689,7 @@ def bench_hash(quick: bool, backend: str) -> dict:
         "unit": "GiB/s",
         "vs_baseline": round(gib_s / 50.0, 4),
         "aggregate_gib_s": round(total / dt / (1 << 30), 3),
+        "fence": _fence_mode(),
         "kernel_variant": variant,
         "e2e_host_gib_s": round(e2e_gib_s, 3),
         "session_digest_mib_s": round(session_mib_s, 1),
@@ -854,6 +867,7 @@ def bench_cdc(quick: bool, backend: str) -> dict:
         "vs_baseline": None,
         "volume_gib": round(total / (1 << 30), 2),
         "kernel_only_gib_s": round(kernel_gib_s, 3),
+        "fence": _fence_mode(),
         "extract_route": ("first-hit kernel"
                           if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
                           else "bitmask+window-reduce"),
@@ -971,6 +985,7 @@ def bench_merkle(quick: bool, backend: str) -> dict:
         "unit": "entries/s",
         "vs_baseline": round(rate / 10e6, 4),
         "aggregate_entries_s": round(reps * n / dt, 0),
+        "fence": _fence_mode(),
         "leaves": n,
         "local_diff_entries_s": round(local_rate, 0) if local_rate else None,
         "reconcile_records_s": round(rrate, 0),

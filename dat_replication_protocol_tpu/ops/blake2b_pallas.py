@@ -268,7 +268,8 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
     # tiny there, the CPU compile of the unrolled chain is the slow part
     # the scanned path normally dodges).  Without the state_loads term
     # the interpret-mode tests would silently exercise the eager path.
-    unroll = (not interpret) or vmem_state or state_loads or blocks_per_step > 1
+    unroll = ((not interpret) or vmem_state or state_loads
+              or blocks_per_step > 1 or g_interleave)
     kernel = functools.partial(
         _kernel, digest_size=digest_size, unroll=unroll,
         msg_loads=msg_loads, vmem_state=vmem_state,

@@ -147,3 +147,35 @@ def test_blocks_per_step_byte_exact():
     for i in (0, 1, 511, 1023):
         exp = hashlib.blake2b(payloads[i], digest_size=32).digest()
         assert digs[i] == exp, i
+
+
+def test_g_interleave_byte_exact():
+    """The 4-way lockstep G-stage emission must be byte-exact (it is
+    pure reordering of independent ops; a lane-indexing slip in
+    _g_stage4 would corrupt digests).  interpret forces the unrolled
+    rounds for this flag, so the interleaved path really traces."""
+    import hashlib
+
+    import jax.numpy as jnp
+
+    from dat_replication_protocol_tpu.ops.blake2b import (
+        digests_to_bytes,
+        pack_payloads,
+    )
+    from dat_replication_protocol_tpu.ops.blake2b_pallas import (
+        blake2b_native,
+        from_native,
+        to_native,
+    )
+
+    payloads = [b"", b"x" * 7, b"y" * 129, b"z" * 256]
+    mh, ml, lens = pack_payloads(payloads, nblocks=2)
+    mh_n, ml_n, len_n, B = to_native(
+        jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lens)
+    )
+    hh, hl = blake2b_native(mh_n, ml_n, len_n, interpret=True,
+                            msg_loads=True, vmem_state=True,
+                            g_interleave=True)
+    assert digests_to_bytes(*from_native(hh, hl, B)) == [
+        hashlib.blake2b(p, digest_size=32).digest() for p in payloads
+    ]
