@@ -77,7 +77,7 @@ cap0 = min(max(256, slab_b >> max(avg_bits - 2, 0)), slab_b >> thin_bits)
 def extract_fenced():
     occ, offs = rabin._extract_first_occ(
         words, pre, T, stride, avg_bits, cap0, True, thin_bits,
-        first_kernel=False,
+        route="bitmask",
     )
     np.asarray(jnp.sum(occ) + jnp.sum(offs.astype(jnp.uint32)))
 

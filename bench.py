@@ -792,17 +792,32 @@ def bench_cdc(quick: bool, backend: str) -> dict:
             collect(), slab_bytes, 1 << (avg_bits - 2), 1 << (avg_bits + 2)
         )
 
-    # self-select the extraction route (bitmask kernel + window reduce
-    # vs the first-hit kernel): the serial-chain analysis favors the
-    # bitmask route, but the bench should capture the best configuration
-    # the chip actually delivers, not a prediction (same policy as the
-    # hash kernel calibration; both routes are byte-identical — tested)
-    if "DAT_CDC_FIRST_KERNEL" not in os.environ:
+    # self-select the extraction route (bitmask kernel + window reduce,
+    # first-hit kernel, or the fused window-first kernel): the
+    # serial-chain analysis favors bitmask over first-hit and the fused
+    # route saves the mask's HBM round-trip, but the bench should
+    # capture the best configuration the chip actually delivers, not a
+    # prediction (same policy as the hash kernel calibration; all
+    # routes produce identical cuts — tested, and guarded again below)
+    if not (os.environ.get("DAT_CDC_ROUTE")
+            or "DAT_CDC_FIRST_KERNEL" in os.environ):
         cal = {}
-        for fk in ("0", "1"):
-            os.environ["DAT_CDC_FIRST_KERNEL"] = fk
+        golden_cuts = None
+        # "fused" is pallas-only; off-TPU it silently aliases bitmask —
+        # timing it there would duplicate a leg and could mislabel
+        # extract_route in the artifact
+        routes = ("bitmask", "first", "fused") if on_tpu else ("bitmask", "first")
+        for route in routes:
+            os.environ["DAT_CDC_ROUTE"] = route
             try:
-                finish(begin())  # compile + warm
+                cuts0 = finish(begin())  # compile + warm
+                if golden_cuts is None:
+                    golden_cuts = cuts0
+                elif cuts0 != golden_cuts:
+                    # never self-select a route that miscuts, however
+                    # fast it runs
+                    log(f"bench[cdc]: route {route} CUT MISMATCH; skipped")
+                    continue
                 # median of 3: one congestion spike must not lock the
                 # slower route in for the whole headline (same policy
                 # as the hash kernel calibration)
@@ -811,15 +826,15 @@ def bench_cdc(quick: bool, backend: str) -> dict:
                     t0 = time.perf_counter()
                     finish(begin())
                     dts.append(time.perf_counter() - t0)
-                cal[fk] = statistics.median(dts)
+                cal[route] = statistics.median(dts)
             except Exception as e:
-                log(f"bench[cdc]: route first_kernel={fk} failed ({e})")
+                log(f"bench[cdc]: route {route} failed ({e})")
         if cal:
             pick = min(cal, key=cal.get)
-            os.environ["DAT_CDC_FIRST_KERNEL"] = pick
-            log(f"bench[cdc]: route calibration {cal} -> first_kernel={pick}")
+            os.environ["DAT_CDC_ROUTE"] = pick
+            log(f"bench[cdc]: route calibration {cal} -> {pick}")
         else:
-            os.environ.pop("DAT_CDC_FIRST_KERNEL", None)
+            os.environ.pop("DAT_CDC_ROUTE", None)
 
     cuts = finish(begin())  # warmup/compile
     nchunks = len(cuts)
@@ -868,9 +883,11 @@ def bench_cdc(quick: bool, backend: str) -> dict:
         "volume_gib": round(total / (1 << 30), 2),
         "kernel_only_gib_s": round(kernel_gib_s, 3),
         "fence": _fence_mode(),
-        "extract_route": ("first-hit kernel"
-                          if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
-                          else "bitmask+window-reduce"),
+        "extract_route": (
+            os.environ.get("DAT_CDC_ROUTE")
+            or ("first" if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
+                else "bitmask")
+        ),
         "chunks_per_slab": nchunks,
     }
 

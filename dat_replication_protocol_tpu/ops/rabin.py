@@ -219,11 +219,11 @@ def _build_rows(words_padded, pre_row, T: int, stride: int):
 @functools.partial(
     jax.jit,
     static_argnames=("T", "stride", "avg_bits", "cap2", "use_pallas",
-                     "thin_bits", "first_kernel"),
+                     "thin_bits", "route"),
 )
 def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
                        avg_bits: int, cap2: int, use_pallas: bool,
-                       thin_bits: int = 11, first_kernel: bool = False):
+                       thin_bits: int = 11, route: str = "bitmask"):
     """Thinned candidate extraction: occupancy bitmap + in-window offsets.
 
     **Candidate thinning**: at most the *first* candidate in each aligned
@@ -233,18 +233,24 @@ def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
     cut to an equivalent in-window neighbor.  Deterministic for a given
     stream; documented policy, not an approximation knob.
 
-    Two equivalent kernel routes (``first_kernel``):
+    Three equivalent kernel routes (``route``; all produce identical
+    candidate sets — tested):
 
-    * ``False`` (default) — the BITMASK kernel + a vectorized
+    * ``"bitmask"`` (default) — the BITMASK kernel + a vectorized
       first-set-bit reduction per window.  The first-hit kernel's
       per-byte ``where`` chain lengthens the gear loop's serial
       dependency (the scan's actual binder), while the bitmask kernel's
       ``or``-accumulate does not — the reduction over packed words is
       ~1 op per 32 bytes, off the critical path.  8x the kernel OUTPUT
       volume, but that output never leaves the device.
-    * ``True`` — the first-hit-per-GROUP kernel + a min over groups
+    * ``"first"`` — the first-hit-per-GROUP kernel + a min over groups
       (1/8 the kernel output volume; kept for measurement comparison —
-      DAT_CDC_FIRST_KERNEL=1).
+      DAT_CDC_FIRST_KERNEL=1 / DAT_CDC_ROUTE=first).
+    * ``"fused"`` — the window-first reduction fused INTO the gear
+      kernel (per-packed-word tracking in registers, one u32 flushed
+      per window): no 1-bit/byte mask ever lands in HBM and no second
+      reduction dispatch runs.  Pallas-only; falls back to "bitmask"
+      off-TPU.  DAT_CDC_ROUTE=fused.
 
     The host result rides in two dense-free pieces —
 
@@ -258,7 +264,13 @@ def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
     count (and the cap2-overflow check) from popcounting ``occ``.
     """
     rows = _build_rows(words_padded, pre_row, T, stride)
-    if first_kernel:
+    if route == "fused" and not use_pallas:
+        route = "bitmask"  # the fused kernel has no XLA formulation
+    if route == "fused":
+        from .rabin_pallas import gear_window_first_pallas
+
+        first = gear_window_first_pallas(rows, avg_bits, thin_bits)
+    elif route == "first":
         if use_pallas:
             from .rabin_pallas import gear_first_pallas
 
@@ -469,15 +481,18 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
 
     if thin_bits is not None and thin_bits >= 8:
         # fast path: windowed first-candidate extraction + occ/offsets
-        # transfer (kernel route per _extract_first_occ; the env knob is
-        # for on-device measurement comparison)
+        # transfer (kernel route per _extract_first_occ; the env knobs
+        # are for on-device measurement comparison / bench calibration)
         import os
 
-        fk = os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
+        route = os.environ.get("DAT_CDC_ROUTE")
+        if route not in ("bitmask", "first", "fused"):
+            route = ("first" if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
+                     else "bitmask")
         with span("cdc.dispatch"):
             first = _extract_first_occ(
                 words, pre, T, stride, avg_bits, cap0, use_pallas,
-                thin_bits, first_kernel=fk,
+                thin_bits, route=route,
             )
             _start_d2h(first)
 
@@ -494,7 +509,7 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
                     cap *= 4
                     _, offs = _extract_first_occ(
                         words, pre, T, stride, avg_bits, cap, use_pallas,
-                        thin_bits, first_kernel=fk,
+                        thin_bits, route=route,
                     )
                 offs_np = np.asarray(offs)
                 out = (winidx << thin_bits) + offs_np[: len(winidx)].astype(

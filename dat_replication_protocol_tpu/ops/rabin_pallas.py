@@ -155,6 +155,216 @@ def gear_candidates_native(words, avg_bits: int = 13,
     )(words)
 
 
+_SENT_OFF = 1 << 30  # "window has no candidate" (matches rabin's sentinel)
+
+
+def _to_native_layout(words, block_tiles: int | None, ilp: int | None):
+    """Shared wrapper boilerplate for the three kernel routes: pick
+    block_tiles/ilp defaults, pad the tile count, and transpose (T, S/4)
+    rows into the word-major (ng, GROUP/4, 8, Tp/8) kernel layout.
+    Returns (native, Tp, ng, block_tiles, ilp)."""
+    T, nwords = words.shape
+    if block_tiles is None:
+        block_tiles = 1024
+        while block_tiles < min(T, 8192):
+            block_tiles <<= 1
+    if ilp is None:
+        ilp = max(1, block_tiles // 1024)
+    S = nwords * 4
+    if S % GROUP:
+        raise ValueError(f"tile bytes must be a multiple of {GROUP}")
+    Tp = -(-T // block_tiles) * block_tiles
+    if Tp != T:
+        words = jnp.pad(words, ((0, Tp - T), (0, 0)))
+    ng = S // GROUP
+    # (T, ng, GROUP/4) -> (ng, GROUP/4, T) word-major -> split tile axis
+    native = jnp.transpose(
+        words.reshape(Tp, ng, GROUP // 4), (1, 2, 0)
+    ).reshape(ng, GROUP // 4, _SUBLANE, Tp // _SUBLANE)
+    return native, Tp, ng, block_tiles, ilp
+
+
+def _kernel_wfirst(wref, oref, sth_ref, stl_ref, fidx_ref, fval_ref, *,
+                   avg_bits: int, ilp: int, gpw: int):
+    """Gear scan with the per-window first-candidate reduction FUSED in.
+
+    Same byte loop as :func:`_kernel`, but instead of storing the packed
+    bitmask (1 bit/byte, re-read by a separate reduction dispatch), the
+    kernel tracks — per tile lane, in registers — the first nonzero
+    packed word of the current ``2**thin_bits``-byte window
+    (``gpw`` = groups per window) and flushes ONE u32 per window: the
+    in-window byte offset of the first candidate, or ``_SENT_OFF``.
+    Output volume drops 8x vs the bitmask (4 B per window vs 4 B per 32
+    bytes) and the mask never round-trips through HBM.  The tracking
+    cost is ~5 ops per packed word (per 32 bytes), off the gear chain's
+    serial path; the lsb/popcount runs once per window flush.
+
+    Window accounting: group 0 is the warm-up prefix (excluded); window
+    w covers groups [1 + w*gpw, 1 + (w+1)*gpw).  ``fidx`` holds the
+    window-word index (0..gpw*8-1) of the first hit, ``fval`` that
+    word's bits; both persist across grid steps in VMEM scratch.
+    """
+    j = pl.program_id(1)
+    mask = U32((1 << avg_bits) - 1)
+    btl = sth_ref.shape[-1] // ilp
+    sent = U32(0xFFFFFFFF)
+
+    @pl.when(j == 0)
+    def _init():
+        sth_ref[0] = jnp.zeros(sth_ref.shape[1:], U32)
+        stl_ref[0] = jnp.zeros(stl_ref.shape[1:], U32)
+        fidx_ref[0] = jnp.full(fidx_ref.shape[1:], sent, U32)
+        fval_ref[0] = jnp.zeros(fval_ref.shape[1:], U32)
+
+    def chunk(a, k):
+        return a[:, k * btl : (k + 1) * btl]
+
+    hh = [chunk(sth_ref[0], k) for k in range(ilp)]
+    hl = [chunk(stl_ref[0], k) for k in range(ilp)]
+    fidx = [chunk(fidx_ref[0], k) for k in range(ilp)]
+    fval = [chunk(fval_ref[0], k) for k in range(ilp)]
+    valid = j > 0  # group 0 is warm-up context: hits there never count
+    wphase = jnp.mod(j - 1, gpw).astype(U32)  # window-local group index
+
+    acc = [jnp.zeros_like(hh[0]) for _ in range(ilp)]
+    bit = 0
+    pword = 0
+    for w in range(GROUP // 4):
+        word = wref[0, w]
+        for s in range(4):
+            for k in range(ilp):
+                byte = (chunk(word, k) >> U32(8 * s)) & U32(0xFF)
+                hh[k], hl[k] = _gear_step(hh[k], hl[k], byte)
+                hit = (hh[k] & mask) == U32(0)
+                acc[k] = acc[k] | (hit.astype(U32) << U32(bit))
+            bit += 1
+            if bit == PACK:
+                word_idx = wphase * U32(GROUP // PACK) + U32(pword)
+                for k in range(ilp):
+                    new = (fidx[k] == sent) & (acc[k] != U32(0)) & valid
+                    fidx[k] = jnp.where(new, word_idx, fidx[k])
+                    fval[k] = jnp.where(new, acc[k], fval[k])
+                acc = [jnp.zeros_like(hh[0]) for _ in range(ilp)]
+                bit = 0
+                pword += 1
+
+    sth_ref[0] = jnp.concatenate(hh, axis=-1)
+    stl_ref[0] = jnp.concatenate(hl, axis=-1)
+
+    is_flush = valid & (wphase == U32(gpw - 1))
+
+    @pl.when(is_flush)
+    def _flush():
+        outs = []
+        for k in range(ilp):
+            lsb = fval[k] & (U32(0) - fval[k])
+            bitpos = _popcount32_u(lsb - U32(1))
+            outs.append(jnp.where(
+                fidx[k] != sent,
+                fidx[k] * U32(PACK) + bitpos,
+                U32(_SENT_OFF),
+            ))
+        oref[0] = jnp.concatenate(outs, axis=-1)
+        fidx_ref[0] = jnp.full(fidx_ref.shape[1:], sent, U32)
+
+    @pl.when(jnp.logical_not(is_flush))
+    def _keep():
+        fidx_ref[0] = jnp.concatenate(fidx, axis=-1)
+        fval_ref[0] = jnp.concatenate(fval, axis=-1)
+
+
+def _popcount32_u(x):
+    """SWAR popcount on uint32 lanes (kernel-local copy: pallas kernels
+    may not capture module-level jnp closures from rabin)."""
+    x = x - ((x >> U32(1)) & U32(0x55555555))
+    x = (x & U32(0x33333333)) + ((x >> U32(2)) & U32(0x33333333))
+    x = (x + (x >> U32(4))) & U32(0x0F0F0F0F)
+    return (x * U32(0x01010101)) >> U32(24)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("avg_bits", "thin_bits", "block_tiles", "interpret",
+                     "ilp"),
+)
+def gear_window_first_native(words, avg_bits: int, thin_bits: int,
+                             block_tiles: int = 8192,
+                             interpret: bool = False, ilp: int = 8):
+    """``words``: (ng, GROUP/4, 8, T/8) uint32 (group 0 = warm-up) ->
+    per-window first-candidate byte offsets ``(nwin_per_tile, 8, T/8)``
+    uint32 (``_SENT_OFF`` = empty window)."""
+    ng, gw, s, tl = words.shape
+    if gw != GROUP // 4 or s != _SUBLANE:
+        raise ValueError(f"expected (ng, {GROUP // 4}, 8, T/8); got {words.shape}")
+    gpw = (1 << thin_bits) // GROUP
+    if gpw < 1 or (ng - 1) % gpw:
+        raise ValueError(
+            f"window of 2**{thin_bits} B needs payload groups {ng - 1} "
+            f"divisible by {gpw}"
+        )
+    btl = block_tiles // _SUBLANE
+    if tl % btl:
+        raise ValueError(f"T/8={tl} not a multiple of tile width {btl}")
+    if btl % ilp or (btl // ilp) % _LANE:
+        raise ValueError(
+            f"block_tiles/8={btl} must split into {ilp} lane-multiples"
+        )
+    nwpt = (ng - 1) // gpw
+    grid = (tl // btl, ng)
+    kernel = functools.partial(_kernel_wfirst, avg_bits=avg_bits, ilp=ilp,
+                               gpw=gpw)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, gw, _SUBLANE, btl), lambda i, j: (j, 0, 0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _SUBLANE, btl),
+            # groups [1 + w*gpw, 1 + (w+1)*gpw) -> window block w; the
+            # warm-up step j=0 aliases harmlessly onto block 0 (clamped),
+            # which it never writes
+            lambda i, j: (jnp.maximum((j - 1) // gpw, 0), 0, i),
+        ),
+        out_shape=jax.ShapeDtypeStruct((nwpt, _SUBLANE, tl), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((1, _SUBLANE, btl), jnp.uint32),
+            pltpu.VMEM((1, _SUBLANE, btl), jnp.uint32),
+            pltpu.VMEM((1, _SUBLANE, btl), jnp.uint32),
+            pltpu.VMEM((1, _SUBLANE, btl), jnp.uint32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(words)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("avg_bits", "thin_bits", "block_tiles", "interpret",
+                     "ilp"),
+)
+def gear_window_first_pallas(words, avg_bits: int, thin_bits: int,
+                             block_tiles: int | None = None,
+                             interpret: bool = False, ilp: int | None = None):
+    """Fused-extraction route: (T, S/4) prefixed tile rows in (group 0 =
+    warm-up, as built by rabin._build_rows), stream-ordered per-window
+    first-candidate offsets out — ``(T * nwin_per_tile,)`` int32 with
+    ``_SENT_OFF`` for empty windows."""
+    T, _ = words.shape
+    native, Tp, ng, block_tiles, ilp = _to_native_layout(
+        words, block_tiles, ilp
+    )
+    firsts = gear_window_first_native(
+        native, avg_bits, thin_bits, block_tiles, interpret, ilp
+    )
+    nwpt = firsts.shape[0]
+    # (nwpt, 8, Tp/8) -> (8, Tp/8, nwpt) -> flat (t, w) stream order
+    out = jnp.transpose(firsts, (1, 2, 0)).reshape(Tp * nwpt)
+    return out[: T * nwpt].astype(jnp.int32)
+
+
 def _kernel_first(wref, oref, sth_ref, stl_ref, *, avg_bits: int, ilp: int):
     """First-hit-per-group variant of :func:`_kernel`: emits one u32 per
     GROUP (the group-local offset of the first candidate, or NO_HIT)
@@ -242,23 +452,10 @@ def gear_first_pallas(words, avg_bits: int = 13,
                       interpret: bool = False, ilp: int | None = None):
     """Drop-in for :func:`.rabin.gear_first_tiled`: (T, S/4) uint32 tiles
     in, (T, S/GROUP) first-hit offsets out, Pallas-accelerated."""
-    T, nwords = words.shape
-    if block_tiles is None:
-        block_tiles = 1024
-        while block_tiles < min(T, 8192):
-            block_tiles <<= 1
-    if ilp is None:
-        ilp = max(1, block_tiles // 1024)
-    S = nwords * 4
-    if S % GROUP:
-        raise ValueError(f"tile bytes must be a multiple of {GROUP}")
-    Tp = -(-T // block_tiles) * block_tiles
-    if Tp != T:
-        words = jnp.pad(words, ((0, Tp - T), (0, 0)))
-    ng = S // GROUP
-    native = jnp.transpose(
-        words.reshape(Tp, ng, GROUP // 4), (1, 2, 0)
-    ).reshape(ng, GROUP // 4, _SUBLANE, Tp // _SUBLANE)
+    T, _ = words.shape
+    native, Tp, ng, block_tiles, ilp = _to_native_layout(
+        words, block_tiles, ilp
+    )
     firsts = gear_first_native(native, avg_bits, block_tiles, interpret, ilp)
     out = jnp.transpose(firsts.reshape(ng, Tp), (1, 0))
     return out[:T]
@@ -282,27 +479,13 @@ def gear_candidates_pallas(words, avg_bits: int = 13,
     ALU- or ILP-bound at this rate) — scaled down for small batches so
     padding never exceeds one power-of-two step.
     """
-    T, nwords = words.shape
-    if block_tiles is None:
-        block_tiles = 1024
-        while block_tiles < min(T, 8192):
-            block_tiles <<= 1
-    if ilp is None:
-        ilp = max(1, block_tiles // 1024)
-    S = nwords * 4
-    if S % GROUP:
-        raise ValueError(f"tile bytes must be a multiple of {GROUP}")
-    Tp = -(-T // block_tiles) * block_tiles
-    if Tp != T:
-        words = jnp.pad(words, ((0, Tp - T), (0, 0)))
-    ng = S // GROUP
-    # (T, ng, GROUP/4) -> (ng, GROUP/4, T) word-major -> split tile axis
-    native = jnp.transpose(
-        words.reshape(Tp, ng, GROUP // 4), (1, 2, 0)
-    ).reshape(ng, GROUP // 4, _SUBLANE, Tp // _SUBLANE)
+    T, _ = words.shape
+    native, Tp, ng, block_tiles, ilp = _to_native_layout(
+        words, block_tiles, ilp
+    )
     bits = gear_candidates_native(native, avg_bits, block_tiles, interpret, ilp)
     # (ng, GROUP/PACK, 8, Tp/8) -> (T, S/PACK)
     out = jnp.transpose(
         bits.reshape(ng, GROUP // PACK, Tp), (2, 0, 1)
-    ).reshape(Tp, S // PACK)
+    ).reshape(Tp, ng * GROUP // PACK)
     return out[:T]

@@ -170,6 +170,39 @@ def test_first_hit_tiled_matches_bitmask():
             assert firsts[t, g] == exp, (t, g)
 
 
+def test_fused_window_first_interpret_matches_bitmask_route():
+    """The fused-extraction kernel (window-first reduction inside the
+    gear scan) must produce exactly the bitmask route's per-window first
+    offsets, including empty-window sentinels and warm-up exclusion."""
+    import jax.numpy as jnp
+
+    from dat_replication_protocol_tpu.ops.rabin_pallas import (
+        gear_window_first_pallas,
+    )
+
+    T, stride, thin_bits = 2, 2048, 9  # W=512 B -> gpw=2, 4 windows/tile
+    data = _data(T * stride, seed=14)
+    words = jnp.asarray(
+        np.frombuffer(data, dtype=np.uint8).view("<u4")
+    )
+    rows = rabin._build_rows(
+        words, jnp.zeros((rabin._PREFIX_WORDS,), jnp.uint32), T, stride
+    )
+    # reference: the bitmask route's window reduction
+    bits = rabin.gear_candidates_tiled(rows, 8)
+    vw = bits[:, rabin._PREFIX // rabin.PACK:
+              rabin._PREFIX // rabin.PACK + stride // rabin.PACK]
+    wpw = (1 << thin_bits) // rabin.PACK
+    ref = np.asarray(rabin._first_bit_per_window(
+        np.asarray(vw).reshape(-1, wpw)
+    ))
+    fused = np.asarray(
+        gear_window_first_pallas(rows, 8, thin_bits, interpret=True)
+    )
+    assert np.array_equal(ref, fused)
+    assert (fused < (1 << 30)).any(), "no candidates at all — weak fixture"
+
+
 def test_first_hit_pallas_interpret_matches_tiled():
     import jax.numpy as jnp
 
