@@ -556,16 +556,16 @@ def bench_hash(quick: bool, backend: str) -> dict:
                     log(f"bench[hash]: variant vmem={vs} sloads={sl} "
                         f"DIGEST MISMATCH vs baseline; skipped")
                     continue
-                # median of 3: one rep can misprice by >2x on the
-                # shared chip (see _timed_reps) and would silently pick
-                # the wrong kernel for the whole headline measurement
-                cals = []
-                for _ in range(3):
-                    t1 = time.perf_counter()
-                    hh, hl = kern()
-                    np.asarray(hh[:1, :1])
-                    np.asarray(hl[:1, :1])
-                    cals.append(time.perf_counter() - t1)
+                # median of 3, pipeline-fenced: one rep can misprice by
+                # >2x on the shared chip and would silently pick the
+                # wrong kernel; serial fencing would additionally bury
+                # variant deltas under the ~66 ms link RTT
+                cals = _timed_reps_pipelined(
+                    kern,
+                    lambda o: (np.asarray(o[0][:1, :1]),
+                               np.asarray(o[1][:1, :1])),
+                    3,
+                )
                 cal = statistics.median(cals)
             except Exception as e:
                 log(f"bench[hash]: variant vmem={vs} sloads={sl} failed ({e})")
@@ -818,15 +818,14 @@ def bench_cdc(quick: bool, backend: str) -> dict:
                     # fast it runs
                     log(f"bench[cdc]: route {route} CUT MISMATCH; skipped")
                     continue
-                # median of 3: one congestion spike must not lock the
-                # slower route in for the whole headline (same policy
-                # as the hash kernel calibration)
-                dts = []
-                for _ in range(3):
-                    t0 = time.perf_counter()
-                    finish(begin())
-                    dts.append(time.perf_counter() - t0)
-                cal[route] = statistics.median(dts)
+                # median of 3, pipelined like the headline loop so
+                # route deltas aren't buried under the link RTT AND one
+                # congestion spike can't lock the slower route in (the
+                # helper also honors BENCH_SERIAL_FENCE, keeping route
+                # selection under the same fencing the headline uses)
+                cal[route] = statistics.median(
+                    _timed_reps_pipelined(begin, finish, 3)
+                )
             except Exception as e:
                 log(f"bench[cdc]: route {route} failed ({e})")
         if cal:
@@ -883,11 +882,7 @@ def bench_cdc(quick: bool, backend: str) -> dict:
         "volume_gib": round(total / (1 << 30), 2),
         "kernel_only_gib_s": round(kernel_gib_s, 3),
         "fence": _fence_mode(),
-        "extract_route": (
-            os.environ.get("DAT_CDC_ROUTE")
-            or ("first" if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
-                else "bitmask")
-        ),
+        "extract_route": rabin.effective_route(use_pallas=on_tpu),
         "chunks_per_slab": nchunks,
     }
 

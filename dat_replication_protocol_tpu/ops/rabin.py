@@ -405,6 +405,24 @@ def _clamp_thin_bits(thin_bits: int | None, stride: int) -> int | None:
     return thin_bits if thin_bits >= 5 else None
 
 
+def effective_route(use_pallas: bool = True) -> str:
+    """The ONE owner of extraction-route resolution: consult
+    ``DAT_CDC_ROUTE`` (values ``bitmask``/``first``/``fused``), fall back
+    to the legacy ``DAT_CDC_FIRST_KERNEL`` knob, and alias ``fused`` to
+    ``bitmask`` off-Pallas (the fused kernel has no XLA formulation).
+    Both the dispatch path and the bench artifact label use this, so the
+    recorded route is always the route that actually ran."""
+    import os
+
+    route = os.environ.get("DAT_CDC_ROUTE")
+    if route not in ("bitmask", "first", "fused"):
+        route = ("first" if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
+                 else "bitmask")
+    if route == "fused" and not use_pallas:
+        route = "bitmask"
+    return route
+
+
 def _start_d2h(arrays) -> None:
     """Start D2H transfers for the extraction outputs now, concurrently:
     by collect() time they are local (or in flight under the next slab's
@@ -483,12 +501,7 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
         # fast path: windowed first-candidate extraction + occ/offsets
         # transfer (kernel route per _extract_first_occ; the env knobs
         # are for on-device measurement comparison / bench calibration)
-        import os
-
-        route = os.environ.get("DAT_CDC_ROUTE")
-        if route not in ("bitmask", "first", "fused"):
-            route = ("first" if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
-                     else "bitmask")
+        route = effective_route(use_pallas)
         with span("cdc.dispatch"):
             first = _extract_first_occ(
                 words, pre, T, stride, avg_bits, cap0, use_pallas,
@@ -639,14 +652,17 @@ def chunk_stream(
     min_size: int | None = None,
     max_size: int | None = None,
     tile_bytes: int = 1 << 17,
-    slab_tiles: int = 8192,
+    slab_tiles: int = 16384,
 ) -> list[int]:
     """Content-defined chunk end-offsets for a byte stream.
 
     ``data``: bytes or uint8 numpy array.  Processes ``slab_tiles`` tiles
     of ``tile_bytes`` per device dispatch (bounded memory regardless of
-    blob size).  Host-resident data pays one H2D transfer per slab; for
-    data already on device use :func:`candidates_words` +
+    blob size).  The default slab is 2 GiB — the per-call cap: the
+    round-4 phase attribution measured ~63 ms of fixed per-dispatch cost
+    against ~5 ms/GiB marginal, so fewer, larger slabs win until the
+    cap.  Host-resident data pays one H2D transfer per slab; for data
+    already on device use :func:`candidates_words` +
     :func:`_greedy_select` directly (the bench's 10 GiB config does).
     """
     if min_size is None:

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .rabin import GROUP, NO_HIT, PACK, _gear_step
+from .rabin import GROUP, NO_HIT, PACK, _gear_step, _popcount32
 from .u64 import U32
 
 _SUBLANE = 8
@@ -258,7 +258,7 @@ def _kernel_wfirst(wref, oref, sth_ref, stl_ref, fidx_ref, fval_ref, *,
         outs = []
         for k in range(ilp):
             lsb = fval[k] & (U32(0) - fval[k])
-            bitpos = _popcount32_u(lsb - U32(1))
+            bitpos = _popcount32(lsb - U32(1))
             outs.append(jnp.where(
                 fidx[k] != sent,
                 fidx[k] * U32(PACK) + bitpos,
@@ -271,15 +271,6 @@ def _kernel_wfirst(wref, oref, sth_ref, stl_ref, fidx_ref, fval_ref, *,
     def _keep():
         fidx_ref[0] = jnp.concatenate(fidx, axis=-1)
         fval_ref[0] = jnp.concatenate(fval, axis=-1)
-
-
-def _popcount32_u(x):
-    """SWAR popcount on uint32 lanes (kernel-local copy: pallas kernels
-    may not capture module-level jnp closures from rabin)."""
-    x = x - ((x >> U32(1)) & U32(0x55555555))
-    x = (x & U32(0x33333333)) + ((x >> U32(2)) & U32(0x33333333))
-    x = (x + (x >> U32(4))) & U32(0x0F0F0F0F)
-    return (x * U32(0x01010101)) >> U32(24)
 
 
 @functools.partial(

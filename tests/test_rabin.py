@@ -272,13 +272,18 @@ def test_parallel_gear_scan_matches_serial(monkeypatch):
 
 
 def test_first_occ_kernel_routes_identical(monkeypatch):
-    """Both _extract_first_occ kernel routes (bitmask+window-reduce vs
-    first-hit kernel) must produce identical occ/offs — and the cuts
-    must match the host reference either way."""
+    """All _extract_first_occ kernel routes must produce identical
+    occ/offs — and the cuts must match the host reference either way.
+    (Off-TPU, "fused" aliases the bitmask route by design; the fused
+    kernel itself is pinned to the bitmask reduction by the interpret
+    test above.)"""
     import numpy as np
 
     from dat_replication_protocol_tpu.ops import rabin
 
+    # a stray route knob from a bench session must not make this test
+    # vacuous: DAT_CDC_ROUTE takes precedence over DAT_CDC_FIRST_KERNEL
+    monkeypatch.delenv("DAT_CDC_ROUTE", raising=False)
     data = _data(6 * 4096 + 321, seed=13)
     buf = np.frombuffer(data, dtype=np.uint8)
     ref = rabin.host_thin(rabin.host_candidates(data, 8), 8)
@@ -286,3 +291,14 @@ def test_first_occ_kernel_routes_identical(monkeypatch):
         monkeypatch.setenv("DAT_CDC_FIRST_KERNEL", env)
         got = rabin._device_candidates(buf, 8, 1 << 12, 4, thin_bits=8)
         assert got.tolist() == ref, f"first_kernel={env}"
+    monkeypatch.delenv("DAT_CDC_FIRST_KERNEL")
+    for route in ("bitmask", "first", "fused"):
+        monkeypatch.setenv("DAT_CDC_ROUTE", route)
+        assert rabin.effective_route(use_pallas=False) == (
+            "bitmask" if route == "fused" else route
+        )
+        got = rabin._device_candidates(buf, 8, 1 << 12, 4, thin_bits=8)
+        assert got.tolist() == ref, f"route={route}"
+    # invalid values resolve to the default, not a crash or a lie
+    monkeypatch.setenv("DAT_CDC_ROUTE", "Fused")
+    assert rabin.effective_route() == "bitmask"
