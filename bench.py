@@ -803,10 +803,13 @@ def bench_cdc(quick: bool, backend: str) -> dict:
             or "DAT_CDC_FIRST_KERNEL" in os.environ):
         cal = {}
         golden_cuts = None
-        # "fused" is pallas-only; off-TPU it silently aliases bitmask —
-        # timing it there would duplicate a leg and could mislabel
-        # extract_route in the artifact
-        routes = ("bitmask", "first", "fused") if on_tpu else ("bitmask", "first")
+        # "fused" is pallas-only; off-Pallas it silently aliases bitmask
+        # — timing it there would duplicate a leg and could mislabel
+        # extract_route in the artifact.  rabin.pallas_active is the one
+        # owner of that decision (the probe's platform string and jax's
+        # backend name can differ on the tunneled platform)
+        routes = (("bitmask", "first", "fused") if rabin.pallas_active()
+                  else ("bitmask", "first"))
         for route in routes:
             os.environ["DAT_CDC_ROUTE"] = route
             try:
@@ -882,7 +885,7 @@ def bench_cdc(quick: bool, backend: str) -> dict:
         "volume_gib": round(total / (1 << 30), 2),
         "kernel_only_gib_s": round(kernel_gib_s, 3),
         "fence": _fence_mode(),
-        "extract_route": rabin.effective_route(use_pallas=on_tpu),
+        "extract_route": rabin.effective_route(),
         "chunks_per_slab": nchunks,
     }
 

@@ -405,19 +405,30 @@ def _clamp_thin_bits(thin_bits: int | None, stride: int) -> int | None:
     return thin_bits if thin_bits >= 5 else None
 
 
-def effective_route(use_pallas: bool = True) -> str:
+def pallas_active() -> bool:
+    """The ONE owner of the "do Pallas kernels run here" decision —
+    candidates_begin's route dispatch, effective_route's fused->bitmask
+    aliasing, and the bench's calibration/label all consult this, so
+    they can never disagree about which kernel actually executes."""
+    return jax.default_backend() == "tpu"
+
+
+def effective_route(use_pallas: bool | None = None) -> str:
     """The ONE owner of extraction-route resolution: consult
     ``DAT_CDC_ROUTE`` (values ``bitmask``/``first``/``fused``), fall back
     to the legacy ``DAT_CDC_FIRST_KERNEL`` knob, and alias ``fused`` to
     ``bitmask`` off-Pallas (the fused kernel has no XLA formulation).
     Both the dispatch path and the bench artifact label use this, so the
-    recorded route is always the route that actually ran."""
+    recorded route is always the route that actually ran.
+    ``use_pallas=None`` consults :func:`pallas_active`."""
     import os
 
     route = os.environ.get("DAT_CDC_ROUTE")
     if route not in ("bitmask", "first", "fused"):
         route = ("first" if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
                  else "bitmask")
+    if use_pallas is None:
+        use_pallas = pallas_active()
     if route == "fused" and not use_pallas:
         route = "bitmask"
     return route
@@ -490,7 +501,7 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
 
     thin_bits = _clamp_thin_bits(thin_bits, stride)
 
-    use_pallas = jax.default_backend() == "tpu"
+    use_pallas = pallas_active()
     # expected candidates ~= nbytes / 2**avg_bits (sparse).  4x margin,
     # then grow geometrically on the (rare) overflow.
     cap0 = max(256, (T * stride) >> max(avg_bits - 2, 0))

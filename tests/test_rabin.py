@@ -201,6 +201,12 @@ def test_fused_window_first_interpret_matches_bitmask_route():
     )
     assert np.array_equal(ref, fused)
     assert (fused < (1 << 30)).any(), "no candidates at all — weak fixture"
+    # multi-chunk ILP interleave (the bench shape runs ilp=8): the
+    # fidx/fval chunk slicing + concat order must not permute lanes
+    fused_ilp = np.asarray(gear_window_first_pallas(
+        rows, 8, thin_bits, block_tiles=2048, ilp=2, interpret=True
+    ))
+    assert np.array_equal(ref, fused_ilp)
 
 
 def test_first_hit_pallas_interpret_matches_tiled():
