@@ -95,6 +95,17 @@ def _timed_reps_pipelined(dispatch, fence, reps: int, depth: int = 2):
     return dts
 
 
+def _peak_span(dts: list) -> float:
+    """Fastest CREDIBLE span for the diagnostic peak fields: under
+    pipelined fencing, a stall in span k lets rep k+1 finish on device
+    early, so span k+1 collapses toward the bare fence RTT — faster
+    than the hardware ever ran.  Spans under half the median are those
+    queue-drain artifacts, not capability; exclude them."""
+    med = statistics.median(dts)
+    cred = [d for d in dts if d >= 0.5 * med]
+    return min(cred) if cred else med
+
+
 def _fence_mode() -> str:
     """Recorded in every device-config result: pipelined vs serial fence
     numbers differ ~1.7x on the tunneled link, so cross-round artifact
@@ -689,6 +700,12 @@ def bench_hash(quick: bool, backend: str) -> dict:
         "unit": "GiB/s",
         "vs_baseline": round(gib_s / 50.0, 4),
         "aggregate_gib_s": round(total / dt / (1 << 30), 3),
+        # best credible rep: on the shared dev chip this approximates
+        # the uncontended rate (diagnostic only; the median stays the
+        # headline; see _peak_span for the queue-drain guard)
+        "peak_gib_s": round(
+            (chunk * item_bytes) / _peak_span(rep_dts) / (1 << 30), 3
+        ),
         "fence": _fence_mode(),
         "kernel_variant": variant,
         "e2e_host_gib_s": round(e2e_gib_s, 3),
@@ -705,6 +722,10 @@ def bench_hash(quick: bool, backend: str) -> dict:
         out["vs_baseline"] = round(host_gib_s / 50.0, 4)
         out["kernel_variant"] = "native-host"
         out["xla_scan_gib_s"] = round(gib_s, 3)
+        # the peak was measured on the scan path, not the routed host
+        # engine — rename it alongside the scan median so peak < value
+        # can't read as nonsense
+        out["xla_scan_peak_gib_s"] = out.pop("peak_gib_s")
         out.update(host_fields)  # the host run's own volume/provenance
     return out
 
@@ -884,6 +905,7 @@ def bench_cdc(quick: bool, backend: str) -> dict:
         "vs_baseline": None,
         "volume_gib": round(total / (1 << 30), 2),
         "kernel_only_gib_s": round(kernel_gib_s, 3),
+        "kernel_peak_gib_s": round(rows.nbytes / _peak_span(kdts) / (1 << 30), 3),
         "fence": _fence_mode(),
         "extract_route": rabin.effective_route(),
         "chunks_per_slab": nchunks,
@@ -1000,6 +1022,7 @@ def bench_merkle(quick: bool, backend: str) -> dict:
         "unit": "entries/s",
         "vs_baseline": round(rate / 10e6, 4),
         "aggregate_entries_s": round(reps * n / dt, 0),
+        "peak_entries_s": round(n / _peak_span(rep_dts), 0),
         "fence": _fence_mode(),
         "leaves": n,
         "local_diff_entries_s": round(local_rate, 0) if local_rate else None,
