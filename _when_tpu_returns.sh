@@ -10,7 +10,8 @@ set -x
 # 0) insurance first: a minimal quick TPU capture (~3 min) so even a
 #    window that dies mid-run leaves a backend=tpu artifact
 BENCH_CONFIGS=3 BENCH_DEADLINE=400 timeout 420 python bench.py --quick 2>&1 | tail -3
-# 1) the full five-config capture (compile cache is warm for every
-#    shape from the earlier window, so this should fit well inside the
-#    default deadline)
-timeout 2400 python bench.py 2>&1 | grep -v WARNING | tail -6
+# 1) the full five-config capture.  Extended deadline: the CDC leg now
+#    calibrates three extraction routes at the 2 GiB shape and the fused
+#    route's compiles are cold (everything else is warm from the earlier
+#    window)
+BENCH_DEADLINE=2200 timeout 2400 python bench.py 2>&1 | grep -v WARNING | tail -6
