@@ -23,8 +23,17 @@ from dat_replication_protocol_tpu.ops.rabin_pallas import (
     gear_candidates_pallas,
 )
 from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
+from dat_replication_protocol_tpu.utils.chiplock import chip_lock
 
 enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
+
+# diagnostics must never share the chip with a bench capture (round-4
+# lesson); held for the process lifetime, released by the kernel on exit
+_lock_cm = chip_lock()  # keep the CM alive: a bare __enter__() on a
+# temporary would be GC'd, running the generator's finally and RELEASING
+# the flock immediately (caught in round-5 review)
+_lease = _lock_cm.__enter__()
+print(f"chip lock: uncontended={_lease.uncontended}", flush=True)
 
 slab_b = 1 << 30
 stride = 1 << 17

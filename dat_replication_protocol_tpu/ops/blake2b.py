@@ -136,12 +136,15 @@ def _g_stage4(v, quads, ms):
     """
     regs = [[v[a], v[b], v[c], v[d]] for (a, b, c, d) in quads]
 
-    def stage_add3(idx, operand):
+    def stage_add3(operand):
+        # a = a + b + m: destination lane 0, addend lane 1 — both fixed
+        # by the G function's shape (advisor r4: a parameterized dst
+        # with a hardcoded addend invited miscalls)
         for k in range(4):
-            (ah, al) = regs[k][idx]
+            (ah, al) = regs[k][0]
             (bh, bl) = regs[k][1]
             (xh, xl) = operand[k]
-            regs[k][idx] = add64_3(ah, al, bh, bl, xh, xl)
+            regs[k][0] = add64_3(ah, al, bh, bl, xh, xl)
 
     def stage_xor_ror(dst, src, r):
         for k in range(4):
@@ -157,11 +160,11 @@ def _g_stage4(v, quads, ms):
 
     xs = [p[0] for p in ms]
     ys = [p[1] for p in ms]
-    stage_add3(0, xs)
+    stage_add3(xs)
     stage_xor_ror(3, 0, 32)
     stage_add(2, 3)
     stage_xor_ror(1, 2, 24)
-    stage_add3(0, ys)
+    stage_add3(ys)
     stage_xor_ror(3, 0, 16)
     stage_add(2, 3)
     stage_xor_ror(1, 2, 63)

@@ -663,16 +663,21 @@ def chunk_stream(
     min_size: int | None = None,
     max_size: int | None = None,
     tile_bytes: int = 1 << 17,
-    slab_tiles: int = 16384,
+    slab_tiles: int = 8192,
 ) -> list[int]:
     """Content-defined chunk end-offsets for a byte stream.
 
     ``data``: bytes or uint8 numpy array.  Processes ``slab_tiles`` tiles
     of ``tile_bytes`` per device dispatch (bounded memory regardless of
-    blob size).  The default slab is 2 GiB — the per-call cap: the
-    round-4 phase attribution measured ~63 ms of fixed per-dispatch cost
-    against ~5 ms/GiB marginal, so fewer, larger slabs win until the
-    cap.  Host-resident data pays one H2D transfer per slab; for data
+    blob size).  The library default slab is 1 GiB: with depth-2
+    pipelining TWO slabs are in flight, each holding the input words
+    plus the ``_build_rows`` copy (and the bitmask route's mask), so
+    HBM high-water is roughly 4x the slab size — 1 GiB slabs fit any
+    current backend.  Callers on a >= 16 GiB-HBM device (the bench's
+    10 GiB config) should pass ``slab_tiles=16384`` (2 GiB): round-4
+    phase attribution measured ~63 ms fixed per-dispatch cost against
+    ~5 ms/GiB marginal, so fewer, larger slabs win until memory does.
+    Host-resident data pays one H2D transfer per slab; for data
     already on device use :func:`candidates_words` +
     :func:`_greedy_select` directly (the bench's 10 GiB config does).
     """

@@ -82,3 +82,103 @@ def test_merge_fallback_fills_only_missing_or_failed():
     assert configs["cdc"]["backend"] == "cpu-fallback"
     assert configs["merkle_diff"]["backend"] == "cpu-fallback"
     assert "broken" not in configs
+
+
+# ---------------------------------------------------------------------------
+# _timed_reps_pipelined: the round-4 perf re-pricing (1.7x on hash) rides
+# on this helper fencing every rep exactly once, in order, with bounded
+# in-flight depth (round-4 verdict item 8: trusted, never tested)
+# ---------------------------------------------------------------------------
+
+
+class _Tracker:
+    """Scripted dispatch/fence pair recording order and in-flight depth."""
+
+    def __init__(self):
+        self.next_id = 0
+        self.outstanding = []       # dispatched, not yet fenced
+        self.fenced = []            # fence order
+        self.dispatch_order = []
+        self.high_water = 0
+
+    def dispatch(self):
+        tok = self.next_id
+        self.next_id += 1
+        self.outstanding.append(tok)
+        self.dispatch_order.append(tok)
+        self.high_water = max(self.high_water, len(self.outstanding))
+        return tok
+
+    def fence(self, tok):
+        assert tok in self.outstanding, f"fenced {tok} twice or never dispatched"
+        self.outstanding.remove(tok)
+        self.fenced.append(tok)
+
+
+def test_pipelined_fences_every_rep_once_in_order():
+    for reps in (1, 2, 3, 7):
+        tr = _Tracker()
+        dts = bench._timed_reps_pipelined(tr.dispatch, tr.fence, reps, depth=2)
+        assert len(dts) == reps
+        # every dispatch fenced exactly once, nothing left in flight
+        assert tr.outstanding == []
+        assert sorted(tr.fenced) == tr.dispatch_order[: len(tr.fenced)]
+        # fences happen in dispatch order (no reorder, no drop)
+        assert tr.fenced == sorted(tr.fenced)
+        # primer + reps dispatches total
+        assert tr.next_id == reps + 1
+
+
+def test_pipelined_depth_bounds_inflight():
+    for depth in (1, 2, 3):
+        tr = _Tracker()
+        bench._timed_reps_pipelined(tr.dispatch, tr.fence, 8, depth=depth)
+        # primer counts toward in-flight until its fence; after it the
+        # window holds at most `depth` unfenced reps
+        assert tr.high_water <= depth + 1
+        assert tr.fenced == list(range(9))
+
+
+def test_pipelined_depth1_degrades_to_serial_alternation():
+    events = []
+
+    def dispatch():
+        events.append("d")
+        return len(events)
+
+    def fence(tok):
+        events.append("f")
+
+    bench._timed_reps_pipelined(dispatch, fence, 4, depth=1)
+    # primer d, first rep d, primer f, then strict f/d alternation with
+    # never more than one rep awaiting its fence
+    pend = 0
+    for e in events:
+        pend += 1 if e == "d" else -1
+        assert 0 <= pend <= 2
+    assert pend == 0
+
+
+def test_serial_fence_env_restores_strict_alternation(monkeypatch):
+    monkeypatch.setenv("BENCH_SERIAL_FENCE", "1")
+    events = []
+
+    def dispatch():
+        events.append("d")
+        return len(events)
+
+    def fence(tok):
+        events.append("f")
+
+    dts = bench._timed_reps_pipelined(dispatch, fence, 3)
+    assert len(dts) == 3
+    assert events == ["d", "f"] * 3  # no primer, no overlap
+
+
+def test_peak_span_guards_drain_and_post_stall():
+    # queue-drain span (0.05 << half median) excluded; the 0.9 span right
+    # after the 2.0 stall is drain-compressed (advisor r4) - excluded too
+    dts = [1.0, 1.0, 2.0, 0.9, 0.05, 0.95]
+    assert bench._peak_span(dts) == 0.95
+    # no credible spans at all -> fall back to the median
+    assert bench._peak_span([1.0]) == 1.0

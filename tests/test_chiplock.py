@@ -1,0 +1,107 @@
+"""Chip-mutex tests (round-4 verdict weak #1: a concurrent diagnostic
+contaminated the round's only driver-shaped capture; the flock is the
+fix and must actually exclude across processes)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from dat_replication_protocol_tpu.utils import chiplock
+
+
+def test_uncontended_acquire(tmp_path, monkeypatch):
+    monkeypatch.setenv("DAT_CHIP_LOCK", str(tmp_path / "chip.lock"))
+    with chiplock.chip_lock(max_wait=1.0) as lease:
+        assert lease.held and lease.uncontended
+        assert lease.as_fields()["uncontended"] is True
+        assert lease.as_fields()["chip_lock"]["held"] is True
+
+
+def test_reentrant_same_path_excludes_across_processes(tmp_path, monkeypatch):
+    lock = str(tmp_path / "chip.lock")
+    monkeypatch.setenv("DAT_CHIP_LOCK", lock)
+    # a child process holds the lock for ~1.2 s; the parent must observe
+    # contention, then win once the child exits
+    child = subprocess.Popen(
+        [sys.executable, "-c", (
+            "import os, sys, time;"
+            "sys.path.insert(0, os.getcwd());"
+            "os.environ['DAT_CHIP_LOCK'] = sys.argv[1];"
+            "from dat_replication_protocol_tpu.utils.chiplock import chip_lock\n"
+            "with chip_lock(max_wait=0.1) as l:\n"
+            "    assert l.held\n"
+            "    print('HELD', flush=True)\n"
+            "    time.sleep(1.2)\n"
+        ), lock],
+        stdout=subprocess.PIPE, text=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert child.stdout.readline().strip() == "HELD"
+    t0 = time.monotonic()
+    with chiplock.chip_lock(max_wait=10.0, poll_s=0.1) as lease:
+        waited = time.monotonic() - t0
+        assert lease.held
+        assert not lease.uncontended  # had to wait for the child
+        assert lease.waited_s > 0
+        assert 0.5 < waited < 8.0
+    child.wait(timeout=5)
+
+
+def test_timeout_runs_lockless_but_says_so(tmp_path, monkeypatch):
+    lock = str(tmp_path / "chip.lock")
+    monkeypatch.setenv("DAT_CHIP_LOCK", lock)
+    child = subprocess.Popen(
+        [sys.executable, "-c", (
+            "import os, sys, time;"
+            "sys.path.insert(0, os.getcwd());"
+            "os.environ['DAT_CHIP_LOCK'] = sys.argv[1];"
+            "from dat_replication_protocol_tpu.utils.chiplock import chip_lock\n"
+            "with chip_lock() as l:\n"
+            "    print('HELD', flush=True)\n"
+            "    time.sleep(3.0)\n"
+        ), lock],
+        stdout=subprocess.PIPE, text=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert child.stdout.readline().strip() == "HELD"
+    with chiplock.chip_lock(max_wait=0.3, poll_s=0.05) as lease:
+        # peer never releases within the budget: run anyway, record it
+        assert not lease.held
+        fields = lease.as_fields()
+        assert fields["uncontended"] is False
+        assert fields["chip_lock"]["held"] is False
+    child.kill()
+    child.wait(timeout=5)
+
+
+def test_crashed_holder_releases_lock(tmp_path, monkeypatch):
+    """flock dies with the process: a crashed diagnostic can never wedge
+    the chip lock (the reason flock was chosen over pid files)."""
+    lock = str(tmp_path / "chip.lock")
+    monkeypatch.setenv("DAT_CHIP_LOCK", lock)
+    child = subprocess.Popen(
+        [sys.executable, "-c", (
+            "import os, sys;"
+            "sys.path.insert(0, os.getcwd());"
+            "os.environ['DAT_CHIP_LOCK'] = sys.argv[1];"
+            "from dat_replication_protocol_tpu.utils.chiplock import chip_lock\n"
+            "ctx = chip_lock()\n"
+            "ctx.__enter__()\n"
+            "print('HELD', flush=True)\n"
+            "os._exit(9)\n"  # simulated crash: no __exit__, no unlock
+        ), lock],
+        stdout=subprocess.PIPE, text=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert child.stdout.readline().strip() == "HELD"
+    child.wait(timeout=5)
+    with chiplock.chip_lock(max_wait=2.0, poll_s=0.05) as lease:
+        assert lease.held  # kernel released the dead holder's flock
+
+
+def test_lease_fields_json_serializable(tmp_path, monkeypatch):
+    monkeypatch.setenv("DAT_CHIP_LOCK", str(tmp_path / "chip.lock"))
+    with chiplock.chip_lock(max_wait=0.5) as lease:
+        json.dumps(lease.as_fields())
