@@ -25,6 +25,7 @@ counter instead of Node Writable plumbing:
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from typing import Callable, Optional
 
@@ -125,6 +126,40 @@ class BlobReader:
             cb()
 
 
+class _FastAck:
+    """One-shot ``done`` for the bulk fast path, cheaper than an ``_up``
+    closure: the pending counter is only touched if the handler did NOT
+    ack synchronously (the overwhelmingly common case never pays the
+    increment/decrement/resume round-trip).
+
+    States: 0 fresh -> 1 acked-before-arming (sync; no pending ever
+    taken) / 2 armed (handler kept it async; pending incremented by the
+    dispatch loop) -> 3 done (armed ack fired; pending released).  All
+    transitions run under the decoder's ``_ack_lock`` so an ack landing
+    from another thread between the handler returning and the loop
+    arming can neither be lost nor double-counted.
+    """
+
+    __slots__ = ("dec", "state")
+
+    def __init__(self, dec: "Decoder") -> None:
+        self.dec = dec
+        self.state = 0
+
+    def __call__(self) -> None:
+        dec = self.dec
+        with dec._ack_lock:
+            st = self.state
+            if st == 0:
+                self.state = 1  # sync ack: loop sees it, never arms
+                return
+            if st != 2:
+                return  # double ack: no-op (same contract as _up)
+            self.state = 3
+            dec._pending -= 1
+        dec._resume()
+
+
 def _drain_blob(blob: BlobReader, done: Callable[[], None]) -> None:
     """Default blob handler: consume and discard (reference: decode.js:58-61).
 
@@ -168,6 +203,8 @@ class Decoder:
         self._end_queued = False
         self._end_cb: OnDone = None
         self._consuming = False  # reentrancy guard for _consume
+        # serializes _FastAck state transitions against cross-thread acks
+        self._ack_lock = threading.Lock()
 
     # -- handler registration (same shape as the reference API) -------------
 
@@ -517,7 +554,11 @@ class Decoder:
 
         Each frame goes through the same change/blob machinery as the
         streaming path (counters, ordering, blob latches, zero-length
-        blobs — shared, not duplicated).
+        blobs — shared, not duplicated).  Runs of consecutive change
+        frames take :meth:`_dispatch_changes_fast` when the columnar
+        pre-decode is available and ``_deliver_change`` is not
+        subclassed — same observable contract, ~3x less per-frame
+        interpreter work (the config-1 decode rate rides this loop).
         """
         st = self._bulk
         assert st is not None
@@ -526,11 +567,19 @@ class Decoder:
         cols = st["cols"]
         f = st["f"]
         n = st["n"]
+        fast = (cols is not None
+                and type(self)._deliver_change is Decoder._deliver_change)
         while f < n:
             if self._stalled() or self.destroyed:
                 st["f"] = f
                 return
             type_id = ids[f]
+            if fast and type_id == TYPE_CHANGE:
+                f = self._dispatch_changes_fast(st, f)
+                if self.destroyed:
+                    self._bulk = None
+                    return
+                continue
             start = starts[f]
             flen = lens[f]
             self._missing = flen
@@ -597,6 +646,80 @@ class Decoder:
         tail = buf[st["consumed"]:]
         if len(tail):
             self._ov_appendleft(tail)
+
+    def _dispatch_changes_fast(self, st: dict, f: int) -> int:
+        """Deliver the run of consecutive change frames starting at ``f``.
+
+        The hot loop of config-1 bulk decode.  Per frame: one slot-built
+        :class:`Change` from the pre-decoded columns, one
+        :class:`_FastAck`, one handler call — no ``_up`` closure, no
+        pending-counter churn unless the handler actually defers its
+        ack, no per-frame parser-state writes (the whole run happens at
+        a frame boundary, so ``_state`` stays ``TYPE_HEADER``
+        throughout).  Slices come from a one-time ``bytes`` copy of the
+        indexed buffer: bytes slicing + decoding is ~2x cheaper than
+        going through memoryview objects.
+
+        Returns the index of the first undispatched frame (a non-change
+        frame, a stall, or ``n``).  Counters and cursor semantics are
+        identical to the general loop; ``self.changes`` is incremented
+        before each handler call exactly as ``_deliver_change`` does.
+        """
+        bbuf = st.get("bbuf")
+        if bbuf is None:
+            bbuf = st["bbuf"] = bytes(st["buf"])
+        rows = st.get("zrows")
+        if rows is None:
+            # one tuple per change row: a single list index + unpack in
+            # the loop instead of nine list indexes (~250ns/frame less)
+            rows = st["zrows"] = list(zip(*st["cols"]))
+        ids = st["ids"]
+        n = st["n"]
+        row = st["row"]
+        on_change = self._on_change
+        lock = self._ack_lock
+        mk = Change.__new__
+        mka = _FastAck.__new__
+        Ch = Change
+        FA = _FastAck
+        TC = TYPE_CHANGE
+        try:
+            while f < n and ids[f] == TC:
+                (cg, fr, to, ko, kl, so, sl, vo, vl) = rows[row]
+                try:
+                    c = mk(Ch)
+                    c.key = bbuf[ko : ko + kl].decode("utf-8")
+                    c.change = cg
+                    c.from_ = fr
+                    c.to = to
+                    c.value = bbuf[vo : vo + vl] if vl >= 0 else b""
+                    c.subset = (bbuf[so : so + sl].decode("utf-8")
+                                if sl >= 0 else "")
+                except ValueError as e:  # incl. UnicodeDecodeError
+                    self.destroy(ProtocolError(str(e)))
+                    return f
+                row += 1
+                f += 1
+                self.changes += 1
+                if on_change is not None:
+                    ack = mka(FA)
+                    ack.dec = self
+                    ack.state = 0
+                    on_change(c, ack)
+                    if ack.state != 1:
+                        with lock:
+                            if ack.state == 0:
+                                ack.state = 2  # armed: handler went async
+                                self._pending += 1
+                    # default: drop (reference: decode.js:54-56)
+                if self.destroyed or self._pending > 0 \
+                        or self._paused_readers > 0:
+                    return f
+        finally:
+            st["row"] = row
+            self._missing = 0
+            self._state = TYPE_HEADER
+        return f
 
     def _consume_chunk(self, chunk: memoryview) -> memoryview | None:
         if self._state == TYPE_HEADER:

@@ -264,3 +264,64 @@ def test_fuzz_hostile_bytes_never_hang():
             raise AssertionError(f"trial {trial}: decoder raised {e!r}")
         if dec.destroyed:
             assert errs, trial
+
+
+def test_double_ack_on_bulk_path_is_noop():
+    """The fast-path done is one-shot: a second (or third) call must not
+    double-decrement pending or corrupt later frames' flow control."""
+    wire = _wire(n=120, blob_every=1 << 30)
+    dec = protocol.decode()
+    seen, dones = [], []
+    dec.change(lambda ch, done: (seen.append(ch.key), dones.append(done),
+                                 done(), done()))  # sync ack, twice
+    dec.write(wire)
+    dec.end()
+    assert dec.finished
+    assert seen == [f"key-{i}" for i in range(120)]
+    for d in dones:  # and a long-stale third call after finish
+        d()
+    assert dec.finished and not dec.destroyed
+
+
+def test_cross_thread_ack_race_never_loses_or_doublecounts():
+    """Hammer the handler-returns vs done()-from-another-thread window:
+    every change is acked from a worker thread immediately; the session
+    must always complete with every key delivered exactly once."""
+    import threading
+
+    wire = _wire(n=200, blob_every=1 << 30)
+    for _ in range(20):
+        dec = protocol.decode()
+        seen = []
+        threads = []
+
+        def on_change(ch, done):
+            seen.append(ch.key)
+            t = threading.Thread(target=done)
+            t.start()
+            threads.append(t)
+
+        dec.change(on_change)
+        done_box = []
+        dec.write(wire)
+        dec.end(lambda: done_box.append(1))
+        for t in threads:
+            t.join(timeout=5)
+        deadline = 100
+        while not dec.finished and deadline:
+            deadline -= 1
+            import time
+            time.sleep(0.01)
+        assert dec.finished, "session never finished: an ack was lost"
+        assert seen == [f"key-{i}" for i in range(200)]
+        assert done_box == [1]
+
+
+def test_changes_counter_increments_before_each_callback():
+    wire = _wire(n=50, blob_every=1 << 30)
+    dec = protocol.decode()
+    observed = []
+    dec.change(lambda ch, done: (observed.append(dec.changes), done()))
+    dec.write(wire)
+    dec.end()
+    assert observed == list(range(1, 51))
