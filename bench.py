@@ -1059,14 +1059,22 @@ def bench_merkle(quick: bool, backend: str) -> dict:
     # hashing, key-addressed sketches, and the cell-level tree diff
     from dat_replication_protocol_tpu.ops import reconcile
 
-    rrows = _env_int("BENCH_RECONCILE_ROWS", 2_000 if quick else 100_000)
+    # full config-5 snapshot scale by default (round-4 verdict #4: 1M+1M;
+    # 1.85M records/s at 200k said nothing about slot-table pressure or
+    # bucketing at the scale the config names)
+    rrows = _env_int("BENCH_RECONCILE_ROWS", 2_000 if quick else 1_000_000)
     keys_a = [b"row-%07d" % i for i in range(rrows)]
     recs_a = [b"value-of:" + k for k in keys_a]
     keys_b = list(keys_a)
     recs_b = list(recs_a)
     rng = np.random.default_rng(5)
-    for j in range(max(1, rrows // 1000)):
-        p = int(rng.integers(0, len(keys_b)))
+    # positions drawn once against the ORIGINAL length (stable spread of
+    # inserts across the log; the insert loop itself is O(k·n) memmove —
+    # ~0.7 s at 1M, measured, and untimed setup either way)
+    pos = sorted((int(p) for p in rng.integers(0, rrows,
+                                               max(1, rrows // 1000))),
+                 reverse=True)
+    for j, p in enumerate(pos):
         keys_b.insert(p, b"new-%d" % j)
         recs_b.insert(p, b"value-of-new-%d" % j)
     log2_slots = max(8, (rrows * 2).bit_length())

