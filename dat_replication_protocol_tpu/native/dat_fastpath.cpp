@@ -1,0 +1,437 @@
+// CPython extension: the decoder's change-run dispatch loop in C.
+//
+// The ctypes library (dat_native.cpp) already indexes frames and
+// pre-decodes change columns in bulk; what remains per frame on the
+// Python side is object construction, ack bookkeeping, and the handler
+// call — ~2 us/frame of interpreter work against a ~0.35 us handler
+// body.  This module moves everything except the handler call itself
+// into C:
+//
+// * FastAck: a C callable with a lock-free state machine
+//   (std::atomic CAS) replacing the Python _FastAck + lock — the
+//   handler-returned vs done()-from-another-thread race is settled by
+//   a single compare_exchange, with no lock on any path.
+// * AckBoard: one atomic outstanding-ack counter per decoder; armed
+//   acks increment it, releases decrement, and the release that hits
+//   zero calls dec._resume().  Decoder._stalled() consults it.
+// * dispatch_changes(): the per-frame loop — slot-built Change
+//   objects straight from the columnar numpy buffers (no tolist, no
+//   zip, no row tuples), handler vectorcall, ack arming, stall checks.
+//
+// Built on demand by runtime/fastpath.py (g++, no pybind11 — plain
+// CPython C API); everything degrades to the pure-Python loop in
+// session/decoder.py when unavailable.
+//
+// reference: decode.js:144-169 is the loop this accelerates; the
+// observable contract (ordering, counters, backpressure, destroy) is
+// pinned by tests/test_decoder_bulk.py and the conformance suite.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <atomic>
+#include <cstdint>
+
+namespace {
+
+// ack states
+enum { FRESH = 0, SYNC_ACKED = 1, ARMED = 2 };
+
+PyObject *s_pending, *s_paused, *s_destroyed, *s_changes, *s_resume;
+PyObject *s_key, *s_change, *s_from, *s_to, *s_value, *s_subset;
+PyObject *empty_bytes, *empty_str, *empty_tuple;
+
+// ---------------------------------------------------------------------------
+// AckBoard
+// ---------------------------------------------------------------------------
+
+typedef struct {
+    PyObject_HEAD
+    std::atomic<long> outstanding;
+} AckBoard;
+
+static PyObject *ackboard_new(PyTypeObject *type, PyObject *, PyObject *) {
+    AckBoard *self = (AckBoard *)type->tp_alloc(type, 0);
+    if (self != nullptr) self->outstanding.store(0);
+    return (PyObject *)self;
+}
+
+static PyObject *ackboard_get_outstanding(AckBoard *self, void *) {
+    return PyLong_FromLong(self->outstanding.load());
+}
+
+static PyGetSetDef ackboard_getset[] = {
+    {"outstanding", (getter)ackboard_get_outstanding, nullptr,
+     "armed (deferred) acks not yet released", nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+static PyTypeObject AckBoard_Type = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "dat_fastpath.AckBoard",            /* tp_name */
+    sizeof(AckBoard),                   /* tp_basicsize */
+};
+
+// ---------------------------------------------------------------------------
+// FastAck
+// ---------------------------------------------------------------------------
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *dec;    // strong ref; needed for _resume on release
+    PyObject *board;  // strong ref (AckBoard)
+    std::atomic<int> state;
+} FastAck;
+
+static void fastack_dealloc(FastAck *self) {
+    Py_XDECREF(self->dec);
+    Py_XDECREF(self->board);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *fastack_call(FastAck *self, PyObject *, PyObject *) {
+    // one-shot: exactly one exchange can observe ARMED, so the release
+    // runs at most once; double/late calls are no-ops (same contract
+    // as the decoder's _up closures)
+    int prev = self->state.exchange(SYNC_ACKED);
+    if (prev == ARMED) {
+        AckBoard *board = (AckBoard *)self->board;
+        long left = board->outstanding.fetch_sub(1) - 1;
+        if (left <= 0 && self->dec != nullptr) {
+            PyObject *r = PyObject_CallMethodNoArgs(self->dec, s_resume);
+            if (r == nullptr) return nullptr;  // propagate handler errors
+            Py_DECREF(r);
+        }
+    }
+    Py_RETURN_NONE;
+}
+
+static PyTypeObject FastAck_Type = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "dat_fastpath.FastAck",             /* tp_name */
+    sizeof(FastAck),                    /* tp_basicsize */
+};
+
+static FastAck *fastack_alloc(PyObject *dec, PyObject *board) {
+    FastAck *ack = (FastAck *)FastAck_Type.tp_alloc(&FastAck_Type, 0);
+    if (ack == nullptr) return nullptr;
+    Py_INCREF(dec);
+    ack->dec = dec;
+    Py_INCREF(board);
+    ack->board = board;
+    ack->state.store(FRESH);
+    return ack;
+}
+
+// ---------------------------------------------------------------------------
+// dispatch_changes
+// ---------------------------------------------------------------------------
+
+struct View {
+    Py_buffer buf{};
+    bool held = false;
+    int acquire(PyObject *obj) {
+        if (PyObject_GetBuffer(obj, &buf, PyBUF_SIMPLE) < 0) return -1;
+        held = true;
+        return 0;
+    }
+    ~View() {
+        if (held) PyBuffer_Release(&buf);
+    }
+};
+
+static long get_long_attr(PyObject *o, PyObject *name, int *err) {
+    PyObject *v = PyObject_GetAttr(o, name);
+    if (v == nullptr) {
+        *err = 1;
+        return 0;
+    }
+    long r = PyLong_AsLong(v);
+    Py_DECREF(v);
+    if (r == -1 && PyErr_Occurred()) *err = 1;
+    return r;
+}
+
+// dispatch_changes(dec, board, cb_or_None, change_cls, buf,
+//                  ids, chg, frm, tov, koff, klen, soff, slen, voff,
+//                  vlen, f, row, n, st)
+// -> (new_f, new_row, status)  status: 0 ran to a non-change frame or
+// n; 1 stalled (armed ack / destroy / pause / pending); 2 a change
+// payload failed UTF-8 decoding — the message is left in
+// st["decode_error"] and NO Python exception is set, so the caller
+// can destroy with ProtocolError without ever confusing a
+// handler-raised ValueError for a wire error (handler exceptions
+// propagate as real exceptions, same as the Python loop).
+// Progress is ALSO written into st["f"]/st["row"] before any error
+// return, so a raising handler cannot desync the cursor.
+static PyObject *dispatch_changes(PyObject *, PyObject *args) {
+    PyObject *dec, *board_o, *cb, *cls_o, *buf_o, *ids_o;
+    PyObject *chg_o, *frm_o, *tov_o, *koff_o, *klen_o, *soff_o, *slen_o,
+        *voff_o, *vlen_o, *st;
+    Py_ssize_t f, row, n;
+    if (!PyArg_ParseTuple(
+            args, "OOOOOOOOOOOOOOOnnnO", &dec, &board_o, &cb, &cls_o,
+            &buf_o, &ids_o, &chg_o, &frm_o, &tov_o, &koff_o, &klen_o,
+            &soff_o, &slen_o, &voff_o, &vlen_o, &f, &row, &n, &st))
+        return nullptr;
+    if (!PyObject_TypeCheck(board_o, &AckBoard_Type)) {
+        PyErr_SetString(PyExc_TypeError, "board must be an AckBoard");
+        return nullptr;
+    }
+    AckBoard *board = (AckBoard *)board_o;
+    PyTypeObject *cls = (PyTypeObject *)cls_o;
+    const bool have_cb = (cb != Py_None);
+
+    View v_buf, v_ids, v_chg, v_frm, v_tov, v_koff, v_klen, v_soff,
+        v_slen, v_voff, v_vlen;
+    if (v_buf.acquire(buf_o) < 0 || v_ids.acquire(ids_o) < 0 ||
+        v_chg.acquire(chg_o) < 0 || v_frm.acquire(frm_o) < 0 ||
+        v_tov.acquire(tov_o) < 0 || v_koff.acquire(koff_o) < 0 ||
+        v_klen.acquire(klen_o) < 0 || v_soff.acquire(soff_o) < 0 ||
+        v_slen.acquire(slen_o) < 0 || v_voff.acquire(voff_o) < 0 ||
+        v_vlen.acquire(vlen_o) < 0)
+        return nullptr;
+    const char *buf = (const char *)v_buf.buf.buf;
+    const uint8_t *ids = (const uint8_t *)v_ids.buf.buf;
+    const uint32_t *chg = (const uint32_t *)v_chg.buf.buf;
+    const uint32_t *frm = (const uint32_t *)v_frm.buf.buf;
+    const uint32_t *tov = (const uint32_t *)v_tov.buf.buf;
+    const int64_t *koff = (const int64_t *)v_koff.buf.buf;
+    const int64_t *klen = (const int64_t *)v_klen.buf.buf;
+    const int64_t *soff = (const int64_t *)v_soff.buf.buf;
+    const int64_t *slen = (const int64_t *)v_slen.buf.buf;
+    const int64_t *voff = (const int64_t *)v_voff.buf.buf;
+    const int64_t *vlen = (const int64_t *)v_vlen.buf.buf;
+
+    int err = 0;
+    long changes = get_long_attr(dec, s_changes, &err);
+    if (err) return nullptr;
+
+    int status = 0;
+    PyObject *exc = nullptr;
+
+    while (f < n && ids[f] == 1 /* TYPE_CHANGE */) {
+        // --- build the Change ------------------------------------------
+        PyObject *ch = cls->tp_new(cls, empty_tuple, nullptr);
+        if (ch == nullptr) { exc = (PyObject *)1; break; }
+        PyObject *key = PyUnicode_DecodeUTF8(buf + koff[row],
+                                             (Py_ssize_t)klen[row], nullptr);
+        if (key == nullptr) {
+            Py_DECREF(ch);
+            if (PyErr_ExceptionMatches(PyExc_UnicodeDecodeError)) {
+                PyObject *t, *v, *tb;
+                PyErr_Fetch(&t, &v, &tb);
+                PyErr_NormalizeException(&t, &v, &tb);
+                PyObject *msg = v ? PyObject_Str(v) : nullptr;
+                if (msg != nullptr) {
+                    PyDict_SetItemString(st, "decode_error", msg);
+                    Py_DECREF(msg);
+                }
+                Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+                status = 2;
+                break;
+            }
+            exc = (PyObject *)1;
+            break;
+        }
+        PyObject *val;
+        if (vlen[row] >= 0) {
+            val = PyBytes_FromStringAndSize(buf + voff[row],
+                                            (Py_ssize_t)vlen[row]);
+        } else {
+            val = empty_bytes;
+            Py_INCREF(val);
+        }
+        PyObject *sub;
+        if (slen[row] >= 0) {
+            sub = PyUnicode_DecodeUTF8(buf + soff[row],
+                                       (Py_ssize_t)slen[row], nullptr);
+            if (sub == nullptr &&
+                PyErr_ExceptionMatches(PyExc_UnicodeDecodeError)) {
+                Py_DECREF(ch);
+                Py_DECREF(key);
+                Py_XDECREF(val);
+                PyObject *t, *v, *tb;
+                PyErr_Fetch(&t, &v, &tb);
+                PyErr_NormalizeException(&t, &v, &tb);
+                PyObject *msg = v ? PyObject_Str(v) : nullptr;
+                if (msg != nullptr) {
+                    PyDict_SetItemString(st, "decode_error", msg);
+                    Py_DECREF(msg);
+                }
+                Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+                status = 2;
+                break;
+            }
+        } else {
+            sub = empty_str;
+            Py_INCREF(sub);
+        }
+        PyObject *cg = PyLong_FromUnsignedLong(chg[row]);
+        PyObject *fr = PyLong_FromUnsignedLong(frm[row]);
+        PyObject *to = PyLong_FromUnsignedLong(tov[row]);
+        int bad = (val == nullptr || sub == nullptr || cg == nullptr ||
+                   fr == nullptr || to == nullptr);
+        if (!bad) {
+            bad = PyObject_SetAttr(ch, s_key, key) < 0 ||
+                  PyObject_SetAttr(ch, s_change, cg) < 0 ||
+                  PyObject_SetAttr(ch, s_from, fr) < 0 ||
+                  PyObject_SetAttr(ch, s_to, to) < 0 ||
+                  PyObject_SetAttr(ch, s_value, val) < 0 ||
+                  PyObject_SetAttr(ch, s_subset, sub) < 0;
+        }
+        Py_DECREF(key);
+        Py_XDECREF(val);
+        Py_XDECREF(sub);
+        Py_XDECREF(cg);
+        Py_XDECREF(fr);
+        Py_XDECREF(to);
+        if (bad) { Py_DECREF(ch); exc = (PyObject *)1; break; }
+
+        row += 1;
+        f += 1;
+        changes += 1;
+        // counter visible inside the handler, same as _deliver_change
+        {
+            PyObject *cv = PyLong_FromLong(changes);
+            if (cv == nullptr || PyObject_SetAttr(dec, s_changes, cv) < 0) {
+                Py_XDECREF(cv);
+                Py_DECREF(ch);
+                exc = (PyObject *)1;
+                break;
+            }
+            Py_DECREF(cv);
+        }
+
+        if (have_cb) {
+            FastAck *ack = fastack_alloc(dec, board_o);
+            if (ack == nullptr) { Py_DECREF(ch); exc = (PyObject *)1; break; }
+            PyObject *argv[2] = {ch, (PyObject *)ack};
+            PyObject *r = PyObject_Vectorcall(cb, argv, 2, nullptr);
+            Py_DECREF(ch);
+            if (r == nullptr) {
+                Py_DECREF(ack);
+                exc = (PyObject *)1;
+                break;
+            }
+            Py_DECREF(r);
+            // arm iff the handler did NOT ack synchronously.  The CAS
+            // settles the cross-thread race: a done() landing between
+            // the handler returning and this point flips state to
+            // SYNC_ACKED and the CAS fails -> sync path.
+            int expected = FRESH;
+            if (ack->state.compare_exchange_strong(expected, ARMED)) {
+                board->outstanding.fetch_add(1);
+                Py_DECREF(ack);
+                status = 1;
+                break;  // park: the armed release resumes the decoder
+            }
+            Py_DECREF(ack);
+        } else {
+            Py_DECREF(ch);  // no handler: drop (reference: decode.js:54-56)
+        }
+
+        // destroy / pause / legacy-pending checks (a handler may destroy
+        // the decoder or pause an earlier blob reader mid-run)
+        PyObject *d = PyObject_GetAttr(dec, s_destroyed);
+        if (d == nullptr) { exc = (PyObject *)1; break; }
+        int is_destroyed = PyObject_IsTrue(d);
+        Py_DECREF(d);
+        if (is_destroyed < 0) { exc = (PyObject *)1; break; }
+        if (is_destroyed) { status = 1; break; }
+        long paused = get_long_attr(dec, s_paused, &err);
+        if (err) { exc = (PyObject *)1; break; }
+        long pending = get_long_attr(dec, s_pending, &err);
+        if (err) { exc = (PyObject *)1; break; }
+        if (paused > 0 || pending > 0 || board->outstanding.load() > 0) {
+            status = 1;
+            break;
+        }
+    }
+
+    // progress writeback happens even on error: a raising handler must
+    // not desync the cursor from the delivered rows
+    PyObject *fv = PyLong_FromSsize_t(f);
+    PyObject *rv = PyLong_FromSsize_t(row);
+    if (fv != nullptr && rv != nullptr) {
+        if (exc != nullptr) {
+            // preserve the pending exception across the dict stores
+            PyObject *t, *val2, *tb;
+            PyErr_Fetch(&t, &val2, &tb);
+            PyDict_SetItemString(st, "f", fv);
+            PyDict_SetItemString(st, "row", rv);
+            PyErr_Restore(t, val2, tb);
+        } else {
+            PyDict_SetItemString(st, "f", fv);
+            PyDict_SetItemString(st, "row", rv);
+        }
+    }
+    Py_XDECREF(fv);
+    Py_XDECREF(rv);
+    if (exc != nullptr) return nullptr;
+    return Py_BuildValue("nni", f, row, status);
+}
+
+static PyMethodDef module_methods[] = {
+    {"dispatch_changes", dispatch_changes, METH_VARARGS,
+     "Dispatch a run of change frames from columnar buffers."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "dat_fastpath",
+    "C dispatch loop for the decoder's bulk change path.", -1,
+    module_methods, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_dat_fastpath(void) {
+    s_pending = PyUnicode_InternFromString("_pending");
+    s_paused = PyUnicode_InternFromString("_paused_readers");
+    s_destroyed = PyUnicode_InternFromString("destroyed");
+    s_changes = PyUnicode_InternFromString("changes");
+    s_resume = PyUnicode_InternFromString("_resume");
+    s_key = PyUnicode_InternFromString("key");
+    s_change = PyUnicode_InternFromString("change");
+    s_from = PyUnicode_InternFromString("from_");
+    s_to = PyUnicode_InternFromString("to");
+    s_value = PyUnicode_InternFromString("value");
+    s_subset = PyUnicode_InternFromString("subset");
+    empty_bytes = PyBytes_FromStringAndSize(nullptr, 0);
+    empty_str = PyUnicode_FromString("");
+    empty_tuple = PyTuple_New(0);
+    if (s_pending == nullptr || s_paused == nullptr ||
+        s_destroyed == nullptr || s_changes == nullptr ||
+        s_resume == nullptr || s_key == nullptr || s_change == nullptr ||
+        s_from == nullptr || s_to == nullptr || s_value == nullptr ||
+        s_subset == nullptr || empty_bytes == nullptr ||
+        empty_str == nullptr || empty_tuple == nullptr)
+        return nullptr;
+
+    AckBoard_Type.tp_flags = Py_TPFLAGS_DEFAULT;
+    AckBoard_Type.tp_new = ackboard_new;
+    AckBoard_Type.tp_getset = ackboard_getset;
+    if (PyType_Ready(&AckBoard_Type) < 0) return nullptr;
+
+    FastAck_Type.tp_flags = Py_TPFLAGS_DEFAULT;
+    FastAck_Type.tp_dealloc = (destructor)fastack_dealloc;
+    FastAck_Type.tp_call = (ternaryfunc)fastack_call;
+    if (PyType_Ready(&FastAck_Type) < 0) return nullptr;
+
+    PyObject *m = PyModule_Create(&moduledef);
+    if (m == nullptr) return nullptr;
+    Py_INCREF(&AckBoard_Type);
+    if (PyModule_AddObject(m, "AckBoard", (PyObject *)&AckBoard_Type) < 0) {
+        Py_DECREF(&AckBoard_Type);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    Py_INCREF(&FastAck_Type);
+    if (PyModule_AddObject(m, "FastAck", (PyObject *)&FastAck_Type) < 0) {
+        Py_DECREF(&FastAck_Type);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}

@@ -1,0 +1,87 @@
+"""On-demand build + import of the ``dat_fastpath`` CPython extension.
+
+Unlike :mod:`.native` (a plain C ABI loaded via ctypes), the dispatch
+loop needs to create Python objects and call handlers, so it is a real
+extension module compiled against this interpreter's headers.  Same
+degrade-gracefully contract: :func:`get` returns ``None`` (and callers
+use the pure-Python loop) when the toolchain or headers are missing or
+``DAT_FASTPATH_DISABLE`` is set.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from pathlib import Path
+
+_SRC = Path(__file__).resolve().parent.parent / "native" / "dat_fastpath.cpp"
+_BUILD_DIR = Path(
+    os.environ.get(
+        "DAT_NATIVE_BUILD_DIR",
+        Path(__file__).resolve().parent.parent / "native" / "_build",
+    )
+)
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def _build() -> Path | None:
+    # keyed by source AND interpreter ABI: an extension built for one
+    # CPython must never be loaded into another
+    key = hashlib.blake2b(
+        _SRC.read_bytes() + sys.version.encode(), digest_size=8
+    ).hexdigest()
+    so = _BUILD_DIR / f"dat_fastpath-{key}.so"
+    if so.exists():
+        return so
+    include = sysconfig.get_paths().get("include")
+    if not include or not os.path.isdir(include):
+        return None
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = so.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        f"-I{include}", str(_SRC), "-o", str(tmp),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"dat_fastpath build failed ({e}); using the Python loop",
+              file=sys.stderr)
+        return None
+    os.replace(tmp, so)  # atomic: concurrent builders race benignly
+    return so
+
+
+def get():
+    """The extension module, building it on first call; None if
+    unavailable (callers fall back to the Python dispatch loop)."""
+    global _mod, _tried
+    with _lock:
+        if _tried:
+            return _mod
+        _tried = True
+        if os.environ.get("DAT_FASTPATH_DISABLE"):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "dat_fastpath", str(so))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _mod = mod
+        except Exception as e:  # load/ABI failure: fall back, once
+            print(f"dat_fastpath load failed ({e}); using the Python loop",
+                  file=sys.stderr)
+            _mod = None
+        return _mod

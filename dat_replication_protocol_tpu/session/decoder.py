@@ -35,6 +35,25 @@ from ..wire.varint import decode_uvarint
 
 OnDone = Optional[Callable[[], None]]
 
+_FP_UNSET = object()
+_fp_cache = _FP_UNSET
+
+
+def _fastpath_mod():
+    """The dat_fastpath C extension, or None (module cached; the DISABLE
+    env var is re-read every call so tests can exercise both dispatch
+    implementations in one process)."""
+    import os
+
+    if os.environ.get("DAT_FASTPATH_DISABLE"):
+        return None
+    global _fp_cache
+    if _fp_cache is _FP_UNSET:
+        from ..runtime import fastpath
+
+        _fp_cache = fastpath.get()
+    return _fp_cache
+
 
 class DecoderDestroyedError(Exception):
     pass
@@ -205,6 +224,9 @@ class Decoder:
         self._consuming = False  # reentrancy guard for _consume
         # serializes _FastAck state transitions against cross-thread acks
         self._ack_lock = threading.Lock()
+        # dat_fastpath AckBoard (outstanding C-side armed acks), created
+        # lazily the first time the C dispatch loop runs
+        self._ack_board = None
 
     # -- handler registration (same shape as the reference API) -------------
 
@@ -307,7 +329,10 @@ class Decoder:
     # -- flow control --------------------------------------------------------
 
     def _stalled(self) -> bool:
-        return self._pending > 0 or self._paused_readers > 0
+        if self._pending > 0 or self._paused_readers > 0:
+            return True
+        board = self._ack_board
+        return board is not None and board.outstanding > 0
 
     def _up(self) -> Callable[[], None]:
         """Create a one-shot ``done`` for an app callback; parsing pauses
@@ -367,8 +392,13 @@ class Decoder:
     # -- parser --------------------------------------------------------------
 
     # bulk path threshold: below this, the native round-trip (array
-    # wrapping + index buffers) costs more than the per-byte scan saves
-    _NATIVE_MIN = 4096
+    # wrapping + index buffers) costs more than the per-byte scan saves.
+    # 2048 measured (round 5): a transport writing ~4 KiB chunks leaves
+    # a ~4000-byte remainder after the scanner crosses the straddling
+    # frame — at the old 4096 threshold that remainder always rode the
+    # scanner (5.5 MiB/s); at 2048 it re-enters the native index
+    # (21.7 MiB/s), with large-write throughput unchanged (within noise)
+    _NATIVE_MIN = 2048
 
     def _consume(self) -> None:
         """Main parse loop: drain overflow while the app is keeping up
@@ -509,7 +539,7 @@ class Decoder:
         if n <= 0:
             return False
 
-        cols = None
+        cols_np = None
         cidx = np.nonzero(ids[:n] == TYPE_CHANGE)[0]
         m = len(cidx)
         if m >= 16:
@@ -530,24 +560,37 @@ class Decoder:
                 ctypes.byref(erri),
             )
             if rc == 0:
-                cols = (
-                    chg.tolist(), frm.tolist(), tov.tolist(),
-                    koff.tolist(), klen.tolist(), soff.tolist(),
-                    slen.tolist(), voff.tolist(), vlen.tolist(),
-                )
+                # kept as the raw numpy columns: the C dispatch loop
+                # reads the buffers directly; the Python loops get
+                # list/tuple views lazily (_cols_lists) — converting
+                # eagerly cost ~0.5us/frame of tolist/zip
+                cols_np = (chg, frm, tov, koff, klen, soff, slen,
+                           voff, vlen)
         self._bulk = {
             "buf": buf,
             "starts": starts[:n].tolist(),
             "lens": lens[:n].tolist(),
             "ids": ids[:n].tolist(),
+            "ids_np": np.ascontiguousarray(ids[:n]),
             "n": n,
             "consumed": int(consumed.value),
             "f": 0,
             "row": 0,
-            "cols": cols,
+            "cols_np": cols_np,
             "blob_open": False,
         }
         return True
+
+    @staticmethod
+    def _cols_lists(st: dict):
+        """Python-loop view of the columnar decode: one tuple per row
+        (lazy; the C dispatcher never needs it)."""
+        rows = st.get("zrows")
+        if rows is None and st["cols_np"] is not None:
+            rows = st["zrows"] = list(
+                zip(*(a.tolist() for a in st["cols_np"]))
+            )
+        return rows
 
     def _run_indexed(self) -> None:
         """Dispatch frames from the parked index until done or stalled.
@@ -564,10 +607,10 @@ class Decoder:
         assert st is not None
         buf = st["buf"]
         starts, lens, ids = st["starts"], st["lens"], st["ids"]
-        cols = st["cols"]
+        have_cols = st["cols_np"] is not None
         f = st["f"]
         n = st["n"]
-        fast = (cols is not None
+        fast = (have_cols
                 and type(self)._deliver_change is Decoder._deliver_change)
         while f < n:
             if self._stalled() or self.destroyed:
@@ -585,18 +628,15 @@ class Decoder:
             self._missing = flen
             if type_id == TYPE_CHANGE:
                 row = st["row"]
-                if cols is not None:
-                    (chg, frm, tov, koff, klen, soff, slen, voff,
-                     vlen) = cols
-                    ko, kl = koff[row], klen[row]
-                    so, sl = soff[row], slen[row]
-                    vo, vl = voff[row], vlen[row]
+                if have_cols:
+                    (cg, fr, to, ko, kl, so, sl, vo,
+                     vl) = self._cols_lists(st)[row]
                     try:
                         change = Change(
                             key=str(buf[ko : ko + kl], "utf-8"),
-                            change=chg[row],
-                            from_=frm[row],
-                            to=tov[row],
+                            change=cg,
+                            from_=fr,
+                            to=to,
                             value=(bytes(buf[vo : vo + vl])
                                    if vl >= 0 else b""),
                             subset=(str(buf[so : so + sl], "utf-8")
@@ -665,14 +705,34 @@ class Decoder:
         identical to the general loop; ``self.changes`` is incremented
         before each handler call exactly as ``_deliver_change`` does.
         """
+        fp = _fastpath_mod()
+        if fp is not None:
+            if self._ack_board is None:
+                self._ack_board = fp.AckBoard()
+            try:
+                # handler exceptions propagate from here as themselves
+                # (the C loop reports WIRE decode errors via status 2,
+                # never as an exception — a handler-raised ValueError
+                # must not be misread as a protocol error)
+                f, _row, status = fp.dispatch_changes(
+                    self, self._ack_board, self._on_change,
+                    Change, st["buf"], st["ids_np"], *st["cols_np"],
+                    f, st["row"], st["n"], st,
+                )
+            finally:
+                # the C loop runs at a frame boundary throughout (same
+                # invariant as the Python loop's finally below)
+                self._missing = 0
+                self._state = TYPE_HEADER
+            if status == 2:
+                self.destroy(ProtocolError(
+                    st.pop("decode_error", "invalid change payload")))
+            return f
+
         bbuf = st.get("bbuf")
         if bbuf is None:
             bbuf = st["bbuf"] = bytes(st["buf"])
-        rows = st.get("zrows")
-        if rows is None:
-            # one tuple per change row: a single list index + unpack in
-            # the loop instead of nine list indexes (~250ns/frame less)
-            rows = st["zrows"] = list(zip(*st["cols"]))
+        rows = self._cols_lists(st)
         ids = st["ids"]
         n = st["n"]
         row = st["row"]

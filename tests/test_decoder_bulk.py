@@ -27,6 +27,17 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+@pytest.fixture(params=["c-dispatch", "python-dispatch"], autouse=True)
+def both_dispatch_paths(request, monkeypatch):
+    """Every test in this module runs against BOTH bulk dispatch
+    implementations (the dat_fastpath C loop and the pure-Python
+    fallback): an image with a toolchain would otherwise never execute
+    the fallback, and one without would never execute the C loop — a
+    divergence between them could ship green either way."""
+    if request.param == "python-dispatch":
+        monkeypatch.setenv("DAT_FASTPATH_DISABLE", "1")
+
+
 def _wire(n=400, blob_every=7):
     parts = []
     for i in range(n):
@@ -325,3 +336,28 @@ def test_changes_counter_increments_before_each_callback():
     dec.write(wire)
     dec.end()
     assert observed == list(range(1, 51))
+
+
+def test_handler_valueerror_propagates_not_protocolerror():
+    """A handler bug that raises ValueError must surface as that
+    ValueError to write()'s caller — on BOTH dispatch paths — never be
+    misread as a wire error that destroys the session (round-5 review:
+    the C loop once wrapped handler calls in the decode-error handler)."""
+    wire = _wire(n=40, blob_every=1 << 30)
+    dec = protocol.decode()
+    seen = []
+
+    def handler(ch, done):
+        seen.append(ch.key)
+        if len(seen) == 10:
+            raise ValueError("bad app state")
+        done()
+
+    dec.change(handler)
+    errs = []
+    dec.on_error(errs.append)
+    with pytest.raises(ValueError, match="bad app state"):
+        dec.write(wire)
+    assert not dec.destroyed  # the decoder was not torn down as a
+    assert errs == []         # protocol error; the app owns its bug
+    assert seen == [f"key-{i}" for i in range(10)]
