@@ -608,6 +608,7 @@ class Decoder:
         buf = st["buf"]
         starts, lens, ids = st["starts"], st["lens"], st["ids"]
         have_cols = st["cols_np"] is not None
+        rows_l = self._cols_lists(st) if have_cols else None
         f = st["f"]
         n = st["n"]
         fast = (have_cols
@@ -629,23 +630,41 @@ class Decoder:
             if type_id == TYPE_CHANGE:
                 row = st["row"]
                 if have_cols:
-                    (cg, fr, to, ko, kl, so, sl, vo,
-                     vl) = self._cols_lists(st)[row]
-                    try:
-                        change = Change(
-                            key=str(buf[ko : ko + kl], "utf-8"),
-                            change=cg,
-                            from_=fr,
-                            to=to,
-                            value=(bytes(buf[vo : vo + vl])
-                                   if vl >= 0 else b""),
-                            subset=(str(buf[so : so + sl], "utf-8")
-                                    if sl >= 0 else ""),
-                        )
-                    except ValueError as e:  # incl. UnicodeDecodeError
-                        self._bulk = None
-                        self.destroy(ProtocolError(str(e)))
-                        return
+                    (cg, fr, to, ko, kl, so, sl, vo, vl) = rows_l[row]
+                    if self._on_change is not None:
+                        try:
+                            change = Change(
+                                key=str(buf[ko : ko + kl], "utf-8"),
+                                change=cg,
+                                from_=fr,
+                                to=to,
+                                value=(bytes(buf[vo : vo + vl])
+                                       if vl >= 0 else b""),
+                                subset=(str(buf[so : so + sl], "utf-8")
+                                        if sl >= 0 else ""),
+                            )
+                        except ValueError as e:  # incl. UnicodeDecodeError
+                            self._bulk = None
+                            self.destroy(ProtocolError(str(e)))
+                            return
+                    else:
+                        # no registered handler will ever see the object
+                        # (the default drops changes) — but the payload
+                        # must still be VALID: the key's UTF-8 check is
+                        # the one observable part of construction, and a
+                        # digest-only subclass (TpuDecoder with no change
+                        # handler — the sidecar's shape) still needs the
+                        # wire error.  ``change=None`` is a documented
+                        # private contract of _deliver_change.
+                        try:
+                            str(buf[ko : ko + kl], "utf-8")
+                            if sl >= 0:
+                                str(buf[so : so + sl], "utf-8")
+                        except ValueError as e:
+                            self._bulk = None
+                            self.destroy(ProtocolError(str(e)))
+                            return
+                        change = None
                     st["row"] = row + 1
                     self._missing = 0
                     self._deliver_change(change, buf[start : start + flen])
@@ -862,11 +881,16 @@ class Decoder:
             return
         self._deliver_change(change, payload)
 
-    def _deliver_change(self, change: Change, payload) -> None:
+    def _deliver_change(self, change: Change | None, payload) -> None:
         """Deliver one decoded change: the single hook both parse paths
         (streaming scanner and native bulk index) funnel through, so
         subclasses adding per-change work (the TPU backend hashes every
-        payload) override exactly one method."""
+        payload) override exactly one method.
+
+        Private contract: ``change`` may be ``None`` ONLY when no change
+        handler is registered (``self._on_change is None``) — the bulk
+        loop skips dead object construction then.  Subclasses must use
+        ``payload``, not ``change``, for handler-independent work."""
         self.changes += 1
         self._state = TYPE_HEADER
         if self._on_change is not None:

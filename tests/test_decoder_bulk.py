@@ -361,3 +361,55 @@ def test_handler_valueerror_propagates_not_protocolerror():
     assert not dec.destroyed  # the decoder was not torn down as a
     assert errs == []         # protocol error; the app owns its bug
     assert seen == [f"key-{i}" for i in range(10)]
+
+
+def test_randomized_ack_schedule_soak():
+    """Bounded version of the round-5 ack soak (7-min run: 3,756 sessions
+    clean): randomized sync / cross-thread / double / late acks across
+    sessions; a lost ack hangs the session and trips the deadline."""
+    import random
+    import threading
+    import time
+
+    for seed in range(6):
+        rng = random.Random(seed)
+        wire = _wire(n=120, blob_every=11)
+        dec = protocol.decode()
+        seen = []
+        threads = []
+        late = []
+
+        def on_change(ch, done):
+            seen.append(ch.key)
+            mode = rng.random()
+            if mode < 0.4:
+                done()
+                if rng.random() < 0.2:
+                    done()
+            elif mode < 0.85:
+                t = threading.Thread(target=lambda d=done: (d(), d()))
+                t.start()
+                threads.append(t)
+            else:
+                late.append(done)
+
+        dec.change(on_change)
+        dec.blob(lambda b, done: b.collect(lambda _d: done()))
+        for off in range(0, len(wire), 4096):
+            deadline = time.time() + 15
+            while not dec.writable() and not dec.finished and not dec.destroyed:
+                if late:
+                    late.pop(0)()
+                assert time.time() < deadline, f"stalled, seed {seed}"
+                time.sleep(0.0005)
+            dec.write(wire[off:off + 4096])
+        dec.end()
+        deadline = time.time() + 15
+        while not dec.finished:
+            if late:
+                late.pop(0)()
+            assert time.time() < deadline, f"finalize hang, seed {seed}"
+            time.sleep(0.0005)
+        for t in threads:
+            t.join(timeout=5)
+        assert seen == [f"key-{i}" for i in range(120)], f"seed {seed}"
