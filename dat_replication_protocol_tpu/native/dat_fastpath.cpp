@@ -31,6 +31,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstring>
 
 namespace {
 
@@ -372,9 +373,278 @@ static PyObject *dispatch_changes(PyObject *, PyObject *args) {
     return Py_BuildValue("nni", f, row, status);
 }
 
+
+// ---------------------------------------------------------------------------
+// encode_change_c — proto2 serialization of one Change (the wire/
+// change_codec.py encoder's hot path; byte-identical, tested against it)
+// ---------------------------------------------------------------------------
+
+static inline int uvarint_len(uint64_t v) {
+    int n = 1;
+    while (v >= 0x80) { v >>= 7; n++; }
+    return n;
+}
+
+static inline int put_uvarint(uint8_t *p, uint64_t v) {
+    int i = 0;
+    while (v >= 0x80) { p[i++] = (uint8_t)(v | 0x80); v >>= 7; }
+    p[i++] = (uint8_t)v;
+    return i;
+}
+
+static int as_uint32(PyObject *o, const char *name, uint32_t *out) {
+    // mirror change_codec._check_uint32: int (incl. bool) in [0, 2^32)
+    if (!PyLong_Check(o) && !PyBool_Check(o)) {
+        PyObject *r = PyObject_Repr(o);
+        PyErr_Format(PyExc_ValueError, "Change.%s must be a uint32, got %s",
+                     name, r ? PyUnicode_AsUTF8(r) : "?");
+        Py_XDECREF(r);
+        return -1;
+    }
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(o, &overflow);
+    if ((v == -1 && PyErr_Occurred())) return -1;
+    if (overflow || v < 0 || v > (long long)0xFFFFFFFFLL) {
+        PyObject *r = PyObject_Repr(o);
+        PyErr_Format(PyExc_ValueError, "Change.%s must be a uint32, got %s",
+                     name, r ? PyUnicode_AsUTF8(r) : "?");
+        Py_XDECREF(r);
+        return -1;
+    }
+    *out = (uint32_t)v;
+    return 0;
+}
+
+// encode_change_c(key, change, from_, to, value_or_None, subset_or_None)
+// -> bytes   (proto2 tags 0x0A subset / 0x12 key / 0x18 / 0x20 / 0x28 /
+// 0x32 value, ascending field order, absent optionals omitted)
+static PyObject *encode_change_c(PyObject *, PyObject *args) {
+    PyObject *key_o, *cg_o, *fr_o, *to_o, *val_o, *sub_o;
+    if (!PyArg_ParseTuple(args, "OOOOOO", &key_o, &cg_o, &fr_o, &to_o,
+                          &val_o, &sub_o))
+        return nullptr;
+    uint32_t cg, fr, to;
+    Py_ssize_t sub_n = 0, key_n = 0, val_n = 0;
+    const char *sub_p = nullptr, *key_p = nullptr;
+    if (sub_o != Py_None) {
+        sub_p = PyUnicode_AsUTF8AndSize(sub_o, &sub_n);
+        if (sub_p == nullptr) return nullptr;
+    }
+    if (key_o == Py_None) {
+        PyErr_SetString(PyExc_ValueError, "Change.key is required");
+        return nullptr;
+    }
+    key_p = PyUnicode_AsUTF8AndSize(key_o, &key_n);
+    if (key_p == nullptr) return nullptr;
+    if (as_uint32(cg_o, "change", &cg) < 0 ||
+        as_uint32(fr_o, "from", &fr) < 0 ||
+        as_uint32(to_o, "to", &to) < 0)
+        return nullptr;
+    Py_buffer val_view{};
+    bool have_val = (val_o != Py_None);
+    if (have_val) {
+        if (PyObject_GetBuffer(val_o, &val_view, PyBUF_SIMPLE) < 0)
+            return nullptr;
+        val_n = val_view.len;
+    }
+
+    Py_ssize_t total = 0;
+    if (sub_p) total += 1 + uvarint_len(sub_n) + sub_n;
+    total += 1 + uvarint_len(key_n) + key_n;
+    total += 1 + uvarint_len(cg) + 1 + uvarint_len(fr) + 1 + uvarint_len(to);
+    if (have_val) total += 1 + uvarint_len(val_n) + val_n;
+
+    PyObject *out = PyBytes_FromStringAndSize(nullptr, total);
+    if (out == nullptr) {
+        if (have_val) PyBuffer_Release(&val_view);
+        return nullptr;
+    }
+    uint8_t *p = (uint8_t *)PyBytes_AS_STRING(out);
+    if (sub_p) {
+        *p++ = 0x0A;
+        p += put_uvarint(p, sub_n);
+        memcpy(p, sub_p, sub_n);
+        p += sub_n;
+    }
+    *p++ = 0x12;
+    p += put_uvarint(p, key_n);
+    memcpy(p, key_p, key_n);
+    p += key_n;
+    *p++ = 0x18; p += put_uvarint(p, cg);
+    *p++ = 0x20; p += put_uvarint(p, fr);
+    *p++ = 0x28; p += put_uvarint(p, to);
+    if (have_val) {
+        *p++ = 0x32;
+        p += put_uvarint(p, val_n);
+        memcpy(p, val_view.buf, val_n);
+        p += val_n;
+        PyBuffer_Release(&val_view);
+    }
+    return out;
+}
+
+
+// ---------------------------------------------------------------------------
+// decode_change_c — one proto2 Change payload -> a Change object (the
+// streaming scanner's per-frame decoder; semantics mirror
+// wire/change_codec.py:decode_change, incl. uint32 truncation and
+// unknown-field skipping; all malformed input -> ValueError)
+// ---------------------------------------------------------------------------
+
+static int read_uvarint(const uint8_t *p, Py_ssize_t n, Py_ssize_t *i,
+                        uint64_t *out) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (*i < n) {
+        uint8_t b = p[(*i)++];
+        if (shift >= 64 || (shift == 63 && (b & 0x7E))) {
+            PyErr_SetString(PyExc_ValueError,
+                            "corrupt Change payload: varint exceeds 64 bits");
+            return -1;
+        }
+        v |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = v;
+            return 0;
+        }
+        shift += 7;
+    }
+    PyErr_SetString(PyExc_ValueError,
+                    "corrupt Change payload: truncated varint");
+    return -1;
+}
+
+// decode_change_c(change_cls, payload_buffer) -> Change
+static PyObject *decode_change_c(PyObject *, PyObject *args) {
+    PyObject *cls_o, *buf_o;
+    if (!PyArg_ParseTuple(args, "OO", &cls_o, &buf_o)) return nullptr;
+    PyTypeObject *cls = (PyTypeObject *)cls_o;
+    View v;
+    if (v.acquire(buf_o) < 0) return nullptr;
+    const uint8_t *p = (const uint8_t *)v.buf.buf;
+    Py_ssize_t n = v.buf.len;
+    Py_ssize_t i = 0;
+
+    PyObject *key = nullptr, *value = nullptr, *subset = nullptr;
+    uint32_t cg = 0, fr = 0, to = 0;
+    bool have_cg = false, have_fr = false, have_to = false;
+
+    while (i < n) {
+        uint64_t tag;
+        if (read_uvarint(p, n, &i, &tag) < 0) goto fail;
+        switch (tag & 7) {
+            case 0: {  // varint
+                uint64_t val;
+                if (read_uvarint(p, n, &i, &val) < 0) goto fail;
+                if (tag == 0x18) { cg = (uint32_t)val; have_cg = true; }
+                else if (tag == 0x20) { fr = (uint32_t)val; have_fr = true; }
+                else if (tag == 0x28) { to = (uint32_t)val; have_to = true; }
+                break;
+            }
+            case 2: {  // length-delimited
+                uint64_t ln;
+                if (read_uvarint(p, n, &i, &ln) < 0) goto fail;
+                if ((uint64_t)(n - i) < ln) {
+                    PyErr_SetString(PyExc_ValueError,
+                                    "corrupt Change payload: truncated "
+                                    "length-delimited field");
+                    goto fail;
+                }
+                if (tag == 0x12) {
+                    Py_XDECREF(key);
+                    key = PyUnicode_DecodeUTF8((const char *)p + i,
+                                               (Py_ssize_t)ln, nullptr);
+                    if (key == nullptr) {
+                        // mirror the Python path: UnicodeDecodeError IS
+                        // a ValueError; let it propagate as-is
+                        goto fail;
+                    }
+                } else if (tag == 0x0A) {
+                    Py_XDECREF(subset);
+                    subset = PyUnicode_DecodeUTF8((const char *)p + i,
+                                                  (Py_ssize_t)ln, nullptr);
+                    if (subset == nullptr) goto fail;
+                } else if (tag == 0x32) {
+                    Py_XDECREF(value);
+                    value = PyBytes_FromStringAndSize((const char *)p + i,
+                                                      (Py_ssize_t)ln);
+                    if (value == nullptr) goto fail;
+                }
+                i += (Py_ssize_t)ln;
+                break;
+            }
+            case 5:  // fixed32 (unknown field skip)
+                if (n - i < 4) {
+                    PyErr_SetString(PyExc_ValueError,
+                                    "corrupt Change payload: truncated "
+                                    "fixed32 field");
+                    goto fail;
+                }
+                i += 4;
+                break;
+            case 1:  // fixed64 (unknown field skip)
+                if (n - i < 8) {
+                    PyErr_SetString(PyExc_ValueError,
+                                    "corrupt Change payload: truncated "
+                                    "fixed64 field");
+                    goto fail;
+                }
+                i += 8;
+                break;
+            default:
+                PyErr_Format(PyExc_ValueError,
+                             "unsupported protobuf wire type %d",
+                             (int)(tag & 7));
+                goto fail;
+        }
+    }
+    if (key == nullptr || !have_cg || !have_fr || !have_to) {
+        PyErr_SetString(PyExc_ValueError,
+                        "Change payload missing required fields");
+        goto fail;
+    }
+    {
+        PyObject *ch = cls->tp_new(cls, empty_tuple, nullptr);
+        if (ch == nullptr) goto fail;
+        PyObject *cgo = PyLong_FromUnsignedLong(cg);
+        PyObject *fro = PyLong_FromUnsignedLong(fr);
+        PyObject *too = PyLong_FromUnsignedLong(to);
+        if (value == nullptr) { value = empty_bytes; Py_INCREF(value); }
+        if (subset == nullptr) { subset = empty_str; Py_INCREF(subset); }
+        int bad = (cgo == nullptr || fro == nullptr || too == nullptr);
+        if (!bad) {
+            bad = PyObject_SetAttr(ch, s_key, key) < 0 ||
+                  PyObject_SetAttr(ch, s_change, cgo) < 0 ||
+                  PyObject_SetAttr(ch, s_from, fro) < 0 ||
+                  PyObject_SetAttr(ch, s_to, too) < 0 ||
+                  PyObject_SetAttr(ch, s_value, value) < 0 ||
+                  PyObject_SetAttr(ch, s_subset, subset) < 0;
+        }
+        Py_XDECREF(cgo);
+        Py_XDECREF(fro);
+        Py_XDECREF(too);
+        Py_DECREF(key);
+        Py_DECREF(value);
+        Py_DECREF(subset);
+        if (bad) { Py_DECREF(ch); return nullptr; }
+        return ch;
+    }
+fail:
+    Py_XDECREF(key);
+    Py_XDECREF(value);
+    Py_XDECREF(subset);
+    return nullptr;
+}
+
 static PyMethodDef module_methods[] = {
     {"dispatch_changes", dispatch_changes, METH_VARARGS,
      "Dispatch a run of change frames from columnar buffers."},
+    {"encode_change_c", encode_change_c, METH_VARARGS,
+     "Serialize one Change to proto2 bytes (byte-identical to "
+     "wire.change_codec.encode_change)."},
+    {"decode_change_c", decode_change_c, METH_VARARGS,
+     "Parse one proto2 Change payload into a Change object "
+     "(semantics of wire.change_codec.decode_change)."},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -93,8 +93,77 @@ def _check_uint32(name: str, v: int) -> int:
     return v
 
 
+_FP_UNSET = object()
+_fp_cache = _FP_UNSET
+
+
+def _fastpath_mod():
+    """The C serializer module, or None.  Cached INCLUDING the
+    DAT_FASTPATH_DISABLE decision: this sits on the per-change encode
+    path where even an ``os.environ.get`` is measurable.  Tests that
+    need the pure-Python bytes call :func:`_encode_change_py`
+    directly (or set the env var before first use)."""
+    global _fp_cache
+    if _fp_cache is _FP_UNSET:
+        import os
+
+        if os.environ.get("DAT_FASTPATH_DISABLE"):
+            _fp_cache = None
+        else:
+            from ..runtime import fastpath
+
+            _fp_cache = fastpath.get()
+    return _fp_cache
+
+
 def encode_change(change: Change | dict) -> bytes:
     """Serialize a Change to protobuf bytes (proto2 wire format)."""
+    # C serializer for the typed common case (byte-identical — fuzzed
+    # against the Python path); exotic-but-accepted inputs (e.g. a
+    # list as value, which bytes() coerces) keep the Python semantics.
+    # Dict inputs are read field-wise — no intermediate Change object —
+    # with from_dict's exact KeyError behavior.
+    fp = _fp_cache
+    if fp is _FP_UNSET:
+        fp = _fastpath_mod()
+    if fp is not None:
+        if isinstance(change, dict):
+            if "from" in change:
+                fr = change["from"]
+            elif "from_" in change:
+                fr = change["from_"]
+            else:
+                raise KeyError("from")  # required, same as from_dict
+            key = change["key"]
+            cg = change["change"]
+            to = change["to"]
+            value = change.get("value")
+            subset = change.get("subset")
+        else:
+            key = change.key
+            cg = change.change
+            fr = change.from_
+            to = change.to
+            value = change.value
+            subset = change.subset
+        if (
+            isinstance(key, str)
+            and (value is None
+                 or type(value) in (bytes, bytearray)
+                 # strided or multi-byte-item views would fail the C
+                 # side's PyBUF_SIMPLE (or, worse, encode nbytes where
+                 # the old Python path wrote element counts): only the
+                 # plain flat case rides C
+                 or (isinstance(value, memoryview) and value.c_contiguous
+                     and value.itemsize == 1 and value.ndim == 1))
+            and (subset is None or isinstance(subset, str))
+        ):
+            return fp.encode_change_c(key, cg, fr, to, value, subset)
+    return _encode_change_py(change)
+
+
+def _encode_change_py(change: Change | dict) -> bytes:
+    """The pure-Python serializer (also the C path's fuzz oracle)."""
     if isinstance(change, dict):
         change = Change.from_dict(change)
     out = bytearray()
@@ -116,9 +185,14 @@ def encode_change(change: Change | dict) -> bytes:
     out.append(_TAG_TO)
     out += encode_uvarint(_check_uint32("to", change.to))
     if change.value is not None:
+        raw = bytes(change.value)
         out.append(_TAG_VALUE)
-        out += encode_uvarint(len(change.value))
-        out += bytes(change.value)
+        # length of the SERIALIZED bytes: len(value) on e.g. a 4-byte-
+        # itemsize memoryview is the element count, which would stamp a
+        # length prefix shorter than the payload written below (latent
+        # wire corruption, caught by the round-5 C-parity review)
+        out += encode_uvarint(len(raw))
+        out += raw
     return bytes(out)
 
 
@@ -130,6 +204,21 @@ def decode_change(buf) -> Change:
     (matching what the reference suite observes for ``subset``,
     reference: test/basic.js:16).
     """
+    fp = _fp_cache
+    if fp is _FP_UNSET:
+        fp = _fastpath_mod()
+    if fp is not None:
+        try:
+            # C parser, differentially fuzzed against the Python loop
+            # below on random bytes (same records, same error class)
+            return fp.decode_change_c(Change, buf)
+        except BufferError:
+            pass  # e.g. a strided memoryview: the Python parser copies
+    return _decode_change_py(buf)
+
+
+def _decode_change_py(buf) -> Change:
+    """The pure-Python parser (also the C path's differential oracle)."""
     buf = memoryview(buf)
     n = len(buf)
     i = 0

@@ -112,3 +112,148 @@ def test_utf8_and_binary_values():
     c = Change(key="ключ-🔑", change=1, from_=0, to=1, value=bytes(range(256)), subset="αβ")
     out = decode_change(encode_change(c))
     assert out.key == "ключ-🔑" and out.value == bytes(range(256)) and out.subset == "αβ"
+
+
+def test_c_encoder_byte_identical_fuzz():
+    """dat_fastpath.encode_change_c must be byte-identical to the Python
+    encoder across randomized field shapes (incl. varint width edges,
+    absent/empty optionals, non-ASCII strings)."""
+    import random
+
+    from dat_replication_protocol_tpu.runtime import fastpath
+
+    fp = fastpath.get()
+    if fp is None:
+        import pytest
+        pytest.skip("dat_fastpath unavailable")
+    rng = random.Random(7)
+    edge_ints = [0, 1, 127, 128, 16383, 16384, (1 << 21) - 1, 1 << 21,
+                 (1 << 28) - 1, 1 << 28, 0xFFFFFFFF]
+    for i in range(500):
+        key = "".join(rng.choice("abÅ→€z0") for _ in range(rng.randrange(0, 40)))
+        subset = rng.choice([None, "", "s", "ünïcode·" * rng.randrange(1, 4)])
+        value = rng.choice([None, b"", bytes(rng.randrange(0, 200))])
+        cg = rng.choice(edge_ints)
+        fr = rng.choice(edge_ints)
+        to = rng.choice(edge_ints)
+        ch = Change(key=key, change=cg, from_=fr, to=to, value=value,
+                    subset=subset)
+        got_c = fp.encode_change_c(key, cg, fr, to, value, subset)
+        from dat_replication_protocol_tpu.wire.change_codec import (
+            _encode_change_py,
+        )
+        want = _encode_change_py(ch)
+        assert got_c == want, (i, key, subset, value, cg, fr, to)
+        # and both decode back to the same record
+        assert decode_change(got_c) == decode_change(want)
+
+
+def test_c_encoder_validation_parity():
+    from dat_replication_protocol_tpu.runtime import fastpath
+
+    fp = fastpath.get()
+    if fp is None:
+        import pytest
+        pytest.skip("dat_fastpath unavailable")
+    import pytest
+    with pytest.raises(ValueError, match="uint32"):
+        fp.encode_change_c("k", -1, 0, 1, None, None)
+    with pytest.raises(ValueError, match="uint32"):
+        fp.encode_change_c("k", 1 << 32, 0, 1, None, None)
+    with pytest.raises(ValueError, match="key is required"):
+        fp.encode_change_c(None, 1, 0, 1, None, None)
+
+
+def test_c_decoder_differential_fuzz():
+    """decode_change_c vs the Python parser on (a) valid encoded records
+    round-tripped, (b) mutated/truncated payloads, (c) pure random
+    bytes: identical records on success, same error CLASS (ValueError)
+    on failure — the C parser must never accept what Python rejects or
+    vice versa."""
+    import random
+
+    from dat_replication_protocol_tpu.runtime import fastpath
+    from dat_replication_protocol_tpu.wire.change_codec import (
+        _decode_change_py,
+    )
+
+    fp = fastpath.get()
+    if fp is None:
+        import pytest
+        pytest.skip("dat_fastpath unavailable")
+    rng = random.Random(11)
+
+    def compare(payload, ctx):
+        try:
+            want = _decode_change_py(payload)
+            want_err = None
+        except ValueError as e:
+            want, want_err = None, e
+        try:
+            got = fp.decode_change_c(Change, payload)
+            got_err = None
+        except ValueError as e:
+            got, got_err = None, e
+        if want_err is not None:
+            assert got_err is not None, (ctx, payload, got)
+        else:
+            assert got_err is None, (ctx, payload, want_err, got_err)
+            assert got == want, (ctx, payload)
+
+    edge_ints = [0, 1, 127, 128, 16383, 16384, (1 << 28) - 1, 1 << 28,
+                 0xFFFFFFFF]
+    for i in range(400):
+        ch = Change(
+            key="".join(rng.choice("abÅ€z") for _ in range(rng.randrange(0, 20))),
+            change=rng.choice(edge_ints),
+            from_=rng.choice(edge_ints),
+            to=rng.choice(edge_ints),
+            value=rng.choice([None, b"", bytes(rng.randrange(0, 60))]),
+            subset=rng.choice([None, "", "sü" * rng.randrange(1, 3)]),
+        )
+        wire = encode_change(ch)
+        compare(wire, ("roundtrip", i))
+        # truncations
+        if len(wire) > 1:
+            compare(wire[: rng.randrange(1, len(wire))], ("trunc", i))
+        # single-byte mutation
+        mut = bytearray(wire)
+        mut[rng.randrange(len(mut))] ^= 1 << rng.randrange(8)
+        compare(bytes(mut), ("mutate", i))
+        # garbage
+        compare(bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40))),
+                ("garbage", i))
+        # >32-bit varints truncate identically (foreign encoders)
+        big = (1 << 34) | rng.choice(edge_ints)
+        from dat_replication_protocol_tpu.wire.varint import encode_uvarint
+        payload = (bytes([0x12, 0x01]) + b"k" + bytes([0x18])
+                   + encode_uvarint(big)
+                   + bytes([0x20, 0x00, 0x28, 0x01]))
+        compare(payload, ("u64-trunc", i))
+
+
+def test_exotic_buffer_values_keep_parity():
+    """Strided / multi-itemsize memoryviews must produce identical,
+    SELF-CONSISTENT wire on both paths (the length prefix must count
+    the serialized bytes — a 4-byte-itemsize view's len() is elements,
+    not bytes), and strided views must decode on both paths."""
+    import array
+
+    from dat_replication_protocol_tpu.wire.change_codec import (
+        _decode_change_py,
+        _encode_change_py,
+    )
+
+    strided = memoryview(b"abcdef")[::2]
+    multi = memoryview(array.array("I", [1, 2]))
+    for value in (strided, multi, memoryview(b"plain"), bytearray(b"ba")):
+        ch = Change(key="k", change=1, from_=0, to=1, value=value)
+        wire = encode_change(ch)
+        assert wire == _encode_change_py(ch)
+        back = decode_change(wire)
+        assert back.value == bytes(value)
+        assert back == _decode_change_py(wire)
+    # a strided view OF a payload decodes via the Python fallback
+    payload = encode_change(Change(key="kk", change=7, from_=0, to=1))
+    doubled = bytes(b for byte in payload for b in (byte, 0))
+    assert decode_change(memoryview(doubled)[::2]) == decode_change(payload)
