@@ -41,11 +41,15 @@ def _host_hash_batch(payloads: list[bytes]) -> list[bytes]:
         if native.available():
             import numpy as np  # noqa: PLC0415
 
-            lens = np.array([len(p) for p in payloads], dtype=np.int64)
-            offs = np.cumsum(lens) - lens
-            out = native.hash_many(
-                np.frombuffer(b"".join(payloads), np.uint8), offs, lens
-            )
+            # zero-copy span path first (no join); falls back to the
+            # joined layout for non-bytes payloads or no extension
+            out = native.hash_many_list(payloads)
+            if out is None:
+                lens = np.array([len(p) for p in payloads], dtype=np.int64)
+                offs = np.cumsum(lens) - lens
+                out = native.hash_many(
+                    np.frombuffer(b"".join(payloads), np.uint8), offs, lens
+                )
             if out is not None:
                 return [row.tobytes() for row in out]
     return [

@@ -150,6 +150,41 @@ def _nthreads() -> int:
     return int(os.environ.get("DAT_NTHREADS", "0"))  # 0 = auto (hw cap)
 
 
+def hash_many_list(payloads: list) -> np.ndarray | None:
+    """BLAKE2b-256 of a list of ``bytes`` payloads -> (n, 32) uint8, or
+    ``None`` when unavailable (callers join + :func:`hash_many`).
+
+    Zero-copy: the C engine reads each payload in place via
+    (address, length) spans filled by the dat_fastpath extension —
+    the ``b"".join`` it replaces was ~25% of the routed host-hash path
+    at digest-pipeline batch shapes.  The spans are passed to the
+    ctypes engine as offsets relative to a dummy base array, so the
+    existing ``dat_blake2b_many`` signature serves both layouts.
+    """
+    lib = get_lib()
+    if lib is None or not payloads:
+        return None
+    from . import fastpath
+
+    fp = fastpath.get()
+    if fp is None:
+        return None
+    n = len(payloads)
+    addrs = np.empty(n, dtype=np.int64)
+    lens = np.empty(n, dtype=np.int64)
+    if not fp.bytes_spans(payloads, addrs, lens):
+        return None  # non-bytes entries: caller falls back to the join
+    base = np.zeros(1, dtype=np.uint8)
+    offs = addrs - np.int64(base.ctypes.data)
+    out = np.empty((n, 32), dtype=np.uint8)
+    # `payloads` stays referenced (and its bytes pinned) for the call
+    rc = lib.dat_blake2b_many(base, offs, lens, n, out.reshape(-1),
+                              _nthreads())
+    if rc != 0:
+        return None
+    return out
+
+
 def hash_many(buf: np.ndarray, offs: np.ndarray, lens: np.ndarray):
     """BLAKE2b-256 of ``n`` extents of ``buf`` -> (n, 32) uint8 array, or
     ``None`` when the native library is unavailable (callers fall back).

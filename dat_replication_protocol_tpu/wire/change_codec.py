@@ -208,12 +208,26 @@ def decode_change(buf) -> Change:
     if fp is _FP_UNSET:
         fp = _fastpath_mod()
     if fp is not None:
-        try:
-            # C parser, differentially fuzzed against the Python loop
-            # below on random bytes (same records, same error class)
+        # C parser, differentially fuzzed against the Python loop below
+        # on random bytes (same records, same error class).  Routed by
+        # INSPECTION, not exception-sniffing: a strided numpy array
+        # raises ValueError (not BufferError) from the buffer protocol,
+        # which would be indistinguishable from a corrupt payload, and a
+        # multi-byte-itemsize view parses per-element on the Python path
+        # — both must keep their Python semantics.
+        t = type(buf)
+        if t is bytes or t is bytearray:
             return fp.decode_change_c(Change, buf)
-        except BufferError:
-            pass  # e.g. a strided memoryview: the Python parser copies
+        if t is memoryview:
+            mv = buf
+        else:
+            try:
+                mv = memoryview(buf)
+            except TypeError:
+                mv = None
+        if (mv is not None and mv.c_contiguous and mv.itemsize == 1
+                and mv.ndim == 1):
+            return fp.decode_change_c(Change, mv)
     return _decode_change_py(buf)
 
 

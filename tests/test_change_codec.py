@@ -257,3 +257,31 @@ def test_exotic_buffer_values_keep_parity():
     payload = encode_change(Change(key="kk", change=7, from_=0, to=1))
     doubled = bytes(b for byte in payload for b in (byte, 0))
     assert decode_change(memoryview(doubled)[::2]) == decode_change(payload)
+
+
+def test_decode_exotic_buffers_keep_python_semantics():
+    """Strided numpy arrays and multi-itemsize views must decode with
+    the Python parser's semantics regardless of whether the C extension
+    compiled (round-5 review: exception-sniffing mistook numpy's
+    non-contiguous ValueError for a corrupt payload)."""
+    import array
+
+    import numpy as np
+
+    from dat_replication_protocol_tpu.wire.change_codec import (
+        _decode_change_py,
+    )
+
+    payload = encode_change(Change(key="kk", change=7, from_=0, to=1,
+                                   value=b"xy"))
+    # strided ndarray view of a doubled payload
+    doubled = np.frombuffer(
+        bytes(b for byte in payload for b in (byte, 0)), dtype=np.uint8)
+    assert decode_change(doubled[::2]) == _decode_change_py(doubled[::2])
+    # contiguous ndarray still decodes
+    arr = np.frombuffer(payload, dtype=np.uint8)
+    assert decode_change(arr) == _decode_change_py(payload)
+    # multi-itemsize memoryview: per-element semantics preserved
+    a = array.array("I", [0x12, 1, ord("k"), 0x18, 1, 0x20, 0, 0x28, 1])
+    mv = memoryview(a)
+    assert decode_change(mv) == _decode_change_py(mv)
