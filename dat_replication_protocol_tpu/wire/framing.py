@@ -31,7 +31,11 @@ def frame_header(payload_len: int, type_id: int) -> bytes:
     The reference amortizes header allocation through a shared 65536-byte pool
     (reference: encode.js:6-7,124-137); in Python small-bytes construction is
     already pooled by the allocator, so the header is built directly.
+    Single-byte-varint frames (payload < 127 bytes — every digest reply
+    and most change records) skip the generic varint encoder.
     """
+    if payload_len < 127:
+        return bytes((payload_len + 1, type_id))
     return encode_uvarint(payload_len + 1) + bytes((type_id,))
 
 
