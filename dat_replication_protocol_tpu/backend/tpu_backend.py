@@ -289,18 +289,46 @@ class TpuDecoder(Decoder):
     def _emit_blob_digest(self, seq: int, digest: bytes) -> None:
         self._emit_digest("blob", seq, digest)
 
+    # ride the base bulk fast loop (C dispatch included): the ONLY
+    # per-change addition here is payload digesting, which the loop
+    # taps via _note_change_payloads — exactly the sink contract
+    _bulk_payload_sink = True
+
+    def _payload_sink_active(self) -> bool:
+        # collection (payload slicing + hashing) only when someone is
+        # listening — the streaming path's `if self._digest_cbs:` guard,
+        # bulk edition; sequence accounting advances either way
+        return bool(self._digest_cbs)
+
     def _deliver_change(self, change, payload) -> None:
         # hooked at _deliver_change (not _finish_change) so BOTH parse
         # paths — the streaming scanner and the native bulk index, which
         # skips _finish_change's re-parse — hash every change payload.
         # ``change`` may be None here (no handler registered; see the
         # base hook's private contract) — only ``payload`` is used.
+        # (The bulk fast loop bypasses this method entirely and delivers
+        # payloads through _note_change_payloads below.)
         if self._digest_cbs:
             seq = self._change_seq
             self._pipeline.submit(bytes(payload), self._emit_change_digest,
                                   seq)
         self._change_seq += 1
         super()._deliver_change(change, payload)
+
+    def _note_change_payloads(self, payloads, count: int) -> None:
+        # the bulk loop's tap: payloads arrive in delivery order for the
+        # whole run; per-seq submit order (and therefore digest delivery
+        # order) matches the per-frame path exactly
+        seq = self._change_seq
+        if payloads:
+            submit = self._pipeline.submit
+            emit = self._emit_change_digest
+            for p in payloads:
+                submit(p, emit, seq)
+                seq += 1
+            self._change_seq = seq
+        else:
+            self._change_seq = seq + count
 
     def _open_blob_if_ready(self) -> None:
         if self._digest_cbs:

@@ -162,7 +162,12 @@ static long get_long_attr(PyObject *o, PyObject *name, int *err) {
 
 // dispatch_changes(dec, board, cb_or_None, change_cls, buf,
 //                  ids, chg, frm, tov, koff, klen, soff, slen, voff,
-//                  vlen, f, row, n, st)
+//                  vlen, f, row, n, st, starts, lens, sink_or_None)
+// When ``sink`` is a list, each dispatched change's raw payload
+// (buf[starts[f] : starts[f]+lens[f]]) is appended as bytes — the
+// digest-decoder's bulk tap; the caller batch-submits them after the
+// run (ordering equivalent to per-frame submit: runs end before any
+// following blob frame is processed).
 // -> (new_f, new_row, status)  status: 0 ran to a non-change frame or
 // n; 1 stalled (armed ack / destroy / pause / pending); 2 a change
 // payload failed UTF-8 decoding — the message is left in
@@ -176,12 +181,21 @@ static PyObject *dispatch_changes(PyObject *, PyObject *args) {
     PyObject *dec, *board_o, *cb, *cls_o, *buf_o, *ids_o;
     PyObject *chg_o, *frm_o, *tov_o, *koff_o, *klen_o, *soff_o, *slen_o,
         *voff_o, *vlen_o, *st;
+    PyObject *starts_o = Py_None, *flens_o = Py_None, *sink_o = Py_None;
     Py_ssize_t f, row, n;
     if (!PyArg_ParseTuple(
-            args, "OOOOOOOOOOOOOOOnnnO", &dec, &board_o, &cb, &cls_o,
+            args, "OOOOOOOOOOOOOOOnnnO|OOO", &dec, &board_o, &cb, &cls_o,
             &buf_o, &ids_o, &chg_o, &frm_o, &tov_o, &koff_o, &klen_o,
-            &soff_o, &slen_o, &voff_o, &vlen_o, &f, &row, &n, &st))
+            &soff_o, &slen_o, &voff_o, &vlen_o, &f, &row, &n, &st,
+            &starts_o, &flens_o, &sink_o))
         return nullptr;
+    const bool have_sink = (sink_o != Py_None);
+    if (have_sink && (!PyList_CheckExact(sink_o) || starts_o == Py_None ||
+                      flens_o == Py_None)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "sink requires a list plus starts/lens buffers");
+        return nullptr;
+    }
     if (!PyObject_TypeCheck(board_o, &AckBoard_Type)) {
         PyErr_SetString(PyExc_TypeError, "board must be an AckBoard");
         return nullptr;
@@ -191,7 +205,7 @@ static PyObject *dispatch_changes(PyObject *, PyObject *args) {
     const bool have_cb = (cb != Py_None);
 
     View v_buf, v_ids, v_chg, v_frm, v_tov, v_koff, v_klen, v_soff,
-        v_slen, v_voff, v_vlen;
+        v_slen, v_voff, v_vlen, v_starts, v_flens;
     if (v_buf.acquire(buf_o) < 0 || v_ids.acquire(ids_o) < 0 ||
         v_chg.acquire(chg_o) < 0 || v_frm.acquire(frm_o) < 0 ||
         v_tov.acquire(tov_o) < 0 || v_koff.acquire(koff_o) < 0 ||
@@ -199,6 +213,13 @@ static PyObject *dispatch_changes(PyObject *, PyObject *args) {
         v_slen.acquire(slen_o) < 0 || v_voff.acquire(voff_o) < 0 ||
         v_vlen.acquire(vlen_o) < 0)
         return nullptr;
+    if (have_sink && (v_starts.acquire(starts_o) < 0 ||
+                      v_flens.acquire(flens_o) < 0))
+        return nullptr;
+    const int64_t *fstarts =
+        have_sink ? (const int64_t *)v_starts.buf.buf : nullptr;
+    const int64_t *flens =
+        have_sink ? (const int64_t *)v_flens.buf.buf : nullptr;
     const char *buf = (const char *)v_buf.buf.buf;
     const uint8_t *ids = (const uint8_t *)v_ids.buf.buf;
     const uint32_t *chg = (const uint32_t *)v_chg.buf.buf;
@@ -296,6 +317,17 @@ static PyObject *dispatch_changes(PyObject *, PyObject *args) {
         Py_XDECREF(to);
         if (bad) { Py_DECREF(ch); exc = (PyObject *)1; break; }
 
+        if (have_sink) {
+            PyObject *pl = PyBytes_FromStringAndSize(
+                buf + fstarts[f], (Py_ssize_t)flens[f]);
+            if (pl == nullptr || PyList_Append(sink_o, pl) < 0) {
+                Py_XDECREF(pl);
+                Py_DECREF(ch);
+                exc = (PyObject *)1;
+                break;
+            }
+            Py_DECREF(pl);
+        }
         row += 1;
         f += 1;
         changes += 1;

@@ -391,6 +391,30 @@ class Decoder:
 
     # -- parser --------------------------------------------------------------
 
+    # Subclass opt-in to the bulk fast loop: when True IN THE CLASS'S
+    # OWN __dict__ (the gate reads cls.__dict__, so the opt-in does NOT
+    # inherit), runs of change frames dispatch through
+    # _dispatch_changes_fast even though _deliver_change is overridden,
+    # and the raw payload of every dispatched change is handed to
+    # _note_change_payloads afterwards (the digest decoder's tap).  The
+    # contract: the declaring class's ONLY per-change addition is
+    # handler-independent payload work; a subclass must re-declare the
+    # flag to re-opt-in after auditing its own overrides.
+    _bulk_payload_sink = False
+
+    def _note_change_payloads(self, payloads, count: int) -> None:
+        """Bulk-path tap: ``payloads`` is the in-order list of raw change
+        payload bytes for the just-dispatched run (None when collection
+        was off), ``count`` the number of changes dispatched.  Called
+        after EVERY fast-loop run on sink-enabled subclasses — even with
+        collection off — so sequence bookkeeping can advance.
+        Base: no-op."""
+
+    def _payload_sink_active(self) -> bool:
+        """Whether the tap should actually COLLECT payloads (slicing
+        costs per frame); sequence accounting happens either way."""
+        return True
+
     # bulk path threshold: below this, the native round-trip (array
     # wrapping + index buffers) costs more than the per-byte scan saves.
     # 2048 measured (round 5): a transport writing ~4 KiB chunks leaves
@@ -572,6 +596,8 @@ class Decoder:
             "lens": lens[:n].tolist(),
             "ids": ids[:n].tolist(),
             "ids_np": np.ascontiguousarray(ids[:n]),
+            "starts_np": np.ascontiguousarray(starts[:n]),
+            "lens_np": np.ascontiguousarray(lens[:n]),
             "n": n,
             "consumed": int(consumed.value),
             "f": 0,
@@ -611,8 +637,14 @@ class Decoder:
         rows_l = self._cols_lists(st) if have_cols else None
         f = st["f"]
         n = st["n"]
+        cls = type(self)
+        # the sink opt-in is deliberately NON-inheritable (__dict__, not
+        # attribute lookup): a subclass overriding _deliver_change would
+        # otherwise silently lose its override on bulk writes while
+        # keeping it on chunked ones
         fast = (have_cols
-                and type(self)._deliver_change is Decoder._deliver_change)
+                and (cls._deliver_change is Decoder._deliver_change
+                     or cls.__dict__.get("_bulk_payload_sink", False)))
         while f < n:
             if self._stalled() or self.destroyed:
                 st["f"] = f
@@ -724,10 +756,14 @@ class Decoder:
         identical to the general loop; ``self.changes`` is incremented
         before each handler call exactly as ``_deliver_change`` does.
         """
+        use_tap = type(self).__dict__.get("_bulk_payload_sink", False)
+        collect = use_tap and self._payload_sink_active()
+        row0 = st["row"]
         fp = _fastpath_mod()
         if fp is not None:
             if self._ack_board is None:
                 self._ack_board = fp.AckBoard()
+            sink = [] if collect else None
             try:
                 # handler exceptions propagate from here as themselves
                 # (the C loop reports WIRE decode errors via status 2,
@@ -737,12 +773,20 @@ class Decoder:
                     self, self._ack_board, self._on_change,
                     Change, st["buf"], st["ids_np"], *st["cols_np"],
                     f, st["row"], st["n"], st,
+                    st["starts_np"] if collect else None,
+                    st["lens_np"] if collect else None,
+                    sink,
                 )
             finally:
                 # the C loop runs at a frame boundary throughout (same
-                # invariant as the Python loop's finally below)
+                # invariant as the Python loop's finally below); the
+                # sink drains even when a handler raised — those
+                # changes WERE delivered, so their digests are owed
+                # (matching the streaming path's submit-before-deliver)
                 self._missing = 0
                 self._state = TYPE_HEADER
+                if use_tap:
+                    self._note_change_payloads(sink, st["row"] - row0)
             if status == 2:
                 self.destroy(ProtocolError(
                     st.pop("decode_error", "invalid change payload")))
@@ -753,6 +797,9 @@ class Decoder:
             bbuf = st["bbuf"] = bytes(st["buf"])
         rows = self._cols_lists(st)
         ids = st["ids"]
+        fstarts = st["starts"]
+        flens = st["lens"]
+        sink = [] if collect else None
         n = st["n"]
         row = st["row"]
         on_change = self._on_change
@@ -777,6 +824,9 @@ class Decoder:
                 except ValueError as e:  # incl. UnicodeDecodeError
                     self.destroy(ProtocolError(str(e)))
                     return f
+                if sink is not None:  # valid frame: its digest is owed
+                    fs = fstarts[f]
+                    sink.append(bbuf[fs : fs + flens[f]])
                 row += 1
                 f += 1
                 self.changes += 1
@@ -798,6 +848,8 @@ class Decoder:
             st["row"] = row
             self._missing = 0
             self._state = TYPE_HEADER
+            if use_tap:
+                self._note_change_payloads(sink, row - row0)
         return f
 
     def _consume_chunk(self, chunk: memoryview) -> memoryview | None:

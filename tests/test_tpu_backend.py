@@ -228,3 +228,108 @@ def test_prefer_host_override_combinations(monkeypatch):
     monkeypatch.delenv("X_ROUTE", raising=False)
     # test env configures the cpu platform (conftest): host wins
     assert prefer_host("X_ROUTE") is True
+
+
+@pytest.mark.parametrize("dispatch", ["c", "python"])
+def test_bulk_sink_digests_match_streaming_path(dispatch, monkeypatch):
+    """backend='tpu' decoding must produce the identical digest sequence
+    (kind, seq, digest) whether frames arrive in one bulk write (the
+    C/Python fast loop's payload sink) or byte-dribbled through the
+    streaming scanner — and interleaved blobs must keep their relative
+    order.  Runs against BOTH fast-loop implementations."""
+    import os
+
+    if dispatch == "python":
+        monkeypatch.setenv("DAT_FASTPATH_DISABLE", "1")
+
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.wire.change_codec import encode_change
+    from dat_replication_protocol_tpu.wire.framing import (
+        TYPE_BLOB,
+        TYPE_CHANGE,
+        frame,
+    )
+
+    os.environ.setdefault("DAT_DEVICE_HASH", "0")
+    parts = []
+    for i in range(300):
+        parts.append(frame(TYPE_CHANGE, encode_change({
+            "key": f"k{i}", "change": i, "from": i, "to": i + 1,
+            "value": bytes([i & 255]) * (i % 40)})))
+        if i % 13 == 0:
+            parts.append(frame(TYPE_BLOB, bytes([i & 255]) * (i % 500 + 1)))
+    wire = b"".join(parts)
+
+    def drive(chunk):
+        dec = protocol.decode(backend="tpu")
+        got = []
+        dec.on_digest(lambda k, s, d: got.append((k, s, d)))
+        dec.change(lambda ch, done: done())
+        dec.blob(lambda b, done: b.collect(lambda _d: done()))
+        for off in range(0, len(wire), chunk):
+            dec.write(wire[off:off + chunk])
+        dec.end()
+        assert dec.finished
+        return got
+
+    bulk = drive(len(wire))
+    tiny = drive(7)
+    assert bulk == tiny
+    assert len(bulk) == 300 + sum(1 for i in range(300) if i % 13 == 0)
+    # per-kind seqs are each contiguous from 0
+    for kind in ("change", "blob"):
+        seqs = [s for k, s, _ in bulk if k == kind]
+        assert seqs == list(range(len(seqs)))
+
+
+def test_digestless_tpu_decoder_never_hashes_on_bulk():
+    """No on_digest registered -> the bulk sink must not collect or hash
+    anything (the streaming path's digest_cbs guard, bulk edition)."""
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.wire.change_codec import encode_change
+    from dat_replication_protocol_tpu.wire.framing import TYPE_CHANGE, frame
+
+    wire = b"".join(frame(TYPE_CHANGE, encode_change({
+        "key": f"k{i}", "change": i, "from": i, "to": i + 1}))
+        for i in range(500))
+    dec = protocol.decode(backend="tpu")
+    seen = []
+    dec.change(lambda ch, done: (seen.append(ch.key), done()))
+    dec.write(wire)
+    dec.end()
+    assert dec.finished and len(seen) == 500
+    assert dec.digest_pipeline.hashed_bytes == 0
+    assert dec.digest_pipeline.dispatches == 0
+    # seq accounting still advanced (a late-registered digest consumer
+    # keeps correct sequence numbers)
+    assert dec._change_seq == 500
+
+
+def test_tpu_decoder_subclass_override_fires_on_bulk_writes():
+    """The sink opt-in must NOT inherit: a subclass overriding
+    _deliver_change gets its override on bulk writes too (round-5
+    review: an inherited flag silently bypassed overrides only for
+    large writes)."""
+    import dat_replication_protocol_tpu as protocol  # noqa: F401
+    from dat_replication_protocol_tpu.backend.tpu_backend import TpuDecoder
+    from dat_replication_protocol_tpu.wire.change_codec import encode_change
+    from dat_replication_protocol_tpu.wire.framing import TYPE_CHANGE, frame
+
+    hooked = []
+
+    class MyDecoder(TpuDecoder):
+        def _deliver_change(self, change, payload):
+            hooked.append(bytes(payload))
+            super()._deliver_change(change, payload)
+
+    wire = b"".join(frame(TYPE_CHANGE, encode_change({
+        "key": f"k{i}", "change": i, "from": i, "to": i + 1}))
+        for i in range(300))
+    dec = MyDecoder()
+    seen = []
+    dec.change(lambda ch, done: (seen.append(ch.key), done()))
+    dec.write(wire)  # one big write: would ride the fast loop if the
+    dec.end()        # flag inherited
+    assert dec.finished
+    assert len(seen) == 300
+    assert len(hooked) == 300, "override bypassed on the bulk path"
