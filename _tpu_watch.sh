@@ -1,10 +1,19 @@
 #!/bin/bash
 # Probe the tunnel every ~5 min (subprocess probe, 100 s cap — a wedged
-# tunnel hangs rather than erroring); the moment a probe EXECUTES a
-# device op, fire _when_tpu_returns.sh once and exit.  Round-3/4 wedge
-# signature: platform initializes, first compute hangs forever.
+# tunnel hangs rather than erroring); whenever a probe EXECUTES a device
+# op, fire _when_tpu_returns.sh.  Round-5 change: the loop RE-ARMS
+# after firing — rounds 3 and 4 both saw windows die mid-agenda, and a
+# one-shot loop wastes any later window.  The agenda's legs are
+# individually resumable (.leg_*_done markers), so a re-fire only runs
+# what is still missing; the loop exits once every leg is done.
 cd "$(dirname "$0")"
+OUT=artifacts/r05_watch
 while true; do
+  if [ -f "$OUT/.leg_quick_done" ] && [ -f "$OUT/.leg_full_done" ] \
+     && [ -f "$OUT/.leg_observe_done" ] && [ -f "$OUT/.leg_reconcile_done" ]; then
+    echo "$(date -u) all agenda legs captured; watch retiring" >> /tmp/tpu_watch.log
+    exit 0
+  fi
   if timeout 100 python -c "
 import jax, numpy as np, jax.numpy as jnp
 x = np.asarray(jnp.arange(8) * 2)
@@ -12,7 +21,10 @@ assert x[3] == 6
 " >/dev/null 2>&1; then
     echo "$(date -u) tunnel answered; firing capture" >> /tmp/tpu_watch.log
     bash _when_tpu_returns.sh >> /tmp/tpu_watch.log 2>&1
-    exit 0
+    # brief pause, then keep probing: if the window died mid-agenda the
+    # next healthy probe re-fires the remaining legs
+    sleep 60
+    continue
   fi
   echo "$(date -u) probe failed" >> /tmp/tpu_watch.log
   sleep 300
