@@ -946,7 +946,17 @@ class Decoder:
         self.changes += 1
         self._state = TYPE_HEADER
         if self._on_change is not None:
-            self._on_change(change, self._up())
+            # same deferred-arm ack as the bulk fast loop: a sync ack
+            # (the common case) never touches the pending counter, and
+            # the lock arbitrates the cross-thread handler-returned vs
+            # done() race exactly as there
+            ack = _FastAck(self)
+            self._on_change(change, ack)
+            if ack.state != 1:
+                with self._ack_lock:
+                    if ack.state == 0:
+                        ack.state = 2  # armed: handler went async
+                        self._pending += 1
         # default: drop (reference: decode.js:54-56)
 
     # -- blob frames ---------------------------------------------------------
