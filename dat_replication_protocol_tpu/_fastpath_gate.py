@@ -1,0 +1,35 @@
+"""The ONE lazy gate to the ``dat_fastpath`` C extension.
+
+Both hot-path consumers (``wire.change_codec`` serialization and
+``session.decoder`` bulk dispatch) must route through
+:func:`.runtime.fastpath.get` so the ``DAT_FASTPATH_DISABLE`` decision
+is made in exactly one place, re-read per call (the round-5 split-brain
+had two private caches freeze the decision independently).  Neither
+consumer can import ``runtime.fastpath`` at module load — the
+``runtime -> replay -> change_codec`` import cycle — so this module
+holds the shared lazy binding instead of each keeping its own copy:
+two independent wrappers are precisely the drift surface that produced
+the split-brain, and datlint's env-cache-policy rule cannot see a fork
+that never touches ``os.environ`` itself.
+
+Only the bound ``get`` FUNCTION is cached here (a per-call
+``from .runtime import`` costs ~1.8us of import machinery — real money
+next to a ~4us encode); the env decision stays inside ``get``.
+"""
+
+from __future__ import annotations
+
+_get = None  # lazily-bound runtime.fastpath.get (import cycle)
+
+
+def fastpath_mod():
+    """The dat_fastpath C extension module, or ``None`` (missing
+    toolchain, or ``DAT_FASTPATH_DISABLE`` set — re-read every call so
+    tests can exercise both implementations in one process)."""
+    global _get
+    get = _get
+    if get is None:
+        from .runtime import fastpath
+
+        get = _get = fastpath.get
+    return get()

@@ -1,0 +1,69 @@
+"""CLI: ``python -m dat_replication_protocol_tpu.analysis [paths...]``.
+
+Exits 0 when clean, 1 on findings, 2 on usage errors — shaped so the
+tier-1 suite (tests/test_datlint_repo_clean.py) and any pre-merge hook
+can gate on it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .engine import Project, run_project
+from .rules import ALL_RULES, rule_by_name
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dat_replication_protocol_tpu.analysis",
+        description="datlint: protocol-invariant static analysis "
+                    "(rules and incidents: ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to analyze (default: this package)")
+    parser.add_argument(
+        "--rule", action="append", default=None, metavar="NAME",
+        help="run only this rule (repeatable)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule names and one-line descriptions, then exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.name}: {rule.description}")
+        return 0
+
+    rules = ALL_RULES
+    if args.rule:
+        try:
+            rules = [rule_by_name(name) for name in args.rule]
+        except KeyError as e:
+            print(f"datlint: unknown rule {e.args[0]!r} "
+                  f"(--list-rules shows the registry)", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [Path(__file__).resolve().parent.parent]
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"datlint: no such path: {', '.join(map(str, missing))}",
+              file=sys.stderr)
+        return 2
+
+    project = Project.from_paths(paths)
+    findings = run_project(project, rules)
+    for f in findings:
+        print(f.render())
+    n_files = len(project.sources)
+    if findings:
+        print(f"datlint: {len(findings)} finding(s) in {n_files} file(s)")
+        return 1
+    print(f"datlint: clean ({n_files} files, {len(rules)} rules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+"""datlint core: sources, findings, suppressions, and the rule runner.
+
+The engine is deliberately dependency-free (``ast`` + ``tokenize`` +
+``re``): it must run in the same stripped CI image as the tier-1 tests,
+before any native toolchain or JAX initialization.
+
+Two source kinds flow through a :class:`Project`:
+
+* Python files are parsed to AST once and shared by every rule;
+  comments (for rule declarations and suppressions) come from
+  ``tokenize`` so that string literals containing ``datlint:`` markers
+  can never activate or suppress anything.
+* C/C++ files are kept as raw text; rules that read them (the
+  wire-constant parity check) do their own regex extraction, and
+  suppressions are recognized in ``//`` / ``/* */`` comments.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Iterator
+
+_PY_SUFFIXES = (".py",)
+_C_SUFFIXES = (".c", ".cc", ".cpp", ".h", ".hpp")
+# build products and caches never carry protocol logic
+_SKIP_DIRS = {"_build", "__pycache__", ".git", ".pytest_cache"}
+
+_SUPPRESS_RE = re.compile(r"datlint:\s*disable=([\w,*-]+)")
+_SUPPRESS_FILE_RE = re.compile(r"datlint:\s*disable-file=([\w,*-]+)")
+_C_COMMENT_RE = re.compile(r"//.*$|/\*.*?\*/")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at a specific source location."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+class SourceFile:
+    """A lazily-parsed source file plus its datlint comment markers."""
+
+    def __init__(self, path: Path, text: str, is_python: bool):
+        self.path = path
+        self.text = text
+        self.is_python = is_python
+        self._tree: ast.Module | None = None
+        self._parse_error: SyntaxError | None = None
+        # line -> set of rule names suppressed on that line
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        # line -> raw comment text (Python only; rules parse declarations
+        # such as coupled-state sets out of these)
+        self.comments: dict[int, str] = {}
+        self._scan_markers()
+
+    # -- parsing -----------------------------------------------------------
+
+    @property
+    def tree(self) -> ast.Module | None:
+        """The module AST, or None for C sources / unparsable Python."""
+        if not self.is_python:
+            return None
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    @property
+    def parse_error(self) -> SyntaxError | None:
+        _ = self.tree
+        return self._parse_error
+
+    # -- markers -----------------------------------------------------------
+
+    def _scan_markers(self) -> None:
+        lines = self.text.splitlines()
+        if self.is_python:
+            try:
+                tokens = tokenize.generate_tokens(
+                    io.StringIO(self.text).readline)
+                for tok in tokens:
+                    if tok.type == tokenize.COMMENT:
+                        line = tok.start[0]
+                        self.comments[line] = tok.string
+                        self._note_suppressions(line, tok.string)
+                        # a comment-only line also covers the line below,
+                        # so long statements can carry a suppression
+                        # without blowing the line length
+                        if lines[line - 1][:tok.start[1]].strip() == "":
+                            self._note_suppressions(line + 1, tok.string)
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass  # rules that need the AST will surface the error
+        else:
+            for i, line in enumerate(lines, start=1):
+                for m in _C_COMMENT_RE.finditer(line):
+                    self._note_suppressions(i, m.group(0))
+                    if line[:m.start()].strip() == "":
+                        self._note_suppressions(i + 1, m.group(0))
+
+    def _note_suppressions(self, line: int, comment: str) -> None:
+        m = _SUPPRESS_FILE_RE.search(comment)
+        if m:
+            self.file_suppressions.update(m.group(1).split(","))
+        m = _SUPPRESS_RE.search(comment)
+        if m:
+            self.line_suppressions.setdefault(line, set()).update(
+                m.group(1).split(","))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if {rule, "all", "*"} & self.file_suppressions:
+            return True
+        on_line = self.line_suppressions.get(line, ())
+        return rule in on_line or "all" in on_line or "*" in on_line
+
+
+class Project:
+    """The file set one analysis run operates over."""
+
+    def __init__(self, py_sources: list[SourceFile],
+                 c_sources: list[SourceFile]):
+        self.py_sources = py_sources
+        self.c_sources = c_sources
+
+    @property
+    def sources(self) -> list[SourceFile]:
+        return self.py_sources + self.c_sources
+
+    @classmethod
+    def from_paths(cls, paths: Iterable[str | Path]) -> "Project":
+        py: list[SourceFile] = []
+        cc: list[SourceFile] = []
+        seen: set[Path] = set()
+        for root in paths:
+            root = Path(root)
+            files: Iterator[Path]
+            if root.is_file():
+                files = iter([root])
+            else:
+                files = (p for p in sorted(root.rglob("*")) if p.is_file())
+            for p in files:
+                if p in seen or any(part in _SKIP_DIRS for part in p.parts):
+                    continue
+                seen.add(p)
+                if p.suffix in _PY_SUFFIXES:
+                    kind = py, True
+                elif p.suffix in _C_SUFFIXES:
+                    kind = cc, False
+                else:
+                    continue
+                try:
+                    text = p.read_text(encoding="utf-8", errors="replace")
+                except OSError:
+                    continue
+                kind[0].append(SourceFile(p, text, kind[1]))
+        return cls(py, cc)
+
+
+def run_project(project: Project, rules: Iterable) -> list[Finding]:
+    """Run ``rules`` over ``project``; returns unsuppressed findings,
+    sorted by (path, line)."""
+    by_path = {str(s.path): s for s in project.sources}
+    out: list[Finding] = []
+    for rule in rules:
+        for f in rule.check(project):
+            src = by_path.get(f.path)
+            if src is not None and src.suppressed(f.rule, f.line):
+                continue
+            out.append(f)
+    # a Python file the analyzer cannot parse hides every AST rule: that
+    # is itself a finding, not a silent skip
+    for s in project.py_sources:
+        if s.parse_error is not None:
+            out.append(Finding(
+                path=str(s.path),
+                line=s.parse_error.lineno or 1,
+                rule="parse-error",
+                message=f"unparsable Python: {s.parse_error.msg}",
+            ))
+    return sorted(out)
+
+
+def run_paths(paths: Iterable[str | Path], rules=None) -> list[Finding]:
+    from .rules import ALL_RULES
+
+    return run_project(Project.from_paths(paths),
+                       ALL_RULES if rules is None else rules)
+
+
+# -- shared AST helpers used by several rules -------------------------------
+
+def canonical(expr: str | ast.AST) -> str:
+    """Canonical source form of an expression (quote/space normalized),
+    so declared coupled-state members compare equal to AST targets."""
+    if isinstance(expr, str):
+        expr = ast.parse(expr, mode="eval").body
+    return ast.unparse(expr)
+
+
+def assign_targets(node: ast.AST) -> Iterator[ast.expr]:
+    """Flattened assignment targets of one statement (tuple unpacking
+    included); empty for non-assignment statements."""
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    else:
+        return
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            yield from t.elts
+        else:
+            yield t
+
+
+def walk_function_body(fn: ast.AST) -> Iterator[ast.AST]:
+    """Every node lexically inside ``fn``'s own body, NOT descending into
+    nested function/class definitions (those are separate scopes and are
+    analyzed on their own)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

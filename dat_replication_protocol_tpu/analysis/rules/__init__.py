@@ -1,0 +1,30 @@
+"""The datlint rule registry.
+
+Each rule is distilled from a real incident in this repo (ANALYSIS.md
+links each to its ADVICE.md finding); adding a rule means adding a
+module here plus a known-bad/known-good fixture pair in
+``tests/test_datlint.py``.
+"""
+
+from __future__ import annotations
+
+from .cursor_coherence import CursorCoherence
+from .env_cache import EnvCachePolicy
+from .jit_purity import JitPurity
+from .unbounded_join import UnboundedJoin
+from .wire_constants import WireConstantParity
+
+ALL_RULES = (
+    CursorCoherence(),
+    EnvCachePolicy(),
+    UnboundedJoin(),
+    JitPurity(),
+    WireConstantParity(),
+)
+
+
+def rule_by_name(name: str):
+    for rule in ALL_RULES:
+        if rule.name == name:
+            return rule
+    raise KeyError(name)

@@ -217,10 +217,13 @@ def leaves_from_columns(cols, frames=None) -> np.ndarray:
 
         sel = frames.ids == TYPE_CHANGE
         return hash_extents(frames.buf, frames.starts[sel], frames.lens[sel])
-    # otherwise hash each record's re-encoded bytes (rarely needed)
-    from ..wire.change_codec import encode_change
+    # otherwise hash each record's re-encoded bytes (rarely needed) —
+    # gate resolved once for the loop, same as replay's bulk encoders
+    from ..wire.change_codec import _encode_change_with, _fastpath_mod
 
-    payloads = [encode_change(cols.row(i)) for i in range(len(cols))]
+    fp = _fastpath_mod()
+    payloads = [_encode_change_with(fp, cols.row(i))
+                for i in range(len(cols))]
     return np.frombuffer(
         b"".join(blake2b.blake2b_batch(payloads)), dtype=np.uint8
     ).reshape(len(payloads), 32)

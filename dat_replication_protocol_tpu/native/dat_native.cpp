@@ -23,6 +23,8 @@ namespace {
 
 // Decode one unsigned LEB128 varint at buf[i..len).  Returns the number of
 // bytes consumed (0 = truncated, -1 = overlong/>10 bytes).
+// The 10-byte cap is the wire limit shared with wire/varint.py; datlint's
+// wire-constant-parity rule cross-checks it:  // wire: MAX_VARINT_LEN = 10
 inline int read_uvarint(const uint8_t* buf, int64_t i, int64_t len,
                         uint64_t* out) {
   uint64_t v = 0;

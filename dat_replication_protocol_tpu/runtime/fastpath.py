@@ -19,6 +19,8 @@ import threading
 from pathlib import Path
 
 _SRC = Path(__file__).resolve().parent.parent / "native" / "dat_fastpath.cpp"
+# location config, not behavior gating: where build products land may
+# freeze at import  # datlint: disable=env-cache-policy
 _BUILD_DIR = Path(
     os.environ.get(
         "DAT_NATIVE_BUILD_DIR",
@@ -60,28 +62,55 @@ def _build() -> Path | None:
 
 
 def get():
-    """The extension module, building it on first call; None if
-    unavailable (callers fall back to the Python dispatch loop)."""
+    """The extension module, or None (callers fall back to the Python
+    dispatch loop).
+
+    THE fast-path gate — the decoder's dispatch loop and the wire
+    codec's encode/decode both route through here so one process has
+    exactly one policy (the round-5 advisor found the two layers had
+    grown caches with opposite policies, a split-brain where flipping
+    ``DAT_FASTPATH_DISABLE`` mid-process disabled one C path and not
+    the other).  Policy: the DISABLE env var is re-read on EVERY call
+    (so tests can exercise both implementations in one process); only
+    the expensive build+import is cached.  A first call made while
+    disabled does not poison the cache — enabling later still builds.
+    """
+    if os.environ.get("DAT_FASTPATH_DISABLE"):
+        return None
+    if _tried:  # lock-free hot path: _mod is set before _tried
+        return _mod
+    return _load_once()
+
+
+def _load_once():
     global _mod, _tried
     with _lock:
         if _tried:
             return _mod
-        _tried = True
-        if os.environ.get("DAT_FASTPATH_DISABLE"):
-            return None
+        mod = None
         so = _build()
-        if so is None:
-            return None
-        try:
-            import importlib.util
+        if so is not None:
+            try:
+                import importlib.util
 
-            spec = importlib.util.spec_from_file_location(
-                "dat_fastpath", str(so))
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            _mod = mod
-        except Exception as e:  # load/ABI failure: fall back, once
-            print(f"dat_fastpath load failed ({e}); using the Python loop",
-                  file=sys.stderr)
-            _mod = None
+                spec = importlib.util.spec_from_file_location(
+                    "dat_fastpath", str(so))
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+            except Exception as e:  # load/ABI failure: fall back, once
+                print(f"dat_fastpath load failed ({e}); using the Python "
+                      f"loop", file=sys.stderr)
+                mod = None
+        _mod = mod
+        _tried = True
         return _mod
+
+
+def reset_for_tests():
+    """Drop the cached import so the next :func:`get` re-decides from a
+    clean slate (build cache on disk is untouched).  Test hook only:
+    live Decoder/Encoder objects keep references to the old module."""
+    global _mod, _tried
+    with _lock:
+        _mod = None
+        _tried = False

@@ -22,6 +22,8 @@ from pathlib import Path
 import numpy as np
 
 _SRC = Path(__file__).resolve().parent.parent / "native" / "dat_native.cpp"
+# location config, not behavior gating: where build products land may
+# freeze at import  # datlint: disable=env-cache-policy
 _BUILD_DIR = Path(
     os.environ.get(
         "DAT_NATIVE_BUILD_DIR",
@@ -122,24 +124,47 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def get_lib() -> ctypes.CDLL | None:
-    """The bound native library, building it on first call; None if
-    unavailable (callers fall back to Python)."""
+    """The bound native library, or None (callers fall back to Python).
+
+    Same gating policy as :func:`runtime.fastpath.get` (the shared
+    env-cache policy datlint's env-cache-policy rule enforces): the
+    DISABLE env var is re-read every call, only the build+load is
+    cached, and a call made while disabled does not poison the cache.
+    """
+    if os.environ.get("DAT_NATIVE_DISABLE"):
+        return None
+    if _tried:  # lock-free hot path: _lib is set before _tried
+        return _lib
+    return _load_once()
+
+
+def _load_once() -> ctypes.CDLL | None:
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
-        _tried = True
-        if os.environ.get("DAT_NATIVE_DISABLE"):
-            return None
+        lib = None
         so = _build()
         if so is not None:
             try:
-                _lib = _bind(ctypes.CDLL(str(so)))
+                lib = _bind(ctypes.CDLL(str(so)))
             except OSError as e:
                 print(f"dat_native load failed ({e}); using Python fallbacks",
                       file=sys.stderr)
-                _lib = None
+                lib = None
+        _lib = lib
+        _tried = True
         return _lib
+
+
+def reset_for_tests() -> None:
+    """Drop the cached load so the next :func:`get_lib` re-decides (disk
+    build cache untouched); the fastpath twin is
+    :func:`runtime.fastpath.reset_for_tests`."""
+    global _lib, _tried
+    with _lock:
+        _lib = None
+        _tried = False
 
 
 def available() -> bool:

@@ -29,30 +29,17 @@ import threading
 from collections import deque
 from typing import Callable, Optional
 
+from .._fastpath_gate import fastpath_mod as _fastpath_mod
 from ..wire.change_codec import Change, decode_change
 from ..wire.framing import MAX_HEADER_LEN, TYPE_BLOB, TYPE_CHANGE, TYPE_HEADER, ProtocolError
 from ..wire.varint import decode_uvarint
 
 OnDone = Optional[Callable[[], None]]
 
-_FP_UNSET = object()
-_fp_cache = _FP_UNSET
-
-
-def _fastpath_mod():
-    """The dat_fastpath C extension, or None (module cached; the DISABLE
-    env var is re-read every call so tests can exercise both dispatch
-    implementations in one process)."""
-    import os
-
-    if os.environ.get("DAT_FASTPATH_DISABLE"):
-        return None
-    global _fp_cache
-    if _fp_cache is _FP_UNSET:
-        from ..runtime import fastpath
-
-        _fp_cache = fastpath.get()
-    return _fp_cache
+# The bulk-path cursor: frame index and columnar row MUST advance
+# together — a frame paired with the wrong row's columns is silent wire
+# corruption (round-5 advisor, high).  Machine-checked:
+# datlint: coupled-state st["f"], st["row"]
 
 
 class DecoderDestroyedError(Exception):
@@ -505,6 +492,16 @@ class Decoder:
         self._overflow.appendleft(mv)
         self._overflow_bytes += len(mv)
 
+    def _requeue_tail(self, rest) -> None:
+        """A handler raised while this chunk's unparsed remainder lived
+        only in a delivery-site local: requeue it so a caught
+        raise-then-resume continues with the NEXT frame instead of
+        silently dropping every frame after the raising one in the same
+        write (the streaming analogue of the bulk path's parked cursor,
+        which preserves its tail in st)."""
+        if len(rest):
+            self._ov_appendleft(rest)
+
     def _merged_overflow(self) -> memoryview | None:
         """Pop ALL queued overflow as one contiguous memoryview."""
         if not self._overflow:
@@ -636,6 +633,7 @@ class Decoder:
         have_cols = st["cols_np"] is not None
         rows_l = self._cols_lists(st) if have_cols else None
         f = st["f"]
+        row = st["row"]
         n = st["n"]
         cls = type(self)
         # the sink opt-in is deliberately NON-inheritable (__dict__, not
@@ -645,94 +643,125 @@ class Decoder:
         fast = (have_cols
                 and (cls._deliver_change is Decoder._deliver_change
                      or cls.__dict__.get("_bulk_payload_sink", False)))
-        while f < n:
-            if self._stalled() or self.destroyed:
-                st["f"] = f
-                return
-            type_id = ids[f]
-            if fast and type_id == TYPE_CHANGE:
-                f = self._dispatch_changes_fast(st, f)
-                if self.destroyed:
-                    self._bulk = None
+        try:
+            while f < n:
+                if self._stalled() or self.destroyed:
                     return
-                continue
-            start = starts[f]
-            flen = lens[f]
-            self._missing = flen
-            if type_id == TYPE_CHANGE:
-                row = st["row"]
-                if have_cols:
-                    (cg, fr, to, ko, kl, so, sl, vo, vl) = rows_l[row]
-                    if self._on_change is not None:
-                        try:
-                            change = Change(
-                                key=str(buf[ko : ko + kl], "utf-8"),
-                                change=cg,
-                                from_=fr,
-                                to=to,
-                                value=(bytes(buf[vo : vo + vl])
-                                       if vl >= 0 else b""),
-                                subset=(str(buf[so : so + sl], "utf-8")
-                                        if sl >= 0 else ""),
-                            )
-                        except ValueError as e:  # incl. UnicodeDecodeError
-                            self._bulk = None
-                            self.destroy(ProtocolError(str(e)))
-                            return
-                    else:
-                        # no registered handler will ever see the object
-                        # (the default drops changes) — but the payload
-                        # must still be VALID: the key's UTF-8 check is
-                        # the one observable part of construction, and a
-                        # digest-only subclass (TpuDecoder with no change
-                        # handler — the sidecar's shape) still needs the
-                        # wire error.  ``change=None`` is a documented
-                        # private contract of _deliver_change.
-                        try:
-                            str(buf[ko : ko + kl], "utf-8")
-                            if sl >= 0:
-                                str(buf[so : so + sl], "utf-8")
-                        except ValueError as e:
-                            self._bulk = None
-                            self.destroy(ProtocolError(str(e)))
-                            return
-                        change = None
-                    st["row"] = row + 1
-                    self._missing = 0
-                    self._deliver_change(change, buf[start : start + flen])
-                else:
-                    st["row"] = row + 1
-                    self._state = TYPE_CHANGE
-                    self._payload_parts = None
-                    self._change_data(buf[start : start + flen])
-            elif type_id == TYPE_BLOB:
-                if not st["blob_open"]:
-                    self._state = TYPE_BLOB
-                    self._current_blob = None
-                    self._open_blob_if_ready()
-                    st["blob_open"] = True
+                type_id = ids[f]
+                if fast and type_id == TYPE_CHANGE:
+                    try:
+                        # return value deliberately unused: the st
+                        # write-back is the one cursor-handoff channel
+                        # (it is what survives handler raises)
+                        self._dispatch_changes_fast(st, f)
+                    finally:
+                        # the fast loops (C and Python) write BOTH
+                        # cursors into st — on their raise path too;
+                        # resync the locals so the outer finally below
+                        # cannot clobber st with stale values
+                        f, row = st["f"], st["row"]
                     if self.destroyed:
                         self._bulk = None
                         return
-                    # a handler that pause()d synchronously must not
-                    # receive the payload until it resumes — same as the
-                    # streaming path parking the chunk undelivered
-                    if flen and self._stalled():
-                        st["f"] = f
-                        return
-                if flen:
-                    self._blob_data(buf[start : start + flen])
-                st["blob_open"] = False
-            else:
-                self._bulk = None
-                self.destroy(
-                    ProtocolError(f"Protocol error, unknown type: {type_id}")
-                )
-                return
-            if self.destroyed:
-                self._bulk = None
-                return
-            f += 1
+                    continue
+                start = starts[f]
+                flen = lens[f]
+                self._missing = flen
+                if type_id == TYPE_CHANGE:
+                    if have_cols:
+                        (cg, fr, to, ko, kl, so, sl, vo, vl) = rows_l[row]
+                        if self._on_change is not None:
+                            try:
+                                change = Change(
+                                    key=str(buf[ko : ko + kl], "utf-8"),
+                                    change=cg,
+                                    from_=fr,
+                                    to=to,
+                                    value=(bytes(buf[vo : vo + vl])
+                                           if vl >= 0 else b""),
+                                    subset=(str(buf[so : so + sl], "utf-8")
+                                            if sl >= 0 else ""),
+                                )
+                            except ValueError as e:  # incl. UnicodeDecodeError
+                                self._bulk = None
+                                self.destroy(ProtocolError(str(e)))
+                                return
+                        else:
+                            # no registered handler will ever see the object
+                            # (the default drops changes) — but the payload
+                            # must still be VALID: the key's UTF-8 check is
+                            # the one observable part of construction, and a
+                            # digest-only subclass (TpuDecoder with no change
+                            # handler — the sidecar's shape) still needs the
+                            # wire error.  ``change=None`` is a documented
+                            # private contract of _deliver_change.
+                            try:
+                                str(buf[ko : ko + kl], "utf-8")
+                                if sl >= 0:
+                                    str(buf[so : so + sl], "utf-8")
+                            except ValueError as e:
+                                self._bulk = None
+                                self.destroy(ProtocolError(str(e)))
+                                return
+                            change = None
+                        # delivery consumes the frame: advance BOTH
+                        # cursor halves before the handler can raise —
+                        # the finally below persists them together, so
+                        # a raise-then-resume re-enters at the next
+                        # frame with row still paired to it
+                        row += 1
+                        f += 1
+                        self._missing = 0
+                        self._deliver_change(change, buf[start : start + flen])
+                    else:
+                        row += 1
+                        f += 1
+                        self._state = TYPE_CHANGE
+                        self._payload_parts = None
+                        self._change_data(buf[start : start + flen])
+                elif type_id == TYPE_BLOB:
+                    if not st["blob_open"]:
+                        self._state = TYPE_BLOB
+                        self._current_blob = None
+                        # opened-state advances WITH the side effect: a
+                        # blob handler that raises must not re-open (and
+                        # re-count) the same blob on resume
+                        st["blob_open"] = True
+                        self._open_blob_if_ready()
+                        if self.destroyed:
+                            self._bulk = None
+                            return
+                        # a handler that pause()d synchronously must not
+                        # receive the payload until it resumes — same as
+                        # the streaming path parking the chunk undelivered
+                        if flen and self._stalled():
+                            return
+                    # delivery consumes the frame (same doctrine as the
+                    # change path above): advance BEFORE the reader
+                    # callbacks can raise, so a caught raise-then-resume
+                    # continues at the next frame instead of
+                    # re-delivering (and re-digesting) this payload
+                    st["blob_open"] = False
+                    f += 1
+                    if flen:
+                        self._blob_data(buf[start : start + flen])
+                else:
+                    self._bulk = None
+                    self.destroy(
+                        ProtocolError(
+                            f"Protocol error, unknown type: {type_id}")
+                    )
+                    return
+                if self.destroyed:
+                    self._bulk = None
+                    return
+        finally:
+            # single atomic write-back for every exit — returns, handler
+            # exceptions, stalls: the cursor halves leave together or
+            # not at all (st is dead when _bulk was dropped; the write
+            # is then harmless)
+            st["f"] = f
+            st["row"] = row
         self._bulk = None
         tail = buf[st["consumed"]:]
         if len(tail):
@@ -845,6 +874,12 @@ class Decoder:
                         or self._paused_readers > 0:
                     return f
         finally:
+            # BOTH cursor halves, atomically — matching the C loop's
+            # unconditional write-back: a handler that raises after
+            # row/f advanced must leave them advanced together, or the
+            # resume re-pairs frame payloads with the wrong rows
+            # (round-5 advisor, high)
+            st["f"] = f
             st["row"] = row
             self._missing = 0
             self._state = TYPE_HEADER
@@ -889,7 +924,15 @@ class Decoder:
                 elif type_id == TYPE_BLOB:
                     self._state = TYPE_BLOB
                     self._current_blob = None
-                    self._open_blob_if_ready()
+                    try:
+                        self._open_blob_if_ready()
+                    except BaseException:
+                        # handler raise: the chunk's remaining bytes are
+                        # only in this local — requeue them or a caught
+                        # raise-then-resume silently loses every frame
+                        # after this one in the same write
+                        self._requeue_tail(chunk[i:])
+                        raise
                 else:
                     self.destroy(
                         ProtocolError(f"Protocol error, unknown type: {type_id}")
@@ -910,7 +953,11 @@ class Decoder:
             payload = chunk[: self._missing]
             rest = chunk[self._missing :]
             self._missing = 0
-            self._finish_change(payload)
+            try:
+                self._finish_change(payload)
+            except BaseException:
+                self._requeue_tail(rest)  # handler raise: keep the tail
+                raise
             return rest
         # slow path: accumulate across chunk boundaries (reference:
         # decode.js:229-248)
@@ -922,7 +969,11 @@ class Decoder:
         rest = chunk[take:]
         if self._missing == 0:
             parts, self._payload_parts = self._payload_parts, None
-            self._finish_change(b"".join(parts))
+            try:
+                self._finish_change(b"".join(parts))
+            except BaseException:
+                self._requeue_tail(rest)  # handler raise: keep the tail
+                raise
         return rest
 
     def _finish_change(self, payload) -> None:
@@ -987,9 +1038,17 @@ class Decoder:
                 self._resume()
 
         handler = self._on_blob if self._on_blob is not None else _drain_blob
-        handler(blob, done)
-        if self._missing == 0:
-            self._end_blob()
+        try:
+            handler(blob, done)
+        finally:
+            # a zero-length blob has no payload bytes to route through
+            # _blob_data's exception-safe end: if the handler raises,
+            # the blob must still END here or _state stays TYPE_BLOB
+            # with the reader dangling — a caught raise-then-resume
+            # would then fail end() with a spurious mid-frame error
+            # (both dispatch paths share this site)
+            if self._missing == 0:
+                self._end_blob()
 
     def _blob_data(self, chunk: memoryview) -> memoryview | None:
         blob = self._current_blob
@@ -1001,11 +1060,21 @@ class Decoder:
         # buffering) — shares this object instead of re-copying the
         # scratch memoryview
         data = bytes(chunk[:take])
-        self._note_blob_bytes(data)
-        blob._deliver(data)
         rest = chunk[take:]
-        if self._missing == 0:
-            self._end_blob()
+        try:
+            self._note_blob_bytes(data)
+            blob._deliver(data)
+        except BaseException:
+            self._requeue_tail(rest)  # reader raise: keep the tail
+            raise
+        finally:
+            # delivery consumed these bytes even if a reader callback
+            # raised: the blob must still END, or _state stays TYPE_BLOB
+            # with _current_blob dangling — a caught raise-then-resume
+            # on the final chunk would then fail end() with a spurious
+            # mid-frame ProtocolError and never fire on_end
+            if self._missing == 0:
+                self._end_blob()
         return rest
 
     def _note_blob_bytes(self, data: bytes) -> None:

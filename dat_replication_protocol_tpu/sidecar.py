@@ -35,20 +35,35 @@ from __future__ import annotations
 import socket
 import sys
 import threading
+import time
 
 from .session.transport import recv_over, send_over
 
 DIGEST_SUBSET_CHANGE = "digest:change"
 DIGEST_SUBSET_BLOB = "digest:blob"
 
+# reply-drain defaults: a client that finished sending but never reads
+# its reply must not park a session thread forever (ADVICE.md round 5).
+DEFAULT_DRAIN_TIMEOUT = 600.0
+_DRAIN_POLL = 0.25
 
-def run_session(read_bytes, write_bytes, close_write=None) -> dict:
+
+def run_session(read_bytes, write_bytes, close_write=None,
+                drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
     """Serve one wire session over a blocking byte pair.
 
     ``read_bytes(n)`` / ``write_bytes(data)`` follow the
     :mod:`..session.transport` contract (block on congestion, ``b''``
     at EOF).  Returns counters for observability:
     ``{"changes": n, "blobs": n, "bytes": n, "digests": n, "ok": bool}``.
+
+    ``drain_timeout`` bounds every reply-stall wait: when the reply
+    stream makes no write progress for that many seconds — whether the
+    stall surfaces in the end-of-session drain join or mid-session in
+    the digest-flush backpressure wait — the encoder is destroyed and
+    ``close_write`` invoked (best-effort) so the connection tears down
+    instead of leaking a parked thread per stalled client; ``None``
+    waits forever (the pre-round-6 behavior).
 
     The decoder is ALWAYS the digest-capable ``backend='tpu'`` one —
     the plain host :class:`Decoder` has no digest surface and would
@@ -63,6 +78,26 @@ def run_session(read_bytes, write_bytes, close_write=None) -> dict:
     enc = encode()  # reply stream: plain host encoder (digest payloads)
     dec = decode(backend="tpu")
     stats = {"digests": 0}
+
+    # reply write progress, shared by every stall check: refreshed each
+    # time a reply byte actually reaches the transport
+    progress = {"t": time.monotonic()}
+
+    def _stalled(now: float) -> bool:
+        return (drain_timeout is not None
+                and now - progress["t"] > drain_timeout)
+
+    def _teardown_stalled() -> None:
+        enc.destroy(TimeoutError(
+            f"reply stream stalled for {drain_timeout}s"))
+        if close_write is not None:
+            try:
+                # unblocks a sender parked in a socket write (shutdown
+                # wakes it with EPIPE); best-effort — the caller's
+                # close is the backstop
+                close_write()
+            except OSError:
+                pass
 
     def on_digest(kind: str, seq: int, digest: bytes) -> None:
         stats["digests"] += 1
@@ -81,9 +116,17 @@ def run_session(read_bytes, write_bytes, close_write=None) -> dict:
             # consume path, so blocking here stalls request consumption —
             # the client that won't read its reply eventually can't send
             # either, and reply memory stays bounded by the high-water
-            # mark instead of growing with the session
+            # mark instead of growing with the session.  Same stall
+            # deadline as the drain join below: a client that parked the
+            # reply mid-session would otherwise hang this wait forever
+            # and the drain teardown could never be reached
+            progress["t"] = time.monotonic()  # stall measured from HERE:
+            # a long reply-quiet stretch before this wait (one huge blob,
+            # digests batched) is not the client's fault
             while not (flushed.wait(0.1) or enc.destroyed):
-                pass
+                if _stalled(time.monotonic()):
+                    _teardown_stalled()
+                    break
 
     dec.on_digest(on_digest)
     # change/blob handlers stay unregistered: the decoder's defaults
@@ -97,9 +140,13 @@ def run_session(read_bytes, write_bytes, close_write=None) -> dict:
     dec.on_error(lambda _e: enc.destroy())
     enc.on_error(lambda _e: None if dec.destroyed else dec.destroy())
 
+    def _write(data) -> None:
+        write_bytes(data)
+        progress["t"] = time.monotonic()  # reply byte reached the client
+
     def _send() -> None:
         try:
-            send_over(enc, write_bytes, close_write)
+            send_over(enc, _write, close_write)
         except Exception as e:  # EPIPE/ECONNRESET from a vanished client
             if not enc.destroyed:
                 enc.destroy(e)
@@ -124,9 +171,20 @@ def run_session(read_bytes, write_bytes, close_write=None) -> dict:
         sender.join(timeout=5)
     else:
         # healthy path: the reply is still draining to the client;
-        # truncating it (returning lets the caller close the socket)
-        # would corrupt a correct session mid-frame
-        sender.join()
+        # truncating it early would corrupt a correct session
+        # mid-frame, but a bare join() would park this thread forever
+        # behind a client that stopped reading (ADVICE.md round 5) —
+        # so join in bounded steps and tear the session down once the
+        # reply makes no progress for drain_timeout seconds
+        progress["t"] = time.monotonic()  # idle clock starts at drain
+        while True:
+            sender.join(timeout=_DRAIN_POLL)
+            if not sender.is_alive():
+                break
+            if _stalled(time.monotonic()):
+                _teardown_stalled()
+                sender.join(timeout=5)
+                break
     return {
         "changes": dec.changes,
         "blobs": dec.blobs,
@@ -137,14 +195,35 @@ def run_session(read_bytes, write_bytes, close_write=None) -> dict:
     }
 
 
-def serve_stdio() -> dict:
+def serve_stdio(drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
     """One session over stdin/stdout (logs go to stderr only)."""
     import os
+
+    # close_write can fire from the session thread (drain-timeout
+    # teardown) while the sender thread sits mid-write on fd 1, so a
+    # bare os.close(1) has a reuse hazard: once fd 1 is free, any
+    # thread's next open() can be handed 1, and _write_all's
+    # partial-write retry loop would then write reply bytes into an
+    # unrelated descriptor.  dup2 of /dev/null atomically releases the
+    # pipe write end (the reader still sees EOF) while keeping fd 1
+    # occupied — a late retry write lands in /dev/null instead.  A
+    # writer currently blocked in write(2) is NOT woken by this (unlike
+    # the TCP twin's shutdown-EPIPE); it unblocks only when the peer
+    # reads or exits, which the bounded drain join tolerates.  Once-only
+    # so the second caller (send_over's finally) doesn't reopen devnull.
+    close_once = threading.Lock()
+
+    def _close_stdout() -> None:
+        if close_once.acquire(blocking=False):
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, 1)
+            os.close(devnull)
 
     stats = run_session(
         read_bytes=lambda n: os.read(0, n),
         write_bytes=lambda d: _write_all(1, d),
-        close_write=lambda: os.close(1),
+        close_write=_close_stdout,
+        drain_timeout=drain_timeout,
     )
     print(f"sidecar: stdio session {stats}", file=sys.stderr, flush=True)
     return stats
@@ -160,7 +239,8 @@ def _write_all(fd: int, data: bytes) -> None:
 
 def serve_tcp(host: str, port: int,
               max_sessions: int | None = None,
-              ready_cb=None) -> None:
+              ready_cb=None,
+              drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> None:
     """Accept loop: one concurrent session per connection.
 
     ``max_sessions`` bounds the loop for tests; ``ready_cb(port)`` fires
@@ -187,6 +267,7 @@ def serve_tcp(host: str, port: int,
                         read_bytes=conn.recv,
                         write_bytes=conn.sendall,
                         close_write=lambda: conn.shutdown(socket.SHUT_WR),
+                        drain_timeout=drain_timeout,
                     )
                     print(f"sidecar: {peer} {stats}", file=sys.stderr,
                           flush=True)
@@ -216,17 +297,24 @@ def main(argv=None) -> int:
                         "routing layer pick device batches or the host "
                         "engine; 'host' forces the host engine.  Digests "
                         "are produced either way")
+    p.add_argument("--drain-timeout", type=float,
+                   default=DEFAULT_DRAIN_TIMEOUT, metavar="SECONDS",
+                   help="tear a session down when its reply stream makes "
+                        "no progress for this long (a client that stops "
+                        "reading); <= 0 waits forever "
+                        f"(default: {DEFAULT_DRAIN_TIMEOUT:.0f})")
     args = p.parse_args(argv)
+    drain = args.drain_timeout if args.drain_timeout > 0 else None
     if args.backend == "host":
         import os
 
         os.environ["DAT_DEVICE_HASH"] = "0"  # routing-layer override:
         # force the host digest engine for this daemon's lifetime
     if args.stdio:
-        stats = serve_stdio()
+        stats = serve_stdio(drain_timeout=drain)
         return 0 if stats["ok"] else 1
     host, _, port = args.tcp.rpartition(":")
-    serve_tcp(host or "127.0.0.1", int(port))
+    serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain)
     return 0
 
 

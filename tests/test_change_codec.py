@@ -285,3 +285,49 @@ def test_decode_exotic_buffers_keep_python_semantics():
     a = array.array("I", [0x12, 1, ord("k"), 0x18, 1, 0x20, 0, 0x28, 1])
     mv = memoryview(a)
     assert decode_change(mv) == _decode_change_py(mv)
+
+
+def test_fastpath_gate_is_shared_and_flips_with_env(monkeypatch):
+    """The codec and the decoder's dispatch loop route through ONE
+    fast-path gate (runtime.fastpath.get) with one caching policy: the
+    DISABLE env var is re-read per call, so flipping it mid-process
+    switches BOTH layers together (round-5 advisor: the codec's private
+    cache froze the decision while the decoder re-read it — tests that
+    "forced the pure-Python path" were exercising half of it)."""
+    from dat_replication_protocol_tpu.runtime import fastpath
+    from dat_replication_protocol_tpu.session import decoder as session_decoder
+    from dat_replication_protocol_tpu.wire import change_codec
+
+    monkeypatch.delenv("DAT_FASTPATH_DISABLE", raising=False)
+    before = fastpath.get()  # may be None on a toolchain-less image
+    assert change_codec._fastpath_mod() is before
+    assert session_decoder._fastpath_mod() is before
+
+    # flip mid-process, AFTER first use: both layers must see it now
+    monkeypatch.setenv("DAT_FASTPATH_DISABLE", "1")
+    assert change_codec._fastpath_mod() is None
+    assert session_decoder._fastpath_mod() is None
+
+    # and flip back: a call made while disabled must not have poisoned
+    # the import cache
+    monkeypatch.delenv("DAT_FASTPATH_DISABLE")
+    assert change_codec._fastpath_mod() is before
+    assert session_decoder._fastpath_mod() is before
+
+
+def test_fastpath_reset_hook_drops_cached_import(monkeypatch):
+    """reset_for_tests() re-arms the one-shot build+import decision so a
+    test can exercise a clean first call (the disk build cache makes the
+    rebuild cheap)."""
+    from dat_replication_protocol_tpu.runtime import fastpath
+
+    monkeypatch.delenv("DAT_FASTPATH_DISABLE", raising=False)
+    before = fastpath.get()
+    fastpath.reset_for_tests()
+    assert fastpath._tried is False and fastpath._mod is None
+    again = fastpath.get()
+    assert (again is None) == (before is None)
+    if before is not None:  # a fresh module object, same extension
+        assert again.__name__ == before.__name__
+    fastpath.reset_for_tests()  # leave no cross-test state behind
+    fastpath.get()

@@ -1,0 +1,415 @@
+"""datlint rule engine: one known-bad and one known-good fixture per
+rule, each distilled from the real incident that motivated the rule
+(ANALYSIS.md maps rules to ADVICE.md findings), plus the suppression
+syntax and the CLI contract the tier-1 gate relies on.
+
+The fixtures are deliberately minimal re-creations of the PRE-fix repo
+patterns: if a rule stops firing on its bad fixture, the analyzer has
+lost the ability to catch the bug class that motivated it.
+"""
+
+import textwrap
+
+import pytest
+
+from dat_replication_protocol_tpu.analysis import run_paths
+from dat_replication_protocol_tpu.analysis.__main__ import main as datlint_main
+
+
+def _lint(tmp_path, *files, rules=None):
+    """Write {name: source} pairs into tmp_path and lint the directory."""
+    for name, source in files:
+        (tmp_path / name).write_text(textwrap.dedent(source))
+    return run_paths([tmp_path], rules=rules)
+
+
+def _rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+# -- cursor-coherence (ADVICE.md round 5, high: bulk cursor desync) ---------
+
+# the pre-fix shape of _dispatch_changes_fast: locals advance together,
+# but the finally writes back only half the coupled cursor
+CURSOR_BAD = '''
+# datlint: coupled-state st["f"], st["row"]
+
+def dispatch(st, frames, rows, deliver):
+    f = st["f"]
+    row = st["row"]
+    try:
+        while f < len(frames):
+            payload = frames[f]
+            row += 1
+            f += 1
+            deliver(payload, rows[row - 1])
+    finally:
+        st["row"] = row
+'''
+
+CURSOR_GOOD = '''
+# datlint: coupled-state st["f"], st["row"]
+
+def dispatch(st, frames, rows, deliver):
+    f = st["f"]
+    row = st["row"]
+    try:
+        while f < len(frames):
+            payload = frames[f]
+            row += 1
+            f += 1
+            deliver(payload, rows[row - 1])
+    finally:
+        st["f"] = f
+        st["row"] = row
+'''
+
+
+def test_cursor_coherence_fires_on_half_writeback(tmp_path):
+    findings = _lint(tmp_path, ("desync.py", CURSOR_BAD))
+    assert "cursor-coherence" in _rules_fired(findings)
+    # both shapes are reported: the subset finally AND the absence of
+    # any finally covering the full set
+    msgs = [f.message for f in findings if f.rule == "cursor-coherence"]
+    # canonical form uses single quotes (ast.unparse)
+    assert any("st['f']" in m and "not" in m for m in msgs)
+
+
+def test_cursor_coherence_fires_on_no_finally_at_all(tmp_path):
+    findings = _lint(tmp_path, ("bare.py", '''
+        # datlint: coupled-state st["f"], st["row"]
+
+        def advance(st):
+            st["row"] += 1
+            st["f"] += 1
+    '''))
+    assert "cursor-coherence" in _rules_fired(findings)
+
+
+def test_cursor_coherence_clean_on_atomic_writeback(tmp_path):
+    assert _lint(tmp_path, ("atomic.py", CURSOR_GOOD)) == []
+
+
+def test_cursor_coherence_ignores_undeclared_modules(tmp_path):
+    # no coupled-state declaration: the rule constrains nothing
+    source = CURSOR_BAD.replace("# datlint: coupled-state", "# not-a-decl")
+    assert _lint(tmp_path, ("free.py", source)) == []
+
+
+def test_cursor_coherence_malformed_declaration_is_a_finding(tmp_path):
+    """A declaration the rule cannot honor must FAIL datlint, not turn
+    the rule off while the run still reports clean (dropping the comma
+    would otherwise ship the exact half-write-back regression green)."""
+    source = CURSOR_BAD.replace('st["f"], st["row"]', 'st["f"] st["row"]')
+    findings = _lint(tmp_path, ("desync.py", source))
+    msgs = [f.message for f in findings if f.rule == "cursor-coherence"]
+    assert any("unparsable member" in m for m in msgs), findings
+
+
+def test_cursor_coherence_single_member_declaration_is_a_finding(tmp_path):
+    # one member is not a coupling; silently ignoring it disables the rule
+    source = CURSOR_BAD.replace('st["f"], st["row"]', 'st["row"]')
+    findings = _lint(tmp_path, ("desync.py", source))
+    msgs = [f.message for f in findings if f.rule == "cursor-coherence"]
+    assert any("at least two" in m for m in msgs), findings
+
+
+# -- env-cache-policy (ADVICE.md round 5, low: DISABLE split-brain) ---------
+
+# the pre-fix change_codec._fastpath_mod: the env decision is frozen
+# into the module cache on first call
+ENV_BAD_FN = '''
+import os
+
+_cache = None
+_tried = False
+
+
+def get():
+    global _cache, _tried
+    if not _tried:
+        _tried = True
+        if os.environ.get("DAT_FASTPATH_DISABLE"):
+            _cache = None
+        else:
+            _cache = object()
+    return _cache
+'''
+
+ENV_GOOD = '''
+import os
+
+_cache = None
+_tried = False
+
+
+def get():
+    if os.environ.get("DAT_FASTPATH_DISABLE"):
+        return None
+    return _load_once()
+
+
+def _load_once():
+    global _cache, _tried
+    if not _tried:
+        _tried = True
+        _cache = object()
+    return _cache
+'''
+
+
+def test_env_cache_fires_on_frozen_function_cache(tmp_path):
+    findings = _lint(tmp_path, ("frozen.py", ENV_BAD_FN))
+    assert _rules_fired(findings) == {"env-cache-policy"}
+
+
+def test_env_cache_fires_on_module_level_env_read(tmp_path):
+    findings = _lint(tmp_path, ("modlevel.py", '''
+        import os
+
+        FASTPATH_OFF = os.environ.get("DAT_FASTPATH_DISABLE")
+    '''))
+    assert _rules_fired(findings) == {"env-cache-policy"}
+
+
+def test_env_cache_clean_on_per_call_read(tmp_path):
+    assert _lint(tmp_path, ("shared.py", ENV_GOOD)) == []
+
+
+# -- unbounded-join (ADVICE.md round 5, low: sidecar drain hang) ------------
+
+JOIN_BAD = '''
+def run_session(sender, sock):
+    sock.settimeout(None)
+    sender.join()
+'''
+
+JOIN_GOOD = '''
+def run_session(sender, sock, parts):
+    sock.settimeout(30.0)
+    while sender.is_alive():
+        sender.join(timeout=0.25)
+    return ", ".join(parts)
+'''
+
+
+def test_unbounded_join_fires_on_bare_join_and_settimeout_none(tmp_path):
+    findings = _lint(tmp_path, ("hang.py", JOIN_BAD))
+    assert [f.rule for f in findings] == ["unbounded-join"] * 2
+
+
+def test_unbounded_join_clean_on_bounded_waits(tmp_path):
+    # str.join with an argument must NOT be confused with Thread.join
+    assert _lint(tmp_path, ("bounded.py", JOIN_GOOD)) == []
+
+
+# -- jit-purity (PERF.md: host effects inside traced bodies) ----------------
+
+JIT_BAD = '''
+import os
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    if os.environ.get("DAT_DEBUG"):
+        x = x + 1
+    return x
+
+
+def kernel(x, out):
+    host = np.asarray(x)
+    out.block_until_ready()
+    return host
+
+
+traced = jax.jit(kernel)
+'''
+
+JIT_GOOD = '''
+import os
+
+import jax
+import jax.numpy as jnp
+
+DEBUG = bool(os.environ.get("DAT_DEBUG"))  # datlint: disable=env-cache-policy
+
+
+@jax.jit
+def step(x):
+    return jnp.sum(x * 2)
+
+
+def host_helper(x):
+    # not traced: environment reads and host syncs are fine here
+    if os.environ.get("DAT_DEBUG"):
+        x.block_until_ready()
+    return x
+'''
+
+
+def test_jit_purity_fires_on_env_read_sync_and_materialize(tmp_path):
+    findings = _lint(tmp_path, ("impure.py", JIT_BAD))
+    impure = [f for f in findings if f.rule == "jit-purity"]
+    joined = " ".join(f.message for f in impure)
+    assert "os.environ" in joined          # frozen trace-time env read
+    assert "block_until_ready" in joined   # host sync point
+    assert "np.asarray" in joined          # device->host transfer
+    assert len(impure) == 3
+
+
+def test_jit_purity_clean_on_pure_traced_body(tmp_path):
+    assert _lint(tmp_path, ("pure.py", JIT_GOOD)) == []
+
+
+# -- wire-constant-parity (cross-implementation constant drift) -------------
+
+WIRE_PY = '''
+MAX_VARINT_LEN = 10
+MAX_HEADER_LEN = MAX_VARINT_LEN + 1
+
+TYPE_HEADER = 0
+TYPE_CHANGE = 1
+TYPE_BLOB = 2
+'''
+
+WIRE_C_GOOD = '''
+enum FrameType {
+  TYPE_HEADER = 0,
+  TYPE_CHANGE = 1,
+  TYPE_BLOB = 2,
+};
+// wire: MAX_VARINT_LEN = 10
+#define MAX_HEADER_LEN 11
+'''
+
+# a drifted C copy: TYPE_BLOB renumbered, the varint cap widened
+WIRE_C_BAD = WIRE_C_GOOD.replace("TYPE_BLOB = 2", "TYPE_BLOB = 3").replace(
+    "MAX_VARINT_LEN = 10", "MAX_VARINT_LEN = 12")
+
+
+def test_wire_parity_fires_on_cross_language_drift(tmp_path):
+    findings = _lint(tmp_path, ("consts.py", WIRE_PY),
+                     ("native.cpp", WIRE_C_BAD))
+    drift = [f for f in findings if f.rule == "wire-constant-parity"]
+    assert {m.split("wire constant ")[1].split(" ")[0] for m in
+            (f.message for f in drift)} == {"TYPE_BLOB", "MAX_VARINT_LEN"}
+
+
+def test_wire_parity_clean_when_constants_agree(tmp_path):
+    # includes the folded MAX_HEADER_LEN = MAX_VARINT_LEN + 1 == 11
+    assert _lint(tmp_path, ("consts.py", WIRE_PY),
+                 ("native.cpp", WIRE_C_GOOD)) == []
+
+
+def test_wire_parity_fires_on_python_python_drift(tmp_path):
+    findings = _lint(tmp_path, ("a.py", "TYPE_CHANGE = 1\n"),
+                     ("b.py", "_TYPE_CHANGE = 7\n"))  # underscore-stripped
+    assert _rules_fired(findings) == {"wire-constant-parity"}
+
+
+def test_wire_parity_single_site_constrains_nothing(tmp_path):
+    assert _lint(tmp_path, ("only.py", "TYPE_CHANGE = 99\n")) == []
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_line_suppression_silences_one_finding(tmp_path):
+    findings = _lint(tmp_path, ("sup.py", '''
+        def wait(sender, other):
+            sender.join()  # datlint: disable=unbounded-join -- test only
+            other.join()
+    '''))
+    assert len(findings) == 1 and findings[0].rule == "unbounded-join"
+    assert findings[0].line == 4  # only the unsuppressed join
+
+
+def test_comment_line_above_suppresses_the_next_line(tmp_path):
+    findings = _lint(tmp_path, ("above.py", '''
+        def wait(sender):
+            # datlint: disable=unbounded-join -- drained by caller
+            sender.join()
+    '''))
+    assert findings == []
+
+
+def test_file_suppression_silences_whole_file(tmp_path):
+    findings = _lint(tmp_path, ("filewide.py", '''
+        # datlint: disable-file=unbounded-join
+        def wait(a, b):
+            a.join()
+            b.join()
+    '''))
+    assert findings == []
+
+
+def test_suppression_in_string_literal_is_inert(tmp_path):
+    findings = _lint(tmp_path, ("strlit.py", '''
+        DOC = "datlint: disable-file=unbounded-join"
+
+        def wait(sender):
+            sender.join()
+    '''))
+    assert len(findings) == 1
+
+
+def test_c_comment_suppression(tmp_path):
+    findings = _lint(
+        tmp_path,
+        ("consts.py", "TYPE_CHANGE = 1\n"),
+        ("bad.cpp",
+         "int t = 2;  // TYPE_CHANGE  // datlint: disable=wire-constant-parity\n"))
+    assert findings == []
+
+
+# -- engine edges -----------------------------------------------------------
+
+def test_unparsable_python_is_a_finding_not_a_skip(tmp_path):
+    findings = _lint(tmp_path, ("broken.py", "def f(:\n"))
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_rule_filter_runs_only_selected_rules(tmp_path):
+    findings = _lint(tmp_path, ("both.py", JOIN_BAD + ENV_BAD_FN),
+                     rules=None)
+    assert _rules_fired(findings) >= {"unbounded-join", "env-cache-policy"}
+    from dat_replication_protocol_tpu.analysis import rule_by_name
+    only = run_paths([tmp_path], rules=[rule_by_name("unbounded-join")])
+    assert _rules_fired(only) == {"unbounded-join"}
+
+
+# -- CLI contract (what the tier-1 gate and pre-merge hooks rely on) --------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "ok.py").write_text("X = 1\n")
+    assert datlint_main([str(clean)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+    dirty = tmp_path / "dirty"
+    dirty.mkdir()
+    (dirty / "bad.py").write_text("def f(t):\n    t.join()\n")
+    assert datlint_main([str(dirty)]) == 1
+    out = capsys.readouterr().out
+    assert "unbounded-join" in out and "finding" in out
+
+    assert datlint_main(["--rule", "no-such-rule", str(clean)]) == 2
+    assert datlint_main([str(tmp_path / "missing")]) == 2
+
+
+def test_cli_list_rules_names_all_five(capsys):
+    assert datlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in ("cursor-coherence", "env-cache-policy", "unbounded-join",
+                 "jit-purity", "wire-constant-parity"):
+        assert name in out
+
+
+def test_findings_are_sorted_and_rendered_with_location(tmp_path):
+    findings = _lint(tmp_path, ("zz.py", JOIN_BAD), ("aa.py", JOIN_BAD))
+    assert findings == sorted(findings)
+    rendered = findings[0].render()
+    assert "aa.py" in rendered and "unbounded-join:" in rendered

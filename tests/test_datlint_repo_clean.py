@@ -1,0 +1,53 @@
+"""Tier-1 gate: the shipped package carries zero datlint findings.
+
+This is the analyzer's production run — the same invocation as
+``python -m dat_replication_protocol_tpu.analysis`` — executed inside
+the ordinary pytest suite so protocol-invariant regressions (a cursor
+write-back dropped in a refactor, a new module-level env cache, a
+drifted wire constant in one C file) fail CI like any other test,
+with no extra pipeline step to forget.
+
+A finding here means either real breakage (fix the code) or a new,
+audited exception (add a ``# datlint: disable=<rule>`` with a
+justification — see ANALYSIS.md for the syntax and the bar).
+"""
+
+from pathlib import Path
+
+import dat_replication_protocol_tpu
+from dat_replication_protocol_tpu.analysis import ALL_RULES, run_paths
+
+PACKAGE_ROOT = Path(dat_replication_protocol_tpu.__file__).resolve().parent
+
+
+def test_package_is_datlint_clean():
+    findings = run_paths([PACKAGE_ROOT])
+    assert findings == [], (
+        "datlint findings in the shipped package:\n"
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_registry_ships_the_five_incident_rules():
+    # the gate is only as strong as the registry: losing a rule from
+    # ALL_RULES would turn the clean-run above into a weaker check
+    # without any test failing
+    assert {r.name for r in ALL_RULES} >= {
+        "cursor-coherence",
+        "env-cache-policy",
+        "unbounded-join",
+        "jit-purity",
+        "wire-constant-parity",
+    }
+
+
+def test_analyzer_actually_saw_the_protocol_stack():
+    # guard against a silent scope regression (e.g. a _SKIP_DIRS typo
+    # excluding session/): the decoder, both C sources, and the wire
+    # layer must be in the analyzed file set
+    from dat_replication_protocol_tpu.analysis.engine import Project
+
+    project = Project.from_paths([PACKAGE_ROOT])
+    names = {p.name for p in (s.path for s in project.sources)}
+    assert {"decoder.py", "framing.py", "change_codec.py",
+            "dat_native.cpp", "dat_fastpath.cpp"} <= names

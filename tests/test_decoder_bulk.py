@@ -363,6 +363,179 @@ def test_handler_valueerror_propagates_not_protocolerror():
     assert seen == [f"key-{i}" for i in range(10)]
 
 
+def _drive_with_raising_handler(dec, wire, boom):
+    """Feed ``wire``, with the change handler raising ValueError after
+    acking any change whose counter is in ``boom``; each raise is caught
+    and dispatch resumed with an empty write.  Returns (seen, raises):
+    the ordered (key, change) pairs delivered and the raise count."""
+    seen = []
+
+    def handler(ch, done):
+        seen.append((ch.key, ch.change))
+        done()
+        if ch.change in boom:
+            raise ValueError(f"app bug at change {ch.change}")
+
+    dec.change(handler)
+    raises = 0
+    data = wire
+    while True:
+        try:
+            dec.write(data)
+            break
+        except ValueError:
+            raises += 1
+            data = b""  # resume the parked bulk cursor
+    dec.end()
+    return seen, raises
+
+
+def test_handler_raise_then_resume_keeps_rows_paired():
+    """The round-5 high finding, as a regression test: a handler raise
+    mid-bulk must advance BOTH cursor halves (frame index f and columnar
+    row) atomically, so that catching the exception and resuming
+    dispatch re-enters at the next frame with payloads still paired to
+    their own rows.  Pre-fix, the pure-Python fast loop's finally wrote
+    back st["row"] but not st["f"]: on resume, frames re-dispatched
+    from the stale f against advanced rows — silently wrong Change
+    records (this exact key/change pairing assertion), duplicate
+    deliveries, then IndexError."""
+    n = 200
+    wire = _wire(n=n, blob_every=1 << 30)
+    dec = protocol.decode()
+    seen, raises = _drive_with_raising_handler(dec, wire, boom={17, 95, 160})
+    assert dec.finished and not dec.destroyed
+    assert raises == 3
+    assert seen == [(f"key-{i}", i) for i in range(n)]
+
+
+def test_handler_raise_then_resume_general_indexed_loop():
+    """Same invariant on the GENERAL indexed loop (the non-fast branch
+    a _deliver_change subclass rides): row/f advance before the handler
+    can raise and persist together in the outer finally.  Pre-fix this
+    path advanced st["row"] immediately but st["f"] only at loop exit
+    — a raise-then-resume re-delivered frames against later rows."""
+    from dat_replication_protocol_tpu.session.decoder import Decoder
+
+    class SubclassedDecoder(Decoder):
+        # any override disables the fast change loop (the gate reads
+        # cls.__dict__), forcing the general indexed dispatch
+        def _deliver_change(self, change, payload):
+            super()._deliver_change(change, payload)
+
+    n = 120
+    wire = _wire(n=n, blob_every=1 << 30)
+    dec = SubclassedDecoder()
+    seen, raises = _drive_with_raising_handler(dec, wire, boom={3, 64, 65})
+    assert dec.finished and not dec.destroyed
+    assert raises == 3
+    assert seen == [(f"key-{i}", i) for i in range(n)]
+
+
+def test_blob_handler_raise_then_resume_delivers_payload_once():
+    """The blob half of the raise-then-resume invariant: a blob on_data
+    callback that raises mid-bulk must not see the same chunk again
+    after the app catches and resumes — delivery consumes the frame.
+    Pre-fix, the bulk loop advanced f and cleared blob_open only AFTER
+    _blob_data, so a resume re-ran the delivery: duplicate blob bytes
+    (and, on a digest decoder, a corrupt blob digest)."""
+    head = b"".join(frame(TYPE_CHANGE, encode_change({
+        "key": f"k{i}", "change": i, "from": 0, "to": 1,
+        "value": b"v" * 80})) for i in range(30))
+    wire = head + frame(TYPE_BLOB, b"B" * 500) + frame(
+        TYPE_CHANGE, encode_change({"key": "after", "change": 1,
+                                    "from": 0, "to": 1}))
+    dec = protocol.decode()
+    keys, chunks, boom = [], [], [True]
+    dec.change(lambda ch, done: (keys.append(ch.key), done()))
+
+    def on_blob(blob, done):
+        def on_data(chunk):
+            chunks.append(bytes(chunk))
+            if boom:
+                boom.clear()
+                raise ValueError("blob handler bug")
+
+        blob.on_data(on_data)
+        blob.on_end(done)
+
+    dec.blob(on_blob)
+    with pytest.raises(ValueError, match="blob handler bug"):
+        dec.write(wire)
+    dec.write(b"")  # resume the parked cursor
+    dec.end()
+    assert dec.finished and not dec.destroyed
+    assert b"".join(chunks) == b"B" * 500, "blob payload re-delivered"
+    assert keys == [f"k{i}" for i in range(30)] + ["after"]
+
+
+@pytest.mark.parametrize("n_head", [30, 2])  # bulk path / streaming path
+def test_blob_raise_on_final_chunk_still_ends_blob(n_head):
+    """A reader on_data raise on the blob's FINAL chunk must not skip
+    _end_blob: pre-fix, _blob_data raised through the missing==0 check,
+    leaving _state=TYPE_BLOB and _current_blob dangling — with the blob
+    as the last frame, on_end never fired and end() destroyed a fully
+    delivered stream with 'stream ended mid-frame'.  (The earlier
+    raise-then-resume test masked this: its trailing change frame reset
+    _state on the next dispatch.)"""
+    head = b"".join(frame(TYPE_CHANGE, encode_change({
+        "key": f"k{i}", "change": i, "from": 0, "to": 1,
+        "value": b"v" * 80})) for i in range(n_head))
+    wire = head + frame(TYPE_BLOB, b"B" * 500)  # blob LAST — no healer
+    dec = protocol.decode()
+    chunks, boom, ended = [], [True], []
+    dec.change(lambda ch, done: done())
+
+    def on_blob(blob, done):
+        def on_data(chunk):
+            chunks.append(bytes(chunk))
+            if boom:
+                boom.clear()
+                raise ValueError("blob handler bug")
+
+        blob.on_data(on_data)
+        blob.on_end(lambda: (ended.append(True), done()))
+
+    dec.blob(on_blob)
+    with pytest.raises(ValueError, match="blob handler bug"):
+        dec.write(wire)
+    dec.write(b"")  # resume
+    dec.end()
+    assert ended, "on_end never fired for the fully delivered blob"
+    assert dec.finished and not dec.destroyed
+    assert b"".join(chunks) == b"B" * 500
+
+
+@pytest.mark.parametrize("n_head", [30, 2])  # bulk path / streaming path
+def test_zero_length_blob_handler_raise_still_ends_blob(n_head):
+    """Zero-length twin of the final-chunk case: with no payload bytes
+    to route through _blob_data, the only end site is
+    _open_blob_if_ready's missing==0 check — which a handler raise used
+    to skip, on BOTH dispatch paths, leaving the reader dangling and
+    end() destroying the stream."""
+    head = b"".join(frame(TYPE_CHANGE, encode_change({
+        "key": f"k{i}", "change": i, "from": 0, "to": 1,
+        "value": b"v" * 80})) for i in range(n_head))
+    wire = head + frame(TYPE_BLOB, b"")  # zero-length blob LAST
+    dec = protocol.decode()
+    boom, ended = [True], []
+    dec.change(lambda ch, done: done())
+
+    def on_blob(blob, done):
+        blob.on_end(lambda: (ended.append(True), done()))
+        if boom:
+            boom.clear()
+            raise ValueError("blob handler bug")
+
+    dec.blob(on_blob)
+    with pytest.raises(ValueError, match="blob handler bug"):
+        dec.write(wire)
+    dec.write(b"")  # resume
+    dec.end()
+    assert ended, "zero-length blob never ended after the handler raise"
+    assert dec.finished and not dec.destroyed
+
+
 def test_randomized_ack_schedule_soak():
     """Bounded version of the round-5 ack soak (7-min run: 3,756 sessions
     clean): randomized sync / cross-thread / double / late acks across
@@ -413,3 +586,81 @@ def test_randomized_ack_schedule_soak():
         for t in threads:
             t.join(timeout=5)
         assert seen == [f"key-{i}" for i in range(120)], f"seed {seed}"
+
+
+def test_streaming_raise_then_resume_preserves_chunk_tail():
+    """A handler raise mid-chunk on the STREAMING path must requeue the
+    chunk's unparsed remainder: pre-fix, _consume popped the chunk and
+    the delivery site's `rest` local died with the exception — every
+    frame after the raising one in the same write() was silently
+    dropped while the session still reported finished=True (the bulk
+    path preserves its tail in the parked cursor; this is the
+    streaming analogue)."""
+    def mkch(k):
+        return frame(TYPE_CHANGE, encode_change(
+            {"key": k, "change": 1, "from": 0, "to": 1}))
+
+    # blob reader raise: trailing change in the same sub-bulk chunk
+    wire = mkch("before") + frame(TYPE_BLOB, b"B" * 50) + mkch("after")
+    assert len(wire) < 2048, "must ride the streaming scanner"
+    dec = protocol.decode()
+    keys, chunks, boom, ended = [], [], [True], []
+    dec.change(lambda ch, done: (keys.append(ch.key), done()))
+
+    def on_blob(blob, done):
+        def on_data(c):
+            chunks.append(bytes(c))
+            if boom:
+                boom.clear()
+                raise ValueError("reader bug")
+
+        blob.on_data(on_data)
+        blob.on_end(lambda: (ended.append(True), done()))
+
+    dec.blob(on_blob)
+    with pytest.raises(ValueError, match="reader bug"):
+        dec.write(wire)
+    dec.write(b"")  # resume
+    dec.end()
+    assert keys == ["before", "after"], f"tail frame lost: {keys}"
+    assert b"".join(chunks) == b"B" * 50 and ended
+    assert dec.finished and not dec.destroyed
+
+    # change handler raise (ack-then-raise): later frames survive
+    dec = protocol.decode()
+    keys, boom = [], [True]
+
+    def handler(ch, done):
+        keys.append(ch.key)
+        done()
+        if boom:
+            boom.clear()
+            raise ValueError("app bug")
+
+    dec.change(handler)
+    with pytest.raises(ValueError, match="app bug"):
+        dec.write(mkch("a") + mkch("b") + mkch("c"))
+    dec.write(b"")
+    dec.end()
+    assert keys == ["a", "b", "c"], f"tail frames lost: {keys}"
+    assert dec.finished and not dec.destroyed
+
+    # blob OPEN raise (handler itself raises; payload + tail follow)
+    dec = protocol.decode()
+    keys, got, boom, ended = [], [], [True], []
+    dec.change(lambda ch, done: (keys.append(ch.key), done()))
+
+    def on_blob2(blob, done):
+        blob.on_data(lambda c: got.append(bytes(c)))
+        blob.on_end(lambda: (ended.append(True), done()))
+        if boom:
+            boom.clear()
+            raise ValueError("open bug")
+
+    dec.blob(on_blob2)
+    with pytest.raises(ValueError, match="open bug"):
+        dec.write(frame(TYPE_BLOB, b"PAY") + mkch("tail"))
+    dec.write(b"")
+    dec.end()
+    assert b"".join(got) == b"PAY" and keys == ["tail"] and ended
+    assert dec.finished and not dec.destroyed

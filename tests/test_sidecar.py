@@ -166,3 +166,98 @@ def test_tcp_sidecar_survives_client_vanishing_mid_reply():
     c.close()
     t.join(timeout=30)
     assert not t.is_alive(), "serve loop hung on a vanished client"
+
+
+def test_run_session_tears_down_stalled_reply_drain():
+    """A client that finishes sending but never reads its reply must not
+    park the session thread forever (ADVICE.md round 5: the healthy path
+    ended in a bare sender.join()).  After drain_timeout with no reply
+    progress, run_session destroys the encoder, fires close_write to
+    unblock the parked sender (the socket-shutdown EPIPE analogue), and
+    returns ok=False — bounded, observable teardown instead of a
+    per-connection thread leak."""
+    import time
+
+    fed = {"done": False}
+
+    def read_bytes(n):
+        if fed["done"]:
+            return b""  # EOF: the client finished sending
+        fed["done"] = True
+        return SESSION_1
+
+    released = threading.Event()
+    closed = threading.Event()
+
+    def write_bytes(data):
+        if closed.is_set():
+            raise OSError("EPIPE")
+        # a peer with a full receive window that never reads: the write
+        # parks until close_write "shuts the socket down" under it
+        released.wait(30)
+        raise OSError("EPIPE")
+
+    def close_write():
+        closed.set()
+        released.set()
+
+    t0 = time.monotonic()
+    stats = sidecar.run_session(read_bytes, write_bytes,
+                                close_write=close_write,
+                                drain_timeout=1.0)
+    elapsed = time.monotonic() - t0
+    assert closed.is_set(), "stall teardown never fired close_write"
+    assert elapsed < 15, f"drain teardown took {elapsed:.1f}s"
+    assert stats["ok"] is False  # a stalled session must not report ok
+    assert stats["changes"] == 1 and stats["digests"] == 1
+
+
+def test_slow_upload_then_burst_is_not_torn_down_as_stalled():
+    """The mid-session digest-flush stall check must measure the stall
+    from when the backpressure wait STARTS, not from the last reply
+    byte: a client that uploads quietly for longer than drain_timeout
+    (one huge blob, no digest traffic) and then triggers a reply burst
+    that crosses the encoder high-water mark is healthy — pre-fix the
+    first 0.1s poll compared against the stale progress clock and tore
+    the session down with TimeoutError while the client was reading
+    promptly (drain-loop parity: serve-side line resets the clock at
+    drain entry; this wait did not)."""
+    import time
+
+    enc = protocol.encode()
+    n = 1400  # digest replies ~60B framed each: crosses the 64 KiB HW
+    for i in range(n):
+        enc.change({"key": f"k{i}", "change": i, "from": 0, "to": 1,
+                    "value": b"x" * 8})
+    enc.finalize()
+    wire = enc.read()
+
+    state = {"fed": False}
+
+    def read_bytes(_n):
+        if state["fed"]:
+            return b""
+        state["fed"] = True
+        # quiet upload stretch longer than drain_timeout, THEN the burst
+        time.sleep(2.0)
+        return wire
+
+    release = threading.Event()
+    writes = []
+
+    def write_bytes(data):
+        # healthy-but-momentarily-busy peer: the first write is in
+        # flight for ~0.5s (well under drain_timeout) while the digest
+        # burst crosses the high-water mark behind it
+        if not writes:
+            writes.append(len(data))
+            release.wait(10)
+        else:
+            writes.append(len(data))
+
+    threading.Timer(2.5, release.set).start()
+    stats = sidecar.run_session(read_bytes, write_bytes,
+                                close_write=lambda: None,
+                                drain_timeout=1.5)
+    assert stats["ok"] is True, f"healthy session torn down: {stats}"
+    assert stats["digests"] == n
