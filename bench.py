@@ -13,6 +13,8 @@ The headline metric is config 3 (the 50 GiB/s north-star target);
   3 hash          GiB/s of batched BLAKE2b blob hashing   (target 50)
   4 cdc           GiB/s of content-defined chunking incl. host select
   5 merkle_diff   entries/sec of two-snapshot tree diff    (target 10M)
+  6 resume        ms from transport fault to first re-delivered frame
+                  (checkpoint export -> reconnect -> redelivery; ROBUSTNESS.md)
 
 Robustness (round-1 failure was a backend-init crash that cost the round
 its only perf artifact): device-backend init is retried with backoff and
@@ -22,7 +24,8 @@ on every backend (<30 s on CPU).
 
 Env knobs: BENCH_ITEMS / BENCH_ITEM_MIB / BENCH_CHUNK (config 3),
 BENCH_REPLAY_ROWS, BENCH_CDC_MIB / BENCH_CDC_REPS, BENCH_MERKLE_LOG2,
-BENCH_ROUNDTRIPS, BENCH_CONFIGS (comma list, default "1,2,3,4,5").
+BENCH_ROUNDTRIPS, BENCH_RESUME_ROWS / BENCH_RESUME_REPS (config 6),
+BENCH_CONFIGS (comma list, default "1,2,3,4,5,6").
 """
 
 from __future__ import annotations
@@ -1114,6 +1117,100 @@ def bench_merkle(quick: bool, backend: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# config 6: resume latency — checkpoint export -> reconnect -> first
+# re-delivered frame (ROBUSTNESS.md's recovery-cost number)
+# ---------------------------------------------------------------------------
+
+
+def bench_resume(quick: bool, backend: str) -> dict:
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.session.faults import (
+        FaultPlan,
+        FaultyReader,
+        TransportFault,
+        bytes_reader,
+    )
+    from dat_replication_protocol_tpu.session.reconnect import (
+        BackoffPolicy,
+        run_resumable,
+    )
+    from dat_replication_protocol_tpu.session.resume import WireJournal
+
+    rows = _env_int("BENCH_RESUME_ROWS", 2_000 if quick else 20_000)
+    reps = _env_int("BENCH_RESUME_REPS", 20 if quick else 100)
+
+    enc = protocol.encode()
+    journal = WireJournal()
+    enc.attach_journal(journal)
+    for i in range(rows):
+        enc.change({"key": f"key-{i:07d}", "change": i, "from": i,
+                    "to": i + 1, "value": b"v" * (i % 48)})
+    enc.finalize()
+    while enc.read(1 << 18) is not None:
+        pass
+    wire = journal.read_from(0)
+    drop_at = len(wire) // 2
+
+    lat = []
+
+    def one() -> None:
+        dec = protocol.decode()
+        times = {}
+
+        class TimedReader(FaultyReader):
+            def read(self, n):
+                try:
+                    return super().read(n)
+                except TransportFault:
+                    times["fault"] = time.perf_counter()
+                    raise
+
+        def on_change_after(c, done):
+            if "fault" in times and "redeliver" not in times:
+                times["redeliver"] = time.perf_counter()
+            done()
+
+        dec.change(on_change_after)
+
+        def source(ckpt, failures):
+            plan = FaultPlan(
+                seed=failures,
+                drop_at=(drop_at - ckpt.wire_offset) if failures == 0 else None,
+            )
+            return TimedReader(bytes_reader(wire[ckpt.wire_offset:]), plan)
+
+        # base=0: measure the machinery, not the (configurable) backoff
+        run_resumable(source, dec,
+                      BackoffPolicy(base=0.0, max_retries=2, seed=0),
+                      chunk_size=1 << 16, expected_total=len(wire),
+                      stall_timeout=30)
+        assert dec.finished and dec.changes == rows
+        lat.append(times["redeliver"] - times["fault"])
+
+    one()  # correctness gate + warmup
+    lat.clear()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        one()
+    dt = time.perf_counter() - t0
+    lat_ms = sorted(x * 1e3 for x in lat)
+    med = statistics.median(lat_ms)
+    log(f"bench[resume]: {reps} faulted sessions ({rows} rows) in {dt:.2f}s; "
+        f"fault->first-redelivered-frame median {med:.3f} ms "
+        f"(p90 {lat_ms[int(0.9 * (len(lat_ms) - 1))]:.3f} ms)")
+    return {
+        "metric": "resume_latency",
+        "value": round(med, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "p90_ms": round(lat_ms[int(0.9 * (len(lat_ms) - 1))], 3),
+        "rows": rows,
+        "wire_bytes": len(wire),
+        "sessions_s": round(reps / dt, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
 
 
 BENCHES = {
@@ -1122,6 +1219,7 @@ BENCHES = {
     "3": ("hash", bench_hash),
     "4": ("cdc", bench_cdc),
     "5": ("merkle_diff", bench_merkle),
+    "6": ("resume", bench_resume),
 }
 
 
@@ -1171,7 +1269,7 @@ def main() -> None:
             trace_dir = "/tmp/dat_bench_trace"
     which = [
         k.strip()
-        for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
+        for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6").split(",")
         if k.strip() in BENCHES
     ]
 
@@ -1205,10 +1303,10 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             _state["configs"][name] = {"error": f"{type(e).__name__}: {e}"}
 
-    # configs 1-2 need no JAX: run them before any backend init so a
+    # configs 1, 2, 6 need no JAX: run them before any backend init so a
     # wedged/broken device stack cannot cost their numbers
     for key in which:
-        if key in ("1", "2"):
+        if key in ("1", "2", "6"):
             run_config(key, "host")
 
     # priority order for the device leg: the headline hash config first,
@@ -1216,7 +1314,8 @@ def main() -> None:
     # that appears late in the budget must still yield config 3
     priority = {"3": 0, "5": 1, "4": 2}
     device_keys = sorted(
-        (k for k in which if k not in ("1", "2")), key=lambda k: priority.get(k, 9)
+        (k for k in which if k not in ("1", "2", "6")),
+        key=lambda k: priority.get(k, 9)
     )
     if device_keys:
         deadline_ts = start_ts + deadline

@@ -8,6 +8,7 @@ module here plus a known-bad/known-good fixture pair in
 
 from __future__ import annotations
 
+from .bounded_wait import BoundedWait
 from .cursor_coherence import CursorCoherence
 from .env_cache import EnvCachePolicy
 from .jit_purity import JitPurity
@@ -18,6 +19,7 @@ ALL_RULES = (
     CursorCoherence(),
     EnvCachePolicy(),
     UnboundedJoin(),
+    BoundedWait(),
     JitPurity(),
     WireConstantParity(),
 )

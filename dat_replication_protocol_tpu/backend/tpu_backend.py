@@ -277,6 +277,14 @@ class TpuDecoder(Decoder):
     def digest_pipeline(self) -> DigestPipeline:
         return self._pipeline
 
+    def _checkpoint_digest(self) -> dict:
+        # the running digest state a resumed session must continue from:
+        # the next change/blob digest sequence numbers.  Per-payload
+        # digests are independent (no chaining across frames), so the
+        # counters ARE the whole state — a reconnected decoder keeps
+        # numbering without gaps or repeats (see ROBUSTNESS.md).
+        return {"change_seq": self._change_seq, "blob_seq": self._blob_seq}
+
     # -- hooks into the parser ----------------------------------------------
 
     def _emit_digest(self, kind: str, seq: int, digest: bytes) -> None:

@@ -1,4 +1,5 @@
-"""L2 session layer: Encoder / Decoder objects and the loopback pipe."""
+"""L2 session layer: Encoder / Decoder objects, the loopback pipe, and
+the fault-and-recovery layer (faults / resume / reconnect)."""
 
 from .decoder import BlobReader, Decoder, DecoderDestroyedError
 from .encoder import (
@@ -7,7 +8,10 @@ from .encoder import (
     Encoder,
     EncoderDestroyedError,
 )
+from .faults import FaultPlan, FaultyReader, FaultyWriter, TransportFault
 from .pipe import Pipe, pipe
+from .reconnect import BackoffPolicy, run_resumable
+from .resume import ResumeError, SessionCheckpoint, WireJournal
 
 __all__ = [
     "BlobReader",
@@ -19,4 +23,13 @@ __all__ = [
     "EncoderDestroyedError",
     "Pipe",
     "pipe",
+    "FaultPlan",
+    "FaultyReader",
+    "FaultyWriter",
+    "TransportFault",
+    "BackoffPolicy",
+    "run_resumable",
+    "ResumeError",
+    "SessionCheckpoint",
+    "WireJournal",
 ]

@@ -27,17 +27,64 @@ from __future__ import annotations
 
 import asyncio
 
+from ..wire.framing import ProtocolError
 from .decoder import Decoder, DecoderDestroyedError
 from .encoder import Encoder, EncoderDestroyedError
-from .transport import DEFAULT_CHUNK
+from .transport import DEFAULT_CHUNK, WAKE_FALLBACK
+
+
+async def _bounded_wait(event: asyncio.Event) -> None:
+    """Await ``event`` with the guarded-fallback bound: the waiter wakes
+    on the event OR after :data:`~.transport.WAKE_FALLBACK` seconds and
+    re-checks its loop condition — a lost wakeup degrades to a short
+    delay instead of a parked-forever pump (the bounded-wait doctrine,
+    ROBUSTNESS.md; enforced package-wide by datlint's bounded-wait
+    rule)."""
+    try:
+        await asyncio.wait_for(event.wait(), WAKE_FALLBACK)
+    except asyncio.TimeoutError:
+        pass
+
+
+async def _drain_with_stall_detect(encoder: Encoder,
+                                   writer: asyncio.StreamWriter,
+                                   stall_timeout: float) -> bool:
+    """Drain with a PROGRESS deadline, not a completion deadline: a
+    slow-but-live peer (buffer shrinking) re-arms the stall clock every
+    ``stall_timeout`` window; only a peer whose window made no progress
+    at all is declared stalled (structured error, encoder destroyed).
+    Returns False when the session was failed."""
+    while True:
+        before = writer.transport.get_write_buffer_size()
+        try:
+            await asyncio.wait_for(writer.drain(), stall_timeout)
+            return True
+        except asyncio.TimeoutError:
+            if writer.transport.get_write_buffer_size() < before:
+                continue  # the peer IS reading, just slowly: re-arm
+            err = ProtocolError(
+                f"peer stalled: no drain progress for {stall_timeout}s",
+                offset=encoder.bytes,
+            )
+            if not encoder.destroyed:
+                encoder.destroy(err)
+            return False
 
 
 async def send_over_async(
     encoder: Encoder,
     writer: asyncio.StreamWriter,
     chunk_size: int = DEFAULT_CHUNK,
+    stall_timeout: float | None = None,
 ) -> None:
-    """Pump ``encoder`` into an asyncio writer until EOF or destroy."""
+    """Pump ``encoder`` into an asyncio writer until EOF or destroy.
+
+    ``stall_timeout`` bounds drain *progress*, not completion: a peer
+    that reads nothing for that long fails the session with a structured
+    :class:`~..wire.framing.ProtocolError` instead of parking this task
+    forever, while a slow-but-live peer (send buffer still shrinking)
+    re-arms the clock each window; ``None`` trusts the peer entirely.
+    """
     readable = asyncio.Event()
     encoder._attach_readable(readable.set)
     encoder.on_error(lambda _e: readable.set())
@@ -50,12 +97,19 @@ async def send_over_async(
             if data is None:  # finalized and drained
                 break
             if not data:
-                await readable.wait()
+                await _bounded_wait(readable)
                 readable.clear()
                 continue
             try:
                 writer.write(bytes(data))
-                await writer.drain()  # congestion backpressure
+                if stall_timeout is None:
+                    # congestion backpressure; unbounded by explicit
+                    # choice — see stall_timeout in the docstring
+                    # datlint: allow-unbounded-wait (opt-in via stall_timeout)
+                    await writer.drain()
+                elif not await _drain_with_stall_detect(
+                        encoder, writer, stall_timeout):
+                    break
             except OSError as e:  # incl. every ConnectionError subclass
                 # peer gone mid-session: nothing downstream will read
                 # these bytes — cascade into the encoder (failure
@@ -74,10 +128,15 @@ async def send_over_async(
 
 async def recv_over_async(
     decoder: Decoder,
-    reader: asyncio.StreamReader,
+    reader,
     chunk_size: int = DEFAULT_CHUNK,
 ) -> None:
-    """Pump an asyncio reader into ``decoder`` until EOF or destroy."""
+    """Pump an asyncio reader into ``decoder`` until EOF or destroy.
+
+    ``reader`` is anything with ``async read(n)`` — an
+    ``asyncio.StreamReader`` or a fault-injecting wrapper
+    (:class:`~.faults.AsyncFaultyReader`).
+    """
     while not decoder.destroyed:
         try:
             data = await reader.read(chunk_size)
@@ -98,10 +157,45 @@ async def recv_over_async(
         except DecoderDestroyedError:
             return
         if not consumed:
-            # single-threaded: the ack that drains the decoder runs on
-            # this loop, so the event cannot be missed (contrast the
-            # threaded pump's bounded poll, transport.py:recv_over)
-            await drained.wait()
+            # acks run on this loop so the event itself cannot be
+            # missed, but the wait is bounded anyway: one doctrine for
+            # every pump (a bug that defers the ack off-loop degrades
+            # to a fallback-period delay, not a hang)
+            while not (decoder.writable() or decoder.destroyed
+                       or decoder.finished):
+                await _bounded_wait(drained)
+                drained.clear()
+
+
+async def open_connection_with_retry(
+    host: str,
+    port: int,
+    policy=None,
+) -> tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+    """``asyncio.open_connection`` under the reconnect backoff policy.
+
+    Retries refused/failed dials with exponential backoff + full jitter
+    (:class:`~.reconnect.BackoffPolicy`); exhausting the attempts raises
+    ONE structured :class:`~..wire.framing.ProtocolError` wrapping the
+    last ``OSError`` — the asyncio face of the reconnect driver.
+    """
+    from .reconnect import BackoffPolicy
+
+    if policy is None:
+        policy = BackoffPolicy()
+    failures = 0
+    while True:
+        try:
+            return await asyncio.open_connection(host, port)
+        except OSError as e:
+            failures += 1
+            if failures > policy.max_retries:
+                raise ProtocolError(
+                    f"connect to {host}:{port} failed after {failures} "
+                    f"attempt(s)",
+                    cause=e,
+                ) from e
+            await asyncio.sleep(policy.delay(failures))
 
 
 async def session_over_asyncio(

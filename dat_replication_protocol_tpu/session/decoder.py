@@ -209,6 +209,11 @@ class Decoder:
         self._end_queued = False
         self._end_cb: OnDone = None
         self._consuming = False  # reentrancy guard for _consume
+        # drain watchers: persistent callbacks fired whenever a stall
+        # clears (or the decoder dies), so a transport pump parked on
+        # "not writable" wakes immediately on a cross-thread ack instead
+        # of rediscovering the state on a poll (transport.recv_over)
+        self._drain_watchers: list[Callable[[], None]] = []
         # serializes _FastAck state transitions against cross-thread acks
         self._ack_lock = threading.Lock()
         # dat_fastpath AckBoard (outstanding C-side armed acks), created
@@ -303,6 +308,8 @@ class Decoder:
         cbs, self._write_cbs = self._write_cbs, []
         for cb in cbs:
             cb()
+        # ... and wake persistent drain watchers for the same reason
+        self._notify_drain_watchers()
 
     def writable(self) -> bool:
         return not (
@@ -311,6 +318,77 @@ class Decoder:
             or self._bulk is not None
             or self.destroyed
             or self.finished
+        )
+
+    def checkpoint(self):
+        """Export this instant's session progress (resume support).
+
+        Cheap and side-effect-free: a :class:`~.resume.SessionCheckpoint`
+        whose ``wire_offset`` is the count of wire bytes this decoder has
+        accepted — the exact byte a reconnecting sender must resume from
+        (parser state, including mid-frame cursors and unparsed overflow,
+        lives on in this object).  The frame/row/blob cursors and the
+        backend digest state ride along for observability and structured
+        error context.  See ROBUSTNESS.md.
+        """
+        from .resume import SessionCheckpoint
+
+        blob = self._current_blob
+        return SessionCheckpoint(
+            wire_offset=self.bytes,
+            frame=self._frames_delivered(),
+            row=self.changes,
+            blob_offset=blob.received if blob is not None else 0,
+            digest=self._checkpoint_digest(),
+        )
+
+    def _frames_delivered(self) -> int:
+        """Frames fully delivered — the single frame-index authority for
+        checkpoints AND structured error context (they must agree).
+        ``blobs`` counts at OPEN (header time): a blob mid-payload is
+        the frame being parsed, not a delivered one."""
+        return (self.changes + self.blobs
+                - (1 if self._current_blob is not None else 0))
+
+    def _checkpoint_digest(self) -> dict:
+        """Backend hook: running digest state to carry in a checkpoint
+        (the TPU decoder records its emitted sequence counters).  Base:
+        no digest surface, nothing to record."""
+        return {}
+
+    # -- drain watchers ------------------------------------------------------
+
+    def _add_drain_watcher(self, cb: Callable[[], None]) -> None:
+        """Register a persistent wakeup hook: fired (possibly from the
+        acking thread) whenever parsing becomes unblocked, so a pump
+        waiting on ``writable()`` can park on an event instead of
+        polling.  Unlike ``write``'s one-shot ``on_consumed`` callbacks
+        these survive across writes; remove with
+        :meth:`_remove_drain_watcher`."""
+        self._drain_watchers.append(cb)
+
+    def _remove_drain_watcher(self, cb: Callable[[], None]) -> None:
+        try:
+            self._drain_watchers.remove(cb)
+        except ValueError:
+            pass
+
+    def _notify_drain_watchers(self) -> None:
+        for cb in list(self._drain_watchers):
+            cb()
+
+    def _protocol_error(self, message: str,
+                        cause: BaseException | None = None) -> ProtocolError:
+        """Structured wire error: every ProtocolError this decoder
+        raises carries the frame index and byte offset where parsing
+        stood — the session-context half of the robustness contract
+        (ROBUSTNESS.md), so operators see *where* a stream broke instead
+        of a bare message."""
+        return ProtocolError(
+            message,
+            frame=self._frames_delivered(),
+            offset=self.bytes,
+            cause=cause,
         )
 
     # -- flow control --------------------------------------------------------
@@ -342,7 +420,16 @@ class Decoder:
         # chunk's unparsed remainder in a local — it will keep going (pending
         # just dropped) and run the drained notifications itself, so a nested
         # resume must be a no-op rather than observe a falsely-empty overflow.
-        if self.destroyed or self._stalled() or self._consuming:
+        if self.destroyed or self._stalled():
+            return
+        if self._drain_watchers:
+            # fire BEFORE the _consuming check: when the outer loop is
+            # live on another thread's stack, it may already be past its
+            # own drained-epilogue — this notify is then the only wakeup
+            # a parked pump gets (the lost-wakeup the transport's old
+            # bounded poll papered over)
+            self._notify_drain_watchers()
+        if self._consuming:
             return
         self._consume()
 
@@ -358,7 +445,7 @@ class Decoder:
         ):
             return
         if self._state != TYPE_HEADER or self._header:
-            self.destroy(ProtocolError("stream ended mid-frame"))
+            self.destroy(self._protocol_error("stream ended mid-frame"))
             return
         self._end_queued = False  # run once
 
@@ -487,6 +574,7 @@ class Decoder:
             for cb in cbs:
                 cb()
             self._maybe_finalize()
+            self._notify_drain_watchers()
 
     def _ov_appendleft(self, mv: memoryview) -> None:
         self._overflow.appendleft(mv)
@@ -684,7 +772,7 @@ class Decoder:
                                 )
                             except ValueError as e:  # incl. UnicodeDecodeError
                                 self._bulk = None
-                                self.destroy(ProtocolError(str(e)))
+                                self.destroy(self._protocol_error(str(e), cause=e))
                                 return
                         else:
                             # no registered handler will ever see the object
@@ -701,7 +789,7 @@ class Decoder:
                                     str(buf[so : so + sl], "utf-8")
                             except ValueError as e:
                                 self._bulk = None
-                                self.destroy(ProtocolError(str(e)))
+                                self.destroy(self._protocol_error(str(e), cause=e))
                                 return
                             change = None
                         # delivery consumes the frame: advance BOTH
@@ -748,7 +836,7 @@ class Decoder:
                 else:
                     self._bulk = None
                     self.destroy(
-                        ProtocolError(
+                        self._protocol_error(
                             f"Protocol error, unknown type: {type_id}")
                     )
                     return
@@ -817,7 +905,7 @@ class Decoder:
                 if use_tap:
                     self._note_change_payloads(sink, st["row"] - row0)
             if status == 2:
-                self.destroy(ProtocolError(
+                self.destroy(self._protocol_error(
                     st.pop("decode_error", "invalid change payload")))
             return f
 
@@ -851,7 +939,7 @@ class Decoder:
                     c.subset = (bbuf[so : so + sl].decode("utf-8")
                                 if sl >= 0 else "")
                 except ValueError as e:  # incl. UnicodeDecodeError
-                    self.destroy(ProtocolError(str(e)))
+                    self.destroy(self._protocol_error(str(e), cause=e))
                     return f
                 if sink is not None:  # valid frame: its digest is owed
                     fs = fstarts[f]
@@ -910,13 +998,13 @@ class Decoder:
                 try:
                     framed_len, _ = decode_uvarint(self._header)
                 except ValueError as e:  # e.g. varint exceeds 64 bits
-                    self.destroy(ProtocolError(str(e)))
+                    self.destroy(self._protocol_error(str(e), cause=e))
                     return None
                 type_id = self._header[-1]
                 self._header.clear()
                 self._missing = framed_len - 1  # length counts the id byte
                 if framed_len < 1:
-                    self.destroy(ProtocolError("frame length must be >= 1"))
+                    self.destroy(self._protocol_error("frame length must be >= 1"))
                     return None
                 if type_id == TYPE_CHANGE:
                     self._state = TYPE_CHANGE
@@ -935,12 +1023,13 @@ class Decoder:
                         raise
                 else:
                     self.destroy(
-                        ProtocolError(f"Protocol error, unknown type: {type_id}")
+                        self._protocol_error(
+                            f"Protocol error, unknown type: {type_id}")
                     )
                     return None
                 return chunk[i:]
             if len(self._header) >= MAX_HEADER_LEN:
-                self.destroy(ProtocolError("frame header too long"))
+                self.destroy(self._protocol_error("frame header too long"))
                 return None
         return None
 
@@ -980,7 +1069,7 @@ class Decoder:
         try:
             change = decode_change(payload)
         except ValueError as e:
-            self.destroy(ProtocolError(str(e)))
+            self.destroy(self._protocol_error(str(e), cause=e))
             return
         self._deliver_change(change, payload)
 

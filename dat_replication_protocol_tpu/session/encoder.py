@@ -190,6 +190,10 @@ class Encoder:
         # bytes become readable, so a connected pump keeps flowing on late
         # writes — the pull-based stand-in for Node's 'readable' event.
         self._on_readable: Optional[Callable[[], None]] = None
+        # Resume tee (see session.resume.WireJournal): every byte read()
+        # hands out is also appended here, so a reconnect can replay the
+        # bytes a dead transport lost.
+        self._journal = None
 
     def _attach_readable(self, cb: Callable[[], None]) -> None:
         """Claim the single readable-hook slot.  A second pump silently
@@ -202,6 +206,29 @@ class Encoder:
 
     def _detach_readable(self) -> None:
         self._on_readable = None
+
+    def attach_journal(self, journal) -> None:
+        """Tee every wire byte :meth:`read` returns into ``journal``
+        (anything with ``append(bytes)`` — canonically a
+        :class:`~.resume.WireJournal`), so the session can resume from a
+        receiver checkpoint after a transport failure.  The journal sees
+        bytes in exact wire order because ``read`` is the single exit
+        point of the output queue.
+
+        Journal positions are ABSOLUTE wire offsets: attaching after
+        bytes were already read out aligns the journal's window past
+        them (via ``journal.seek``) — silently recording them at offset
+        0 would make every ``read_from(checkpoint.wire_offset)`` replay
+        the wrong bytes."""
+        delivered = self.bytes - self._queued_bytes  # already read out
+        if delivered:
+            seek = getattr(journal, "seek", None)
+            if seek is None:
+                raise RuntimeError(
+                    f"encoder already emitted {delivered} byte(s) and the "
+                    "journal cannot seek; attach before the first read")
+            seek(delivered)
+        self._journal = journal
 
     # -- public API ---------------------------------------------------------
 
@@ -295,6 +322,11 @@ class Encoder:
                 self._queue[0] = (payload[room:], cb)
                 self._queued_bytes -= room
                 break
+        data = bytes(out)
+        if self._journal is not None and data:
+            # journal BEFORE the flush callbacks: when an on_flush hook
+            # acks the journal window, the bytes it acks must be there
+            self._journal.append(data)
         below = not self._above_high_water()
         for cb in fired:
             cb()
@@ -307,7 +339,7 @@ class Encoder:
                 cb, self._finalize_cb = self._finalize_cb, None
                 cb()
             self._fire_finish()
-        return bytes(out)
+        return data
 
     @property
     def buffered_bytes(self) -> int:

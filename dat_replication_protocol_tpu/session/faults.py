@@ -1,0 +1,297 @@
+"""Deterministic fault injection for session transports — the chaos harness.
+
+The reference's only failure semantics is "destroy the stream"
+(reference: decode.js:104-110, encode.js:69-75); reproducing and then
+*surviving* transport faults needs a way to manufacture them on demand,
+repeatably.  This module wraps the byte-level transport contract both
+pump families speak — the threaded pumps' ``read_bytes(n) -> bytes`` /
+``write_bytes(data)`` callables (:mod:`.transport`) and the asyncio
+pumps' ``await reader.read(n)`` (:mod:`.aio`) — with a seed-driven
+:class:`FaultPlan` that can:
+
+* **re-segment**: deliver reads in arbitrary-size pieces (down to one
+  byte), exercising every header/payload straddle the parser has;
+* **truncate**: fake a clean EOF mid-stream (the silent-truncation
+  fault — indistinguishable in-band from a finished session, which is
+  exactly why the resume layer checks the sender's declared length,
+  see ROBUSTNESS.md);
+* **drop**: raise :class:`TransportFault` once a chosen byte offset has
+  been delivered (the mid-session disconnect);
+* **flip**: XOR one byte at a chosen offset (wire corruption; a flipped
+  *header* byte surfaces as a structured ProtocolError, a flipped
+  *payload* byte is undetectable at the wire layer by design — the
+  digest pipeline is the end-to-end integrity answer);
+* **stall / latency**: inject one long pause at a chosen offset and/or
+  small per-read delays, exercising every bounded-wait path.
+
+Everything is derived from ``random.Random(seed)``: the same plan over
+the same bytes produces the same faults, so a failing seed is a
+reproducer, not a flake.  :meth:`FaultPlan.for_sweep` is the shared
+scenario generator the conformance sweep (tests/test_session_faults.py)
+and future robustness work key off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional
+
+__all__ = [
+    "TransportFault",
+    "FaultPlan",
+    "FaultyReader",
+    "FaultyWriter",
+    "AsyncFaultyReader",
+    "bytes_reader",
+]
+
+
+class TransportFault(ConnectionError):
+    """An injected (or detected) connection-level failure.
+
+    Distinct from :class:`~..wire.framing.ProtocolError`: a transport
+    fault says nothing about the bytes that *did* arrive — the session
+    is resumable from the receiver's checkpoint.  ``offset`` is the
+    number of bytes this connection delivered before dying.
+    """
+
+    def __init__(self, message: str, *, offset: int | None = None):
+        super().__init__(message)
+        self.offset = offset
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """What one connection will do to the bytes passing through it.
+
+    All offsets are relative to this connection's first delivered byte
+    (a resumed connection starts its own plan at 0).  ``None`` disables
+    a fault.  The plan is pure data — the wrapper classes below own the
+    clock and the randomness (seeded from ``seed``).
+    """
+
+    seed: int = 0
+    max_segment: Optional[int] = None    # re-segment reads into [1, max_segment]
+    drop_at: Optional[int] = None        # raise TransportFault at this offset
+    truncate_at: Optional[int] = None    # fake clean EOF at this offset
+    flip_at: Optional[int] = None        # XOR one byte at this offset
+    flip_mask: int = 0xFF                # never 0 (a 0-mask flips nothing)
+    stall_at: Optional[int] = None       # one long pause before this offset
+    stall_s: float = 0.0
+    latency_prob: float = 0.0            # per-read chance of a small sleep
+    latency_s: float = 0.0
+
+    # the disconnect-class scenarios: faults a correct resume layer must
+    # absorb without changing the decoded session (corruption is a
+    # different class — it must ERROR, and gets targeted tests)
+    SWEEP_SCENARIOS = ("drop", "truncate", "stall", "reseg")
+
+    @classmethod
+    def for_sweep(cls, seed: int, wire_len: int, attempt: int = 0) -> "FaultPlan":
+        """The conformance-sweep scenario for ``(seed, attempt)``.
+
+        Attempt 0 carries the seed's primary fault, attempt 1 has a 50%
+        chance of a second fault (a reconnect that dies too), attempts
+        >= 2 are clean apart from aggressive re-segmentation — so every
+        seed converges within a bounded number of reconnects while still
+        exercising double faults.  Deterministic: same (seed, attempt,
+        wire_len) -> same plan.
+        """
+        rng = random.Random(seed * 1_000_003 + attempt)
+        span = max(1, wire_len)
+        plan = cls(
+            seed=rng.randrange(1 << 30),
+            max_segment=rng.choice([1, 3, 7, 64, 1024, None]),
+            latency_prob=rng.choice([0.0, 0.0, 0.05]),
+            latency_s=0.001,
+        )
+        if attempt >= 2 or (attempt == 1 and rng.random() < 0.5):
+            return plan
+        scenario = rng.choice(cls.SWEEP_SCENARIOS)
+        at = rng.randrange(span)
+        if scenario == "drop":
+            plan.drop_at = at
+        elif scenario == "truncate":
+            plan.truncate_at = at
+        elif scenario == "stall":
+            plan.stall_at = at
+            plan.stall_s = 0.02
+        # "reseg": byte-at-a-time delivery IS the fault
+        if scenario == "reseg":
+            plan.max_segment = 1
+        return plan
+
+
+class _FaultState:
+    """Plan execution shared by the sync and async wrappers: decides the
+    next segment size (or EOF / fault), applies the byte flip, and keeps
+    the delivered-byte offset — everything except the actual pull and
+    the actual sleep, which differ between the thread and event-loop
+    worlds."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.offset = 0  # bytes delivered downstream on THIS connection
+        self._rng = random.Random(plan.seed)
+        self._stalled = False
+        self._dead = False
+
+    def pre_read(self, n: int) -> tuple[Optional[int], float]:
+        """(segment limit, sleep seconds) for the next read; limit None
+        means injected clean EOF.  Raises on an injected drop."""
+        p = self.plan
+        if self._dead:
+            raise TransportFault(
+                f"connection already dropped at byte {self.offset}",
+                offset=self.offset)
+        if p.drop_at is not None and self.offset >= p.drop_at:
+            self._dead = True
+            raise TransportFault(
+                f"injected disconnect at byte {self.offset}",
+                offset=self.offset)
+        if p.truncate_at is not None and self.offset >= p.truncate_at:
+            return None, 0.0
+        limit = max(1, n)
+        if p.max_segment:
+            limit = self._rng.randint(1, max(1, min(limit, p.max_segment)))
+        if p.drop_at is not None:
+            limit = min(limit, p.drop_at - self.offset)
+        if p.truncate_at is not None:
+            limit = min(limit, p.truncate_at - self.offset)
+        sleep_s = 0.0
+        if (p.stall_at is not None and not self._stalled
+                and self.offset >= p.stall_at):
+            self._stalled = True
+            sleep_s += p.stall_s
+        if p.latency_prob and self._rng.random() < p.latency_prob:
+            sleep_s += p.latency_s
+        return limit, sleep_s
+
+    def deliver(self, chunk: bytes) -> bytes:
+        """Apply the byte flip (if it lands in this chunk) and advance."""
+        p = self.plan
+        if (p.flip_at is not None
+                and self.offset <= p.flip_at < self.offset + len(chunk)):
+            i = p.flip_at - self.offset
+            mask = p.flip_mask or 0xFF
+            chunk = chunk[:i] + bytes((chunk[i] ^ mask,)) + chunk[i + 1:]
+        self.offset += len(chunk)
+        return chunk
+
+
+class FaultyReader:
+    """Pull-side wrapper for the threaded transport contract.
+
+    ``read(n)`` returns up to ``n`` bytes, ``b''`` at (real or injected)
+    EOF, and raises :class:`TransportFault` on an injected drop —
+    exactly the ``read_bytes`` shape :func:`.transport.recv_over` and
+    the reconnect driver consume.
+    """
+
+    def __init__(self, read_bytes: Callable[[int], bytes], plan: FaultPlan,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._read = read_bytes
+        self._state = _FaultState(plan)
+        self._sleep = sleep
+        self._pending = bytearray()  # pulled upstream, not yet delivered
+
+    @property
+    def offset(self) -> int:
+        return self._state.offset
+
+    def read(self, n: int) -> bytes:
+        limit, sleep_s = self._state.pre_read(n)
+        if sleep_s:
+            self._sleep(sleep_s)
+        if limit is None:
+            return b""  # injected truncation: a clean-looking EOF
+        while not self._pending:
+            data = self._read(n)
+            if not data:
+                return b""  # upstream EOF
+            self._pending += data
+        take = min(limit, len(self._pending))
+        out = bytes(self._pending[:take])
+        del self._pending[:take]
+        return self._state.deliver(out)
+
+
+class AsyncFaultyReader:
+    """The asyncio twin of :class:`FaultyReader`: wraps any object with
+    ``async read(n)`` (e.g. an ``asyncio.StreamReader``); byte-for-byte
+    identical fault behavior for the same plan."""
+
+    def __init__(self, reader, plan: FaultPlan):
+        self._reader = reader
+        self._state = _FaultState(plan)
+        self._pending = bytearray()
+
+    @property
+    def offset(self) -> int:
+        return self._state.offset
+
+    async def read(self, n: int) -> bytes:
+        import asyncio
+
+        limit, sleep_s = self._state.pre_read(n)
+        if sleep_s:
+            await asyncio.sleep(sleep_s)
+        if limit is None:
+            return b""
+        while not self._pending:
+            data = await self._reader.read(n)
+            if not data:
+                return b""
+            self._pending += data
+        take = min(limit, len(self._pending))
+        out = bytes(self._pending[:take])
+        del self._pending[:take]
+        return self._state.deliver(out)
+
+
+class FaultyWriter:
+    """Push-side wrapper: re-segments, delays, flips, and drops writes.
+
+    Wraps a ``write_bytes(data)`` callable (the :func:`.transport.send_over`
+    sink).  A drop surfaces as :class:`TransportFault` from ``write``,
+    which the sending pump treats like any transport error.
+    """
+
+    def __init__(self, write_bytes: Callable[[bytes], None], plan: FaultPlan,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._write = write_bytes
+        self._state = _FaultState(plan)
+        self._sleep = sleep
+
+    @property
+    def offset(self) -> int:
+        return self._state.offset
+
+    def write(self, data) -> None:
+        view = memoryview(data)
+        while len(view):
+            limit, sleep_s = self._state.pre_read(len(view))
+            if sleep_s:
+                self._sleep(sleep_s)
+            if limit is None:
+                return  # truncated: silently swallow the tail
+            chunk = self._state.deliver(bytes(view[:limit]))
+            self._write(chunk)
+            view = view[limit:]
+
+
+def bytes_reader(data: bytes) -> Callable[[int], bytes]:
+    """A ``read_bytes``-shaped source over an in-memory byte string —
+    the journal-replay / test-harness building block."""
+    view = memoryview(data)
+    pos = [0]
+
+    def read(n: int) -> bytes:
+        i = pos[0]
+        j = min(len(view), i + max(1, n))
+        pos[0] = j
+        return bytes(view[i:j])
+
+    return read

@@ -1,0 +1,232 @@
+"""Reconnect with exponential backoff + full jitter; the resumable driver.
+
+Policy (ROBUSTNESS.md): attempt ``k`` (1-based) sleeps
+``uniform(0, min(cap, base * 2**k))`` — "full jitter", the variant that
+avoids synchronized reconnect storms when many peers lose the same link
+(the thundering-herd argument; AWS architecture blog's exp-backoff
+study).  Attempts are bounded: once ``max_retries`` transport faults
+accumulate, the driver gives up with ONE structured
+:class:`~..wire.framing.ProtocolError` carrying the last checkpoint's
+frame index / byte offset and the underlying cause — never a hang,
+never a silent partial session.
+
+:func:`run_resumable` is the receive-side driver: it pulls bytes from a
+reconnectable source into a decoder, exporting a checkpoint at every
+fault and asking the source for a fresh connection that resumes from
+it.  The source callable is transport-agnostic — tests hand it a
+fault-injected journal replay (:mod:`.faults`), a real deployment hands
+it a socket dialer.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from ..wire.framing import ProtocolError
+from .decoder import Decoder, DecoderDestroyedError
+from .faults import TransportFault
+from .resume import SessionCheckpoint
+from .transport import DEFAULT_CHUNK
+
+__all__ = ["BackoffPolicy", "retrying", "run_resumable"]
+
+
+class BackoffPolicy:
+    """Exponential backoff with full jitter, bounded attempts.
+
+    ``seed`` pins the jitter for reproducible tests; ``sleep`` is
+    injectable for the same reason.  ``max_retries`` counts *faults
+    absorbed*: the first failure is retried while ``faults <=
+    max_retries``, so ``max_retries=0`` means fail on the first fault.
+    """
+
+    def __init__(self, base: float = 0.05, cap: float = 5.0,
+                 max_retries: int = 5, seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if base < 0 or cap < 0:
+            raise ValueError("backoff base/cap must be >= 0")
+        self.base = base
+        self.cap = cap
+        self.max_retries = max_retries
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+
+    def delay(self, attempt: int) -> float:
+        """Full-jitter delay before retry ``attempt`` (1-based)."""
+        ceiling = min(self.cap, self.base * (2 ** max(0, attempt)))
+        return self._rng.uniform(0.0, ceiling)
+
+    def sleep_before(self, attempt: int) -> float:
+        d = self.delay(attempt)
+        if d > 0:
+            self._sleep(d)
+        return d
+
+
+def retrying(fn: Callable[[], object], policy: BackoffPolicy,
+             retry_on: tuple = (OSError,), describe: str = "operation"):
+    """Run ``fn`` with the policy's backoff until it returns or the
+    attempts are exhausted; the terminal failure is one structured
+    ProtocolError wrapping the last cause."""
+    failures = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            failures += 1
+            if failures > policy.max_retries:
+                raise ProtocolError(
+                    f"{describe} failed after {failures} attempt(s)",
+                    cause=e,
+                ) from e
+            policy.sleep_before(failures)
+
+
+def _wire_error(errors: list, ckpt: SessionCheckpoint) -> ProtocolError:
+    """The decoder destroyed itself: surface its error as ONE structured
+    ProtocolError (wrapping non-protocol causes) with session context."""
+    err = errors[-1] if errors else None
+    if isinstance(err, ProtocolError):
+        return err
+    return ProtocolError(
+        "session destroyed mid-stream",
+        frame=ckpt.frame, offset=ckpt.wire_offset, cause=err,
+    )
+
+
+def run_resumable(
+    source: Callable[[SessionCheckpoint, int], object],
+    decoder: Decoder,
+    policy: BackoffPolicy,
+    chunk_size: int = DEFAULT_CHUNK,
+    expected_total: Optional[int] = None,
+    stall_timeout: Optional[float] = None,
+    wait_step: float = 0.5,
+) -> dict:
+    """Drive a resumable receive session to completion.
+
+    ``source(checkpoint, failures)`` opens a connection delivering wire
+    bytes from ``checkpoint.wire_offset`` onward, as an object with
+    ``read(n) -> bytes`` (``b''`` at EOF).  Connection death — opening
+    or reading — may surface as :class:`TransportFault` or as any plain
+    ``OSError`` (what a real socket raises: ``ConnectionResetError``,
+    ``ETIMEDOUT``, ...); both take the reconnect path.
+
+    Termination is trichotomous, never silent:
+
+    * the decoder finishes with the complete session (returns stats);
+    * ONE structured ProtocolError is raised — wire corruption, resume
+      window lost, app stall past ``stall_timeout``, or attempts
+      exhausted, each with frame/byte/cause context;
+    * (there is no third option: every wait is bounded.)
+
+    ``expected_total``, when the sender's produced length is known
+    out-of-band, turns silent truncation (a clean EOF short of the
+    declared length) into a reconnect instead of a quietly short
+    session — see ROBUSTNESS.md on why in-band detection is impossible
+    for an EOF-terminated wire format.
+    """
+    stats = {"attempts": 0, "reconnects": 0, "faults": []}
+    errors: list = []
+    err_cb = errors.append
+    decoder.on_error(err_cb)
+    wake = threading.Event()
+    decoder._add_drain_watcher(wake.set)
+    failures = 0
+    try:
+        while True:
+            ckpt = decoder.checkpoint()
+            stats["attempts"] += 1
+            # The fault catches wrap ONLY the transport calls (source()
+            # and reader.read) — catching OSError around decoder.write
+            # would misclassify an app handler's own OSError (e.g.
+            # ENOSPC while materializing a blob) as a transport fault
+            # and "resume" a stream the failed delivery desynchronized.
+            # OSError, not just TransportFault: a real socket surfaces
+            # peer death as ConnectionResetError / ETIMEDOUT etc.
+            # (TransportFault is itself a ConnectionError), and all of
+            # it must land in the reconnect path, never escape raw.
+            fault: Optional[OSError] = None
+            try:
+                reader = source(ckpt, failures)
+            except OSError as e:
+                fault = e
+            while fault is None:
+                try:
+                    data = reader.read(chunk_size)
+                except OSError as e:
+                    fault = e
+                    break
+                if not data:
+                    if (expected_total is not None
+                            and decoder.bytes < expected_total):
+                        # silent truncation: the connection closed
+                        # cleanly short of the sender's declared length
+                        # — same recovery path as a drop
+                        fault = TransportFault(
+                            f"truncated: clean EOF at byte "
+                            f"{decoder.bytes} of {expected_total}",
+                            offset=decoder.bytes)
+                    break
+                wake.clear()
+                try:
+                    consumed = decoder.write(data)
+                except DecoderDestroyedError:
+                    raise _wire_error(errors, decoder.checkpoint())
+                if decoder.destroyed:
+                    raise _wire_error(errors, decoder.checkpoint())
+                if not consumed:
+                    _wait_writable(decoder, wake, wait_step, stall_timeout)
+            if fault is not None:
+                failures += 1
+                stats["faults"].append(str(fault))
+                if failures > policy.max_retries:
+                    last = decoder.checkpoint()
+                    raise ProtocolError(
+                        f"session lost after {failures} transport fault(s)",
+                        frame=last.frame, offset=last.wire_offset,
+                        cause=fault,
+                    ) from fault
+                stats["reconnects"] += 1
+                policy.sleep_before(failures)
+                continue
+            # clean EOF this attempt
+            if decoder.destroyed:
+                raise _wire_error(errors, decoder.checkpoint())
+            if not decoder.finished:
+                decoder.end()
+                if decoder.destroyed:  # e.g. EOF mid-frame
+                    raise _wire_error(errors, decoder.checkpoint())
+            return stats
+    finally:
+        decoder._remove_drain_watcher(wake.set)
+        # symmetric cleanup: a long-lived decoder driven through this
+        # function repeatedly must not accumulate stale error hooks
+        try:
+            decoder._error_cbs.remove(err_cb)
+        except ValueError:
+            pass
+
+
+def _wait_writable(decoder: Decoder, wake: threading.Event,
+                   wait_step: float, stall_timeout: Optional[float]) -> None:
+    """Bounded wait for the app to drain the decoder: the drain watcher
+    wakes us immediately on cross-thread acks; ``stall_timeout`` (when
+    set) converts an app that never acks into a structured error
+    instead of a parked-forever driver."""
+    deadline = (None if stall_timeout is None
+                else time.monotonic() + stall_timeout)
+    while not (decoder.writable() or decoder.destroyed or decoder.finished):
+        if deadline is not None and time.monotonic() > deadline:
+            ckpt = decoder.checkpoint()
+            err = ProtocolError(
+                f"app stalled: no ack for {stall_timeout}s",
+                frame=ckpt.frame, offset=ckpt.wire_offset,
+            )
+            decoder.destroy(err)
+            raise err
+        wake.wait(wait_step)
+        wake.clear()

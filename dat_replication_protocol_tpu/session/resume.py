@@ -1,0 +1,141 @@
+"""Session checkpoints and wire journals — resume instead of destroy.
+
+The reference's answer to any mid-session failure is stream destruction
+(reference: decode.js:104-110): the session's progress is simply lost.
+This module adds the thin recovery layer over the *existing* session
+state (no new protocol): the decoder can export a
+:class:`SessionCheckpoint` at any instant, and a sender that kept its
+produced wire bytes in a :class:`WireJournal` can replay exactly the
+bytes past the checkpoint over a fresh connection.
+
+Why a byte-offset checkpoint works: the decoder object survives a
+transport failure untouched — its parser state (mid-header bytes,
+mid-frame payload cursor, unparsed overflow) is all still there, so the
+only thing a reconnect needs is *the next wire byte*.  ``wire_offset``
+is ``decoder.bytes``, the count of wire bytes the decoder has accepted;
+the journal hands back everything from that offset on.  No frame is
+ever re-delivered (no duplicate deliveries) and none is skipped.
+
+The other checkpoint fields — ``frame``, ``row``, ``blob_offset``, and
+the per-backend ``digest`` state — are the coupled cursor tuple the
+cursor-coherence datlint rule guards, exported for observability and
+for the structured :class:`~..wire.framing.ProtocolError` context when
+recovery fails.  See ROBUSTNESS.md for the full failure model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from ..wire.framing import ProtocolError
+
+__all__ = ["SessionCheckpoint", "WireJournal", "ResumeError"]
+
+
+class ResumeError(ProtocolError):
+    """A checkpoint that cannot be honored (e.g. the journal already
+    trimmed past it).  Carries the standard structured context."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionCheckpoint:
+    """One instant of session progress, exported by ``Decoder.checkpoint()``.
+
+    * ``wire_offset`` — wire bytes accepted by the decoder; the resume
+      point (the sender replays from exactly here).
+    * ``frame`` — frames fully delivered (changes + blobs).
+    * ``row`` — change-row cursor (changes delivered so far).
+    * ``blob_offset`` — payload bytes already delivered of the blob open
+      at checkpoint time (0 at a frame boundary).
+    * ``digest`` — backend digest-state (the TPU decoder records its
+      emitted change/blob digest sequence counters so a resumed session
+      continues numbering without gaps or repeats).
+    """
+
+    wire_offset: int
+    frame: int = 0
+    row: int = 0
+    blob_offset: int = 0
+    digest: dict = dataclasses.field(default_factory=dict)
+
+    def as_dict(self) -> dict[str, Any]:
+        """JSON-able form (the out-of-band resume handshake payload)."""
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "SessionCheckpoint":
+        return cls(
+            wire_offset=int(d["wire_offset"]),
+            frame=int(d.get("frame", 0)),
+            row=int(d.get("row", 0)),
+            blob_offset=int(d.get("blob_offset", 0)),
+            digest=dict(d.get("digest", {})),
+        )
+
+
+class WireJournal:
+    """Sender-side retention of produced wire bytes, replayable by offset.
+
+    Attach to an encoder (``encoder.attach_journal(journal)``) and every
+    byte ``read()`` hands to the transport is also recorded here.  On
+    reconnect, ``read_from(checkpoint.wire_offset)`` returns the bytes
+    the old connection lost.  ``ack(offset)`` trims delivered history
+    once the receiver has confirmed it, bounding memory; resuming below
+    the trimmed start raises :class:`ResumeError` (the session is then
+    unrecoverable and must restart from scratch — the structured error
+    says so instead of silently replaying from the wrong place).
+    """
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._start = 0  # wire offset of _buf[0]
+
+    @property
+    def start(self) -> int:
+        return self._start
+
+    @property
+    def end(self) -> int:
+        return self._start + len(self._buf)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def append(self, data) -> None:
+        self._buf += data
+
+    def seek(self, offset: int) -> None:
+        """Align an EMPTY journal's window to an absolute wire offset —
+        used when attaching to an encoder that already emitted bytes
+        (those bytes are unrecoverable; the window starts after them)."""
+        if self._buf:
+            raise ValueError("seek on a non-empty journal")
+        self._start = offset
+
+    def ack(self, offset: int) -> None:
+        """The receiver confirmed bytes below ``offset``: trim them."""
+        if offset <= self._start:
+            return
+        if offset > self.end:
+            raise ValueError(
+                f"ack({offset}) beyond journal end {self.end}")
+        del self._buf[: offset - self._start]
+        self._start = offset
+
+    def read_from(self, offset: int) -> bytes:
+        """Every journaled byte at ``offset`` and beyond (a copy: the
+        journal may keep growing while the replay is in flight)."""
+        if offset < self._start:
+            raise ResumeError(
+                "checkpoint predates the journal's retained window "
+                f"(asked for byte {offset}, journal starts at {self._start})",
+                offset=offset,
+            )
+        if offset > self.end:
+            raise ResumeError(
+                f"checkpoint is ahead of everything produced (byte {offset}, "
+                f"journal ends at {self.end})",
+                offset=offset,
+            )
+        return bytes(self._buf[offset - self._start:])

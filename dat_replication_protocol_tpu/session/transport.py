@@ -45,6 +45,12 @@ from .encoder import Encoder, EncoderDestroyedError
 
 DEFAULT_CHUNK = 64 * 1024
 
+# Guarded-fallback poll period: wakeups are event-driven (the encoder's
+# readable hook / the decoder's drain watchers), so this bound only
+# matters if a wakeup is ever lost to an unknown race — the pump then
+# rediscovers the state within one period instead of hanging forever.
+WAKE_FALLBACK = 0.5
+
 
 def send_over(
     encoder: Encoder,
@@ -70,7 +76,10 @@ def send_over(
             if data is None:  # finalized and drained
                 break
             if not data:
-                readable.wait()
+                # bounded: the readable hook fires on every push, but a
+                # hang here has no recovery path at all — re-check on the
+                # fallback period rather than trusting a single wakeup
+                readable.wait(WAKE_FALLBACK)
                 readable.clear()
                 continue
             write_bytes(bytes(data))
@@ -92,33 +101,39 @@ def recv_over(
 
     ``read_bytes(n)`` returns up to n bytes, or ``b''`` at EOF.  When the
     decoder stalls on an outstanding app ``done``, reading is suspended
-    until the parked write-completion callback fires — so the kernel
-    receive buffer (not host RAM) absorbs the in-flight window and the
-    peer's sends eventually block.
+    until the decoder's drain watcher fires — so the kernel receive
+    buffer (not host RAM) absorbs the in-flight window and the peer's
+    sends eventually block.
     """
-    while not decoder.destroyed:
-        data = read_bytes(chunk_size)
-        if not data:
-            if not decoder.destroyed and not decoder.finished:
-                decoder.end()
-            return
-        drained = threading.Event()
-        try:
-            consumed = decoder.write(data, on_consumed=drained.set)
-        except DecoderDestroyedError:
-            return
-        if not consumed:
-            # bounded-poll instead of a bare wait: a done() ack landing
-            # on another thread between the decoder's stall check and the
-            # callback parking can drain the decoder without firing our
-            # event (the session objects are single-threaded state; the
-            # transport is where cross-thread acks meet them), so
-            # re-check writability on a short period rather than hanging
-            # on a wakeup that may have been lost
-            while not (decoder.writable() or decoder.destroyed
-                       or decoder.finished):
-                drained.wait(0.05)
-                drained.clear()
+    # Persistent drain watcher, not a per-write on_consumed callback: a
+    # done() ack landing on another thread while THIS thread is still
+    # inside _consume used to be a lost wakeup (the acking thread's
+    # _resume saw _consuming and returned without firing anything; the
+    # consuming thread had already taken its stall exit).  The watcher
+    # fires from the acking thread the moment the stall clears, so the
+    # pump wakes immediately; the bounded wait below stays only as a
+    # guarded fallback for wakeup paths not yet mapped.
+    wake = threading.Event()
+    decoder._add_drain_watcher(wake.set)
+    try:
+        while not decoder.destroyed:
+            data = read_bytes(chunk_size)
+            if not data:
+                if not decoder.destroyed and not decoder.finished:
+                    decoder.end()
+                return
+            wake.clear()
+            try:
+                consumed = decoder.write(data)
+            except DecoderDestroyedError:
+                return
+            if not consumed:
+                while not (decoder.writable() or decoder.destroyed
+                           or decoder.finished):
+                    wake.wait(WAKE_FALLBACK)
+                    wake.clear()
+    finally:
+        decoder._remove_drain_watcher(wake.set)
 
 
 # -- socket / fd bindings ----------------------------------------------------
@@ -139,16 +154,41 @@ def recv_over_socket(decoder: Decoder, sock: socket.socket,
     recv_over(decoder, sock.recv, chunk_size=chunk_size)
 
 
+def once(close_fn: Callable[[], None]) -> Callable[[], None]:
+    """Close-once guard: the returned callable runs ``close_fn`` on the
+    first call only, atomically across threads (mirrors the sidecar's
+    once-only stdio close).  Share it between a pump's ``close`` hook and
+    the caller's own error-path cleanup so neither double-closes — a
+    second ``os.close`` on a released fd number can hit an unrelated
+    descriptor some other thread was just handed."""
+    guard = threading.Lock()
+
+    def _once() -> None:
+        if guard.acquire(blocking=False):
+            close_fn()
+
+    return _once
+
+
 def send_over_fd(encoder: Encoder, fd: int,
-                 chunk_size: int = DEFAULT_CHUNK) -> None:
+                 chunk_size: int = DEFAULT_CHUNK,
+                 close: Callable[[], None] | None = None,
+                 ) -> Callable[[], None]:
+    """Pump ``encoder`` into a raw fd; closes it exactly once on the way
+    out.  ``close`` lets the caller share its own :func:`once` guard (and
+    is returned either way, so error-path cleanup can safely invoke it
+    again — the old ``close=lambda: os.close(fd)`` double-closed when the
+    caller also closed the fd after a pump error)."""
     def write_all(data: bytes) -> None:
         view = memoryview(data)
         while view:
             n = os.write(fd, view)
             view = view[n:]
 
-    send_over(encoder, write_all, close=lambda: os.close(fd),
-              chunk_size=chunk_size)
+    if close is None:
+        close = once(lambda: os.close(fd))
+    send_over(encoder, write_all, close=close, chunk_size=chunk_size)
+    return close
 
 
 def recv_over_fd(decoder: Decoder, fd: int,

@@ -210,14 +210,16 @@ def serve_stdio(drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
     # writer currently blocked in write(2) is NOT woken by this (unlike
     # the TCP twin's shutdown-EPIPE); it unblocks only when the peer
     # reads or exits, which the bounded drain join tolerates.  Once-only
-    # so the second caller (send_over's finally) doesn't reopen devnull.
-    close_once = threading.Lock()
+    # (transport.once) so the second caller (send_over's finally)
+    # doesn't reopen devnull.
+    from .session.transport import once
 
-    def _close_stdout() -> None:
-        if close_once.acquire(blocking=False):
-            devnull = os.open(os.devnull, os.O_WRONLY)
-            os.dup2(devnull, 1)
-            os.close(devnull)
+    def _swap_stdout_for_devnull() -> None:
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, 1)
+        os.close(devnull)
+
+    _close_stdout = once(_swap_stdout_for_devnull)
 
     stats = run_session(
         read_bytes=lambda n: os.read(0, n),
@@ -240,16 +242,38 @@ def _write_all(fd: int, data: bytes) -> None:
 def serve_tcp(host: str, port: int,
               max_sessions: int | None = None,
               ready_cb=None,
-              drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> None:
+              drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT,
+              retry_policy=None) -> None:
     """Accept loop: one concurrent session per connection.
 
     ``max_sessions`` bounds the loop for tests; ``ready_cb(port)`` fires
     once the socket is bound+listening (the test/race-free handshake).
+
+    ``retry_policy`` (a :class:`~.session.reconnect.BackoffPolicy`, CLI
+    flags ``--max-retries`` / ``--backoff-base``) governs the daemon's
+    transient-failure behavior: binding retries through a lingering
+    ``EADDRINUSE`` (the restart-while-old-socket-drains race) and the
+    accept loop rides out bursts of ``EMFILE``/``ECONNABORTED`` with
+    backoff instead of crashing the daemon; sustained failure surfaces
+    as one structured ProtocolError (see ROBUSTNESS.md).
     """
-    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind((host, port))
-    srv.listen(8)
+    from .session.reconnect import BackoffPolicy, retrying
+
+    policy = retry_policy if retry_policy is not None else BackoffPolicy()
+
+    def _bind() -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind((host, port))
+            s.listen(8)
+        except OSError:
+            s.close()
+            raise
+        return s
+
+    srv = retrying(_bind, policy, retry_on=(OSError,),
+                   describe=f"bind {host}:{port}")
     bound = srv.getsockname()[1]
     print(f"sidecar: listening on {host}:{bound}",
           file=sys.stderr, flush=True)
@@ -258,7 +282,12 @@ def serve_tcp(host: str, port: int,
     served = 0
     try:
         while max_sessions is None or served < max_sessions:
-            conn, peer = srv.accept()
+            # transient accept failures (fd exhaustion, aborted
+            # handshakes) back off instead of killing the daemon; each
+            # retrying() call is one fresh consecutive-failure budget,
+            # so a successful accept resets the count
+            conn, peer = retrying(srv.accept, policy, retry_on=(OSError,),
+                                  describe="accept")
             served += 1
 
             def _one(conn=conn, peer=peer):
@@ -303,8 +332,21 @@ def main(argv=None) -> int:
                         "no progress for this long (a client that stops "
                         "reading); <= 0 waits forever "
                         f"(default: {DEFAULT_DRAIN_TIMEOUT:.0f})")
+    p.add_argument("--max-retries", type=int, default=5, metavar="N",
+                   help="transient-failure budget: bind/accept errors are "
+                        "retried with backoff at most N times before the "
+                        "daemon fails with a structured error (default: 5)")
+    p.add_argument("--backoff-base", type=float, default=0.05,
+                   metavar="SECONDS",
+                   help="base of the exponential-backoff-with-full-jitter "
+                        "retry delay: attempt k sleeps uniform(0, "
+                        "min(cap, base * 2^k)) (default: 0.05)")
     args = p.parse_args(argv)
     drain = args.drain_timeout if args.drain_timeout > 0 else None
+    from .session.reconnect import BackoffPolicy
+
+    policy = BackoffPolicy(base=args.backoff_base,
+                           max_retries=args.max_retries)
     if args.backend == "host":
         import os
 
@@ -314,7 +356,8 @@ def main(argv=None) -> int:
         stats = serve_stdio(drain_timeout=drain)
         return 0 if stats["ok"] else 1
     host, _, port = args.tcp.rpartition(":")
-    serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain)
+    serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain,
+              retry_policy=policy)
     return 0
 
 

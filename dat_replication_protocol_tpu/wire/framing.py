@@ -50,4 +50,30 @@ class ProtocolError(Exception):
     The reference's sole detected fault is an unknown type id
     (reference: decode.js:159-161); this codec also rejects oversized varint
     headers.
+
+    Structured context (ROBUSTNESS.md): a failure that can name where in
+    the session it happened carries ``frame`` (0-based index of the frame
+    being parsed/delivered when the fault surfaced), ``offset`` (wire
+    bytes accepted up to the fault), and ``cause`` (the underlying
+    exception, e.g. the ``OSError`` of a dead transport).  All three are
+    optional so the bare ``ProtocolError("msg")`` form keeps working;
+    when present they are folded into ``str(err)`` so even unstructured
+    logging shows them.
     """
+
+    def __init__(self, message: str = "", *, frame: int | None = None,
+                 offset: int | None = None,
+                 cause: BaseException | None = None):
+        self.frame = frame
+        self.offset = offset
+        self.cause = cause
+        context = []
+        if frame is not None:
+            context.append(f"frame={frame}")
+        if offset is not None:
+            context.append(f"byte={offset}")
+        if cause is not None:
+            context.append(f"cause={type(cause).__name__}: {cause}")
+        super().__init__(
+            f"{message} [{', '.join(context)}]" if context else message
+        )

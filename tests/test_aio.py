@@ -137,3 +137,56 @@ def test_decoder_destroy_with_idle_sender_does_not_hang():
 
     _run(main())
     assert dec.destroyed and enc.destroyed
+
+
+def test_async_fault_injector_resegmentation_is_transparent():
+    """AsyncFaultyReader (the chaos harness's asyncio face,
+    session/faults.py) slicing the stream into 1..7-byte pieces must not
+    change the decoded session — every header/payload straddle the
+    event-loop pump can see, exercised in one pass."""
+    from dat_replication_protocol_tpu.session.aio import (
+        recv_over_async,
+        send_over_async,
+    )
+    from dat_replication_protocol_tpu.session.faults import (
+        AsyncFaultyReader,
+        FaultPlan,
+    )
+
+    enc, dec = protocol.encode(), protocol.decode()
+    got = []
+    dec.change(lambda c, done: (got.append(("change", c.key)), done()))
+    dec.blob(
+        lambda b, done: b.collect(lambda d: (got.append(("blob", d)), done()))
+    )
+
+    async def main():
+        import socket
+
+        a, b = socket.socketpair()
+        a.setblocking(False)
+        b.setblocking(False)
+        _, writer = await asyncio.open_connection(sock=a)
+        reader, writer_b = await asyncio.open_connection(sock=b)
+        enc.change({"key": "a", "change": 1, "from": 0, "to": 1})
+        ws = enc.blob(11)
+        ws.write(b"hello ")
+        ws.end(b"world")
+        enc.change({"key": "b", "change": 2, "from": 1, "to": 2})
+        enc.finalize()
+        chaotic = AsyncFaultyReader(
+            reader, FaultPlan(seed=9, max_segment=7, latency_prob=0.1,
+                              latency_s=0.001))
+        await asyncio.wait_for(asyncio.gather(
+            send_over_async(enc, writer),
+            recv_over_async(dec, chaotic),
+        ), 30)
+        for w in (writer, writer_b):
+            w.transport.abort()
+            w.close()
+        a.close()
+        b.close()
+
+    _run(main())
+    assert got == [("change", "a"), ("blob", b"hello world"), ("change", "b")]
+    assert dec.finished

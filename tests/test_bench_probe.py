@@ -175,6 +175,24 @@ def test_serial_fence_env_restores_strict_alternation(monkeypatch):
     assert events == ["d", "f"] * 3  # no primer, no overlap
 
 
+def test_resume_probe_measures_fault_to_redelivery(monkeypatch):
+    """Config 6 (resume latency) must produce a real, positive
+    fault->first-redelivered-frame number on a tiny session and survive
+    being run host-only (no JAX involvement)."""
+    monkeypatch.setenv("BENCH_RESUME_ROWS", "200")
+    monkeypatch.setenv("BENCH_RESUME_REPS", "3")
+    res = bench.bench_resume(quick=True, backend="host")
+    assert res["metric"] == "resume_latency" and res["unit"] == "ms"
+    assert res["value"] > 0 and res["p90_ms"] >= res["value"]
+    assert res["rows"] == 200 and res["wire_bytes"] > 0
+
+
+def test_resume_probe_registered_in_host_group():
+    # config 6 needs no device: it must be in BENCHES and NOT in the
+    # device leg (a wedged tunnel cannot cost the recovery number)
+    assert bench.BENCHES["6"][0] == "resume"
+
+
 def test_peak_span_guards_drain_and_post_stall():
     # queue-drain span (0.05 << half median) excluded; the 0.9 span right
     # after the 2.0 stall is drain-compressed (advisor r4) - excluded too

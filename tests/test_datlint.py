@@ -203,6 +203,70 @@ def test_unbounded_join_clean_on_bounded_waits(tmp_path):
     assert _lint(tmp_path, ("bounded.py", JOIN_GOOD)) == []
 
 
+# -- bounded-wait (ISSUE 2: lost-wakeup hangs; aio's bare awaits) -----------
+
+# the pre-fix shape of aio.send_over_async: an idle encoder whose
+# producer dies parks the pump task forever in wait(); a peer that
+# stops reading parks it forever in drain()
+WAIT_BAD = '''
+async def pump(encoder, readable, writer):
+    while True:
+        data = encoder.read(65536)
+        if not data:
+            await readable.wait()
+            continue
+        writer.write(data)
+        await writer.drain()
+'''
+
+WAIT_GOOD = '''
+import asyncio
+
+
+async def pump(encoder, readable, writer):
+    while True:
+        data = encoder.read(65536)
+        if not data:
+            await asyncio.wait_for(readable.wait(), 0.5)
+            continue
+        writer.write(data)
+        await asyncio.wait_for(writer.drain(), 30.0)
+
+
+def threaded_pump(event):
+    while not event.wait(0.5):
+        pass
+'''
+
+
+def test_bounded_wait_fires_on_bare_wait_and_drain(tmp_path):
+    findings = _lint(tmp_path, ("hangs.py", WAIT_BAD))
+    waits = [f for f in findings if f.rule == "bounded-wait"]
+    assert len(waits) == 2
+    joined = " ".join(f.message for f in waits)
+    assert ".wait()" in joined and ".drain()" in joined
+
+
+def test_bounded_wait_clean_on_wait_for_and_timeouts(tmp_path):
+    assert _lint(tmp_path, ("bounded.py", WAIT_GOOD)) == []
+
+
+def test_bounded_wait_allow_marker_is_the_escape_hatch(tmp_path):
+    findings = _lint(tmp_path, ("justified.py", '''
+        async def pump(writer, event):
+            # datlint: allow-unbounded-wait -- peer trusted, see docstring
+            await writer.drain()
+            await event.wait()  # datlint: allow-unbounded-wait -- same
+    '''))
+    assert findings == []
+
+
+def test_bounded_wait_does_not_double_report_join(tmp_path):
+    # .join() belongs to unbounded-join; one finding, not two
+    findings = _lint(tmp_path, ("joins.py", JOIN_BAD))
+    assert "bounded-wait" not in _rules_fired(findings)
+
+
 # -- jit-purity (PERF.md: host effects inside traced bodies) ----------------
 
 JIT_BAD = '''
