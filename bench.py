@@ -235,6 +235,8 @@ def _start_cpu_fallback(device_keys: list[str], quick: bool,
     argv = [sys.executable, os.path.abspath(__file__)]
     if quick:
         argv.append("--quick")
+    if _METRICS["on"]:  # fallback numbers deserve attribution too
+        argv.append("--metrics")
     if trace_dir:  # own subdir: the parent's device leg may trace too
         argv.append(f"--trace={os.path.join(trace_dir, 'cpu_fallback')}")
     log(f"bench: starting CPU-fallback subprocess for configs "
@@ -1226,6 +1228,30 @@ BENCHES = {
 _state: dict = {"configs": {}, "backend": None, "backend_error": None}
 _emitted = False
 
+# --metrics: attach a per-config obs-registry snapshot to each config's
+# result so BENCH_*.json rounds carry attribution (which layer moved),
+# not just a headline number.  The registry is reset between configs so
+# each snapshot is that config's own story.
+_METRICS = {"on": False}
+
+
+def _metrics_on() -> None:
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+
+    _METRICS["on"] = True
+    obs_metrics.enable()
+
+
+def _attach_metrics(res: dict) -> None:
+    """Attach the registry snapshot to one config result (no-op unless
+    --metrics), then reset values for the next config."""
+    if not _METRICS["on"]:
+        return
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+
+    res["metrics"] = obs_metrics.snapshot()
+    obs_metrics.REGISTRY.reset()
+
 
 def _emit() -> None:
     """Print the one JSON artifact line from whatever has completed.
@@ -1261,6 +1287,8 @@ def main() -> None:
     import threading
 
     quick = "--quick" in sys.argv
+    if "--metrics" in sys.argv:
+        _metrics_on()
     trace_dir = None
     for arg in sys.argv[1:]:
         if arg.startswith("--trace="):
@@ -1296,12 +1324,15 @@ def main() -> None:
         try:
             res = fn(quick, backend)
             res["seconds"] = round(time.perf_counter() - t0, 2)
+            _attach_metrics(res)
             _state["configs"][name] = res
             log(f"bench: config {key} ({name}) ok in {res['seconds']}s")
         except Exception as e:
             log(f"bench: config {key} ({name}) FAILED: {e}")
             traceback.print_exc(file=sys.stderr)
-            _state["configs"][name] = {"error": f"{type(e).__name__}: {e}"}
+            err_res = {"error": f"{type(e).__name__}: {e}"}
+            _attach_metrics(err_res)  # partial-work attribution
+            _state["configs"][name] = err_res
 
     # configs 1, 2, 6 need no JAX: run them before any backend init so a
     # wedged/broken device stack cannot cost their numbers

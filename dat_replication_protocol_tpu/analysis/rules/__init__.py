@@ -12,6 +12,7 @@ from .bounded_wait import BoundedWait
 from .cursor_coherence import CursorCoherence
 from .env_cache import EnvCachePolicy
 from .jit_purity import JitPurity
+from .obs_discipline import ObsDiscipline
 from .unbounded_join import UnboundedJoin
 from .wire_constants import WireConstantParity
 
@@ -22,6 +23,7 @@ ALL_RULES = (
     BoundedWait(),
     JitPurity(),
     WireConstantParity(),
+    ObsDiscipline(),
 )
 
 

@@ -23,11 +23,16 @@ from __future__ import annotations
 import hashlib
 from typing import Callable, Optional
 
+from ..obs.metrics import OBS as _OBS, counter as _counter
 from ..session.decoder import BlobReader, Decoder
 from ..session.encoder import Encoder
 from ..utils.trace import span
 
 DIGEST_SIZE = 32  # BLAKE2b-256, dat's content-hash size
+
+# digest deliveries by session end (OBSERVABILITY.md catalog)
+_M_DEC_DIGESTS = _counter("decoder.digests")
+_M_ENC_DIGESTS = _counter("encoder.digests")
 
 OnDigest = Callable[[str, int, bytes], None]  # (kind, seq, digest)
 
@@ -288,6 +293,8 @@ class TpuDecoder(Decoder):
     # -- hooks into the parser ----------------------------------------------
 
     def _emit_digest(self, kind: str, seq: int, digest: bytes) -> None:
+        if _OBS.on:
+            _M_DEC_DIGESTS.inc()
         for cb in self._digest_cbs:
             cb(kind, seq, digest)
 
@@ -408,6 +415,8 @@ class TpuEncoder(Encoder):
         return self._pipeline
 
     def _emit_digest(self, kind: str, seq: int, digest: bytes) -> None:
+        if _OBS.on:
+            _M_ENC_DIGESTS.inc()
         for cb in self._digest_cbs:
             cb(kind, seq, digest)
 

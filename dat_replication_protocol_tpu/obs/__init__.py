@@ -1,0 +1,69 @@
+"""obs — session telemetry: metrics core + structured event log.
+
+The reference's only observability is three passive counters
+(reference: encode.js:51-53, decode.js:68-70).  This package is the
+host-visible telemetry layer for everything the session stack does at
+runtime — retries, stalls, replay bytes, watcher-vs-poll wakeups —
+exactly the datapath an offload-style deployment no longer steps
+through (PAPERS: *Reliable Replication Protocols on SmartNICs*).
+
+Deliberately zero-dependency and flat (stdlib only, no JAX, no numpy):
+the layer must be importable and near-free in every process that
+touches the session stack, including the stripped CI image
+(PAPERS: *Simplicity Scales*).
+
+Two halves:
+
+* :mod:`.metrics` — Counters / Gauges / Histograms in a process-global
+  registry behind ONE hoisted enable gate (``OBS.on``): the disabled
+  path at an instrumentation site is a single attribute load, the same
+  trick as ``_fastpath_gate``.
+* :mod:`.events` — a bounded-ring structured event log (monotonic ts +
+  seq) with an optional fd/JSONL sink, for session *lifecycle*:
+  connect, checkpoint, resume, backoff, replay, stall, truncation,
+  ProtocolError.
+
+The fault injector (:mod:`..session.faults`) is the layer's
+correctness oracle: it emits ground-truth ``fault.*`` events for every
+fault it injects, and the conformance sweep
+(tests/test_obs_conformance.py) asserts the session layers' telemetry
+agrees — chaos and telemetry must tell the same story.
+
+Catalog, schema, overhead budget: OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+from .events import EVENTS, EventLog, emit
+from .metrics import (
+    OBS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    disable,
+    enable,
+    gauge,
+    histogram,
+    snapshot,
+)
+
+__all__ = [
+    "OBS",
+    "REGISTRY",
+    "EVENTS",
+    "EventLog",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "emit",
+    "enable",
+    "disable",
+]

@@ -1,0 +1,144 @@
+"""Bounded-ring structured event log for session lifecycle.
+
+Events are the *rare*, *narrative* half of telemetry (metrics are the
+dense half): connect, checkpoint export, resume attempt, backoff
+sleep, journal replay, stall detection, truncation, ProtocolError.
+Each record carries a process-wide monotonically increasing ``seq``
+and a ``time.monotonic()`` timestamp, so interleavings across threads
+reconstruct even when wall clocks jump.
+
+The ring is bounded (default 1024 records): an event storm overwrites
+the oldest records and bumps ``dropped`` instead of growing host RAM —
+the same discipline as the histogram quantile ring.  An optional sink
+(:meth:`EventLog.attach_sink`) mirrors every record as one JSON line
+(JSONL) to a file descriptor or file object the moment it is emitted —
+attach a dedicated fd for a live event stream.  (The sidecar's
+``--stats-fd`` exports periodic *metrics snapshots* plus the ring's
+``dropped`` count on its own fd; it deliberately does not share that
+fd with the per-event sink, because two writers interleaving past
+PIPE_BUF would corrupt the one-object-per-line contract.)
+
+Emission is gated on the shared :data:`~.metrics.OBS` gate; hot-path
+call sites additionally guard with ``if _OBS.on:`` so the disabled
+path never builds the kwargs dict (see OBSERVABILITY.md's budget).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from .metrics import OBS
+
+__all__ = ["EventLog", "EVENTS", "emit"]
+
+DEFAULT_CAPACITY = 1024
+
+
+class EventLog:
+    """Bounded ring of structured events + optional JSONL sink."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._lock = threading.Lock()
+        # separate sink lock: record ordering/teardown stays cheap under
+        # _lock; the (possibly slow) sink I/O serializes on its own lock
+        # so concurrent emits cannot interleave characters of two records
+        self._sink_lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = 0
+        self.dropped = 0  # records overwritten by ring wraparound
+        self._sink = None  # int fd, or object with write(str)
+
+    # -- emission -----------------------------------------------------------
+
+    def emit(self, event: str, **fields) -> None:
+        """Record one event (no-op while the obs gate is off).
+
+        ``event`` names are dot-separated literals (greppable — the
+        obs-discipline datlint rule enforces literal names at call
+        sites); ``fields`` must be JSON-able scalars/strings.
+        """
+        if not OBS.on:
+            return
+        now = time.monotonic()
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            rec = {"seq": seq, "ts": now, "event": event, "fields": fields}
+            self._ring.append(rec)
+            sink = self._sink
+        if sink is not None:
+            with self._sink_lock:
+                self._write_sink(sink, rec)
+
+    @staticmethod
+    def _write_sink(sink, rec: dict) -> None:
+        line = json.dumps(rec, default=repr) + "\n"
+        try:
+            if isinstance(sink, int):
+                # write-all loop: a short write on a blocking fd must
+                # not truncate the record mid-line (the consumer parses
+                # one JSON object per line); a non-blocking fd's EAGAIN
+                # falls through to the best-effort swallow below
+                view = memoryview(line.encode("utf-8"))
+                while view:
+                    view = view[os.write(sink, view):]
+            else:
+                sink.write(line)
+                flush = getattr(sink, "flush", None)
+                if flush is not None:
+                    flush()
+        except (OSError, ValueError):
+            pass  # a dead sink must never take the session down
+
+    # -- sink management ----------------------------------------------------
+
+    def attach_sink(self, sink) -> None:
+        """Mirror every subsequent event as one JSON line to ``sink``
+        (an int file descriptor, or any object with ``write(str)``)."""
+        with self._lock:
+            self._sink = sink
+
+    def detach_sink(self) -> None:
+        with self._lock:
+            self._sink = None
+
+    # -- inspection ---------------------------------------------------------
+
+    def events(self, event: Optional[str] = None) -> list[dict]:
+        """Snapshot of the retained records, oldest first; optionally
+        filtered by exact event name."""
+        with self._lock:
+            records = list(self._ring)
+        if event is None:
+            return records
+        return [r for r in records if r["event"] == event]
+
+    def count(self, event: str) -> int:
+        return len(self.events(event))
+
+    def last(self, event: Optional[str] = None) -> Optional[dict]:
+        records = self.events(event)
+        return records[-1] if records else None
+
+    def clear(self) -> None:
+        """Drop retained records (seq keeps counting — per-test reset)."""
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+
+EVENTS = EventLog()
+
+
+def emit(event: str, **fields) -> None:
+    """Emit to the process-global event log (gated, see EventLog.emit)."""
+    EVENTS.emit(event, **fields)

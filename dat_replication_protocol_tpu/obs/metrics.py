@@ -1,0 +1,334 @@
+"""Zero-dependency metrics core: counters, gauges, histograms.
+
+Design constraints (ISSUE 3 acceptance, OBSERVABILITY.md):
+
+* **Disabled path is one attribute load.**  Instrumentation sites hold
+  a pre-bound metric handle (created at module import) and guard with
+  ``if _OBS.on:`` — no registry lookup, no dict allocation, no call at
+  all when telemetry is off.  ``OBS`` is a one-slot object so the
+  check compiles to LOAD_GLOBAL + LOAD_ATTR + POP_JUMP, the same
+  hoisted-gate trick as ``_fastpath_gate``.
+* **The gate is a runtime LATCH, not a per-call env read.**  Unlike
+  ``DAT_FASTPATH_DISABLE`` (a behavior fork that must stay re-readable,
+  see the env-cache-policy rule), the obs gate exists precisely so hot
+  paths do NOT pay an environ lookup: ``DAT_OBS=1`` seeds the initial
+  state, and :func:`enable` / :func:`disable` flip it at runtime
+  (the sidecar's ``--stats-fd`` does, tests do).
+* **Enabled path favors correctness over nanoseconds.**  Every mutate
+  takes the metric's lock: a Python ``x += 1`` is a read-modify-write
+  that can lose increments across threads, and the session stack is
+  aggressively multi-threaded (pumps, ack threads, the sidecar).  The
+  overhead budget test bounds only the disabled path.
+* **Snapshots are plain dicts** (JSON-able as-is): the sidecar's
+  ``--stats-fd`` dumps, ``bench.py --metrics`` attribution, and the
+  conformance oracle all consume the same shape.
+
+Histograms keep BOTH fixed-bucket counts (cheap, mergeable) and a
+fixed-size ring of recent observations (wraparound overwrite) so
+``snapshot()`` can report approximate quantiles of the *recent* window
+without unbounded memory.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Optional, Sequence
+
+__all__ = [
+    "OBS",
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "enable",
+    "disable",
+]
+
+
+class _Gate:
+    """The hoisted enable gate.  One mutable slot; instrumentation
+    sites read ``OBS.on`` and nothing else."""
+
+    __slots__ = ("on",)
+
+    def __init__(self) -> None:
+        self.on = False
+
+
+OBS = _Gate()
+
+
+def enable() -> None:
+    """Turn telemetry on process-wide (idempotent)."""
+    OBS.on = True
+
+
+def disable() -> None:
+    OBS.on = False
+
+
+def _seed_gate_from_env() -> None:
+    # initial state only — enable()/disable() own the gate afterwards
+    # (a latch by design: the whole point of the hoisted gate is that
+    # hot paths never pay an environ read; see module docstring)
+    if os.environ.get("DAT_OBS", "") not in ("", "0"):
+        OBS.on = True
+
+
+_seed_gate_from_env()
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` under the lock: increments from pump
+    threads, ack threads, and the sidecar's emitter must not be lost."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+# Default buckets span the session stack's latency range: sub-us gate
+# checks up through multi-second backoff sleeps.  Upper edges are
+# INCLUSIVE (observe(x) lands in the first bucket with x <= edge), with
+# an implicit +inf overflow bucket.
+DEFAULT_BUCKETS = (
+    1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0,
+)
+
+DEFAULT_RING = 256
+
+
+class Histogram:
+    """Fixed buckets + a ring buffer of recent raw observations.
+
+    The buckets give cheap, mergeable distribution counts; the ring
+    gives approximate quantiles over the most recent ``ring`` samples
+    (older samples are overwritten — wraparound, bounded memory).
+    """
+
+    __slots__ = ("name", "buckets", "_lock", "_counts", "_count", "_sum",
+                 "_ring", "_ring_n")
+
+    def __init__(self, name: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 ring: int = DEFAULT_RING):
+        if list(buckets) != sorted(buckets) or len(set(buckets)) != len(
+                tuple(buckets)):
+            raise ValueError("histogram buckets must be sorted and unique")
+        if ring < 1:
+            raise ValueError("ring size must be >= 1")
+        self.name = name
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +1: +inf overflow
+        self._count = 0
+        self._sum = 0.0
+        self._ring: list[float] = [0.0] * ring
+        self._ring_n = 0  # total observations ever; ring index = n % len
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = 0
+            buckets = self.buckets
+            n = len(buckets)
+            while i < n and v > buckets[i]:
+                i += 1
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            ring = self._ring
+            ring[self._ring_n % len(ring)] = v
+            self._ring_n += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate ``q``-quantile (0..1) over the ring window, or
+        None before the first observation.  Nearest-rank on a sorted
+        copy — snapshot-time cost, not observe-time cost."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile must be in [0, 1]")
+        with self._lock:
+            n = min(self._ring_n, len(self._ring))
+            if n == 0:
+                return None
+            window = sorted(self._ring[:n])
+        rank = min(n - 1, max(0, math.ceil(q * n) - 1))
+        return window[rank]
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._ring_n = 0
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            count = self._count
+            total = self._sum
+            n = min(self._ring_n, len(self._ring))
+            window = sorted(self._ring[:n])
+
+        def q(frac: float) -> Optional[float]:
+            if not window:
+                return None
+            rank = min(len(window) - 1, max(0, math.ceil(frac * len(window)) - 1))
+            return window[rank]
+
+        return {
+            "count": count,
+            "sum": total,
+            "buckets": [[le, c] for le, c in zip(self.buckets, counts)]
+            + [["+inf", counts[-1]]],
+            "p50": q(0.50),
+            "p90": q(0.90),
+            "p99": q(0.99),
+        }
+
+
+class Registry:
+    """Name -> metric, process-global.  Get-or-create is idempotent so
+    any module can hoist a handle at import without ordering concerns;
+    a name registered twice with a different TYPE is a programming
+    error and raises."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, cls, *args, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args, **kwargs)
+                self._metrics[name] = m
+            elif type(m) is not cls:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  ring: int = DEFAULT_RING) -> Histogram:
+        h = self._get(name, Histogram, buckets, ring)
+        # parameter drift is the same silent catalog fork the type
+        # check above guards: a second registration with different
+        # edges would quietly get the FIRST caller's buckets
+        if h.buckets != tuple(float(b) for b in buckets) \
+                or len(h._ring) != ring:
+            raise ValueError(
+                f"histogram {name!r} already registered with different "
+                f"buckets/ring")
+        return h
+
+    def snapshot(self) -> dict:
+        """Plain-dict view of every registered metric (JSON-able)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in metrics:
+            if isinstance(m, Counter):
+                out["counters"][m.name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][m.name] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][m.name] = m._snapshot()
+        return out
+
+    def reset(self) -> None:
+        """Zero every metric's VALUE, keeping registrations (and the
+        handles instrumentation sites hoisted) intact — per-test and
+        per-bench-config isolation."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._reset()
+
+
+REGISTRY = Registry()
+
+
+def counter(name: str) -> Counter:
+    """Get-or-create a counter in the process-global registry."""
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
+              ring: int = DEFAULT_RING) -> Histogram:
+    return REGISTRY.histogram(name, buckets, ring)
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()

@@ -27,10 +27,17 @@ from __future__ import annotations
 
 import asyncio
 
+from ..obs.events import emit as _emit
+from ..obs.metrics import OBS as _OBS, counter as _counter
 from ..wire.framing import ProtocolError
 from .decoder import Decoder, DecoderDestroyedError
 from .encoder import Encoder, EncoderDestroyedError
 from .transport import DEFAULT_CHUNK, WAKE_FALLBACK
+
+# Wakeup attribution for the event-loop pumps, the asyncio twin of
+# transport.py's recv/send counters (OBSERVABILITY.md)
+_M_AIO_WAKE_EVENT = _counter("aio.wake.event")
+_M_AIO_WAKE_POLL = _counter("aio.wake.poll")
 
 
 async def _bounded_wait(event: asyncio.Event) -> None:
@@ -42,8 +49,11 @@ async def _bounded_wait(event: asyncio.Event) -> None:
     rule)."""
     try:
         await asyncio.wait_for(event.wait(), WAKE_FALLBACK)
+        if _OBS.on:
+            _M_AIO_WAKE_EVENT.inc()
     except asyncio.TimeoutError:
-        pass
+        if _OBS.on:
+            _M_AIO_WAKE_POLL.inc()
 
 
 async def _drain_with_stall_detect(encoder: Encoder,
@@ -62,6 +72,9 @@ async def _drain_with_stall_detect(encoder: Encoder,
         except asyncio.TimeoutError:
             if writer.transport.get_write_buffer_size() < before:
                 continue  # the peer IS reading, just slowly: re-arm
+            if _OBS.on:
+                _emit("session.stall", kind="peer-drain",
+                      seconds=stall_timeout, offset=encoder.bytes)
             err = ProtocolError(
                 f"peer stalled: no drain progress for {stall_timeout}s",
                 offset=encoder.bytes,

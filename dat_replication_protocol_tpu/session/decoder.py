@@ -27,14 +27,30 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+from time import perf_counter as _perf
 from typing import Callable, Optional
 
 from .._fastpath_gate import fastpath_mod as _fastpath_mod
+from ..obs.events import emit as _emit
+from ..obs.metrics import OBS as _OBS, counter as _counter, \
+    histogram as _histogram
 from ..wire.change_codec import Change, decode_change
 from ..wire.framing import MAX_HEADER_LEN, TYPE_BLOB, TYPE_CHANGE, TYPE_HEADER, ProtocolError
 from ..wire.varint import decode_uvarint
 
 OnDone = Optional[Callable[[], None]]
+
+# Telemetry handles, hoisted at import: the disabled path at every
+# instrumentation site below is a single `_OBS.on` attribute load — no
+# registry lookup, no allocation (OBSERVABILITY.md's budget).
+_M_DEC_BYTES = _counter("decoder.bytes")
+_M_DEC_CHANGES = _counter("decoder.changes")
+_M_DEC_BLOBS = _counter("decoder.blobs")
+_M_DEC_BLOB_BYTES = _counter("decoder.blob.bytes")
+_M_DEC_REQUEUES = _counter("decoder.requeues")
+_M_DEC_ERRORS = _counter("decoder.errors")
+# per-write() dispatch latency: bytes in -> handlers fired (or stalled)
+_H_DEC_DISPATCH = _histogram("decoder.dispatch.seconds")
 
 # The bulk-path cursor: frame index and columnar row MUST advance
 # together — a frame paired with the wrong row's columns is silent wire
@@ -270,7 +286,15 @@ class Decoder:
         if on_consumed is not None:
             entry = lambda cb=on_consumed: cb()  # noqa: E731
             self._write_cbs.append(entry)
-        self._consume()
+        if _OBS.on:
+            _M_DEC_BYTES.inc(len(data))
+            t0 = _perf()
+            try:
+                self._consume()
+            finally:
+                _H_DEC_DISPATCH.observe(_perf() - t0)
+        else:
+            self._consume()
         if entry is not None:
             return entry not in self._write_cbs  # fired <=> consumed
         return not (
@@ -333,6 +357,9 @@ class Decoder:
         """
         from .resume import SessionCheckpoint
 
+        if _OBS.on:
+            _emit("session.checkpoint", wire_offset=self.bytes,
+                  frame=self._frames_delivered(), row=self.changes)
         blob = self._current_blob
         return SessionCheckpoint(
             wire_offset=self.bytes,
@@ -384,6 +411,10 @@ class Decoder:
         stood — the session-context half of the robustness contract
         (ROBUSTNESS.md), so operators see *where* a stream broke instead
         of a bare message."""
+        if _OBS.on:
+            _M_DEC_ERRORS.inc()
+            _emit("protocol.error", frame=self._frames_delivered(),
+                  offset=self.bytes, message=message)
         return ProtocolError(
             message,
             frame=self._frames_delivered(),
@@ -588,6 +619,10 @@ class Decoder:
         write (the streaming analogue of the bulk path's parked cursor,
         which preserves its tail in st)."""
         if len(rest):
+            if _OBS.on:
+                _M_DEC_REQUEUES.inc()
+                _emit("decoder.requeue", bytes=len(rest),
+                      offset=self.bytes)
             self._ov_appendleft(rest)
 
     def _merged_overflow(self) -> memoryview | None:
@@ -902,6 +937,8 @@ class Decoder:
                 # (matching the streaming path's submit-before-deliver)
                 self._missing = 0
                 self._state = TYPE_HEADER
+                if _OBS.on and st["row"] > row0:
+                    _M_DEC_CHANGES.inc(st["row"] - row0)
                 if use_tap:
                     self._note_change_payloads(sink, st["row"] - row0)
             if status == 2:
@@ -971,6 +1008,8 @@ class Decoder:
             st["row"] = row
             self._missing = 0
             self._state = TYPE_HEADER
+            if _OBS.on and row > row0:
+                _M_DEC_CHANGES.inc(row - row0)
             if use_tap:
                 self._note_change_payloads(sink, row - row0)
         return f
@@ -1084,6 +1123,8 @@ class Decoder:
         loop skips dead object construction then.  Subclasses must use
         ``payload``, not ``change``, for handler-independent work."""
         self.changes += 1
+        if _OBS.on:
+            _M_DEC_CHANGES.inc()
         self._state = TYPE_HEADER
         if self._on_change is not None:
             # same deferred-arm ack as the bulk fast loop: a sync ack
@@ -1115,6 +1156,8 @@ class Decoder:
         blob = BlobReader(self, self._missing)
         self._current_blob = blob
         self.blobs += 1
+        if _OBS.on:
+            _M_DEC_BLOBS.inc()
         latch = {"ended": False, "acked": False}
         blob._pending_latch = latch
 
@@ -1150,6 +1193,8 @@ class Decoder:
         # scratch memoryview
         data = bytes(chunk[:take])
         rest = chunk[take:]
+        if _OBS.on:
+            _M_DEC_BLOB_BYTES.inc(take)
         try:
             self._note_blob_bytes(data)
             blob._deliver(data)

@@ -27,12 +27,26 @@ re-designed as a pull-based Python object instead of a Node Readable:
 from __future__ import annotations
 
 from collections import deque
+from time import monotonic as _now
 from typing import Callable, Optional
 
+from ..obs.metrics import OBS as _OBS, counter as _counter, \
+    histogram as _histogram
 from ..wire.change_codec import Change, encode_change
 from ..wire.framing import TYPE_BLOB, TYPE_CHANGE, frame_header
 
 OnDone = Optional[Callable[[], None]]
+
+# Telemetry handles, hoisted at import so the disabled path at every
+# instrumentation site is one `_OBS.on` attribute load (OBSERVABILITY.md).
+_M_ENC_BYTES = _counter("encoder.bytes")
+_M_ENC_CHANGES = _counter("encoder.changes")
+_M_ENC_BLOBS = _counter("encoder.blobs")
+_M_ENC_BLOB_CHUNKS = _counter("encoder.blob.chunks")
+_M_ENC_PARKED = _counter("encoder.parked.bytes")
+# backpressure park time: how long bytes sat corked/parked behind the
+# blob FIFO before reaching the wire queue
+_H_ENC_PARK = _histogram("encoder.park.seconds")
 
 DEFAULT_HIGH_WATER = 64 * 1024
 
@@ -62,7 +76,7 @@ class BlobWriter:
         self._on_flush = on_flush
         self._written = 0
         self._corked = False
-        self._parked: list[tuple[bytes, OnDone]] = []
+        self._parked: list[tuple[bytes, OnDone, float | None]] = []
         self._ended = False
         self._finished = False
         self.destroyed = False
@@ -89,6 +103,8 @@ class BlobWriter:
             self._encoder.destroy(err)
             raise err
         self._written += len(data)
+        if _OBS.on:
+            _M_ENC_BLOB_CHUNKS.inc()
         if self._corked:
             self._park(bytes(data), on_flush)
             return not self._encoder._above_high_water()
@@ -136,8 +152,12 @@ class BlobWriter:
     def _park(self, data: bytes, cb: OnDone) -> None:
         """Parked bytes count toward the encoder's high-water mark so
         backpressure stays honest while the head blob streams."""
-        self._parked.append((data, cb))
+        # third slot: park timestamp (None while telemetry is off) —
+        # _uncork turns it into the encoder.park.seconds histogram
+        self._parked.append((data, cb, _now() if _OBS.on else None))
         self._encoder._parked_bytes += len(data)
+        if _OBS.on:
+            _M_ENC_PARKED.inc(len(data))
 
     def _uncork(self) -> None:
         """Flush parked chunks into the parent; if already ended, finish —
@@ -145,8 +165,10 @@ class BlobWriter:
         if not self._corked:
             return
         self._corked = False
-        for data, cb in self._parked:
+        for data, cb, t0 in self._parked:
             self._encoder._parked_bytes -= len(data)
+            if t0 is not None and _OBS.on:
+                _H_ENC_PARK.observe(_now() - t0)
             self._encoder._push(data, cb)
         self._parked.clear()
         if self._ended:
@@ -181,7 +203,7 @@ class Encoder:
         self._open_blobs: deque[BlobWriter] = deque()
         # Parked changes are encoded at submit time (catching bad input early
         # and making the parked bytes countable); framed on replay.
-        self._parked_changes: list[tuple[bytes, OnDone]] = []
+        self._parked_changes: list[tuple[bytes, OnDone, float | None]] = []
         self._drain_cbs: list[Callable[[], None]] = []
         self._error_cbs: list[Callable[[Exception | None], None]] = []
         self._finish_cbs: list[Callable[[], None]] = []
@@ -241,13 +263,18 @@ class Encoder:
             raise EncoderDestroyedError("change after finalize")
         payload = encode_change(change)
         if self._open_blobs:
-            self._parked_changes.append((payload, on_flush))
+            self._parked_changes.append(
+                (payload, on_flush, _now() if _OBS.on else None))
             self._parked_bytes += len(payload)
+            if _OBS.on:
+                _M_ENC_PARKED.inc(len(payload))
             return not self._above_high_water()
         return self._frame_change(payload, on_flush)
 
     def _frame_change(self, payload: bytes, on_flush: OnDone) -> bool:
         self.changes += 1
+        if _OBS.on:
+            _M_ENC_CHANGES.inc()
         header = frame_header(len(payload), TYPE_CHANGE)
         self._push(header, None)
         return self._push(payload, on_flush)
@@ -264,6 +291,8 @@ class Encoder:
             raise ValueError("blob length is required and must be > 0")
         ws = BlobWriter(self, length, on_flush)
         self.blobs += 1
+        if _OBS.on:
+            _M_ENC_BLOBS.inc()
         header = frame_header(length, TYPE_BLOB)
         if self._open_blobs:
             ws._cork()
@@ -323,6 +352,8 @@ class Encoder:
                 self._queued_bytes -= room
                 break
         data = bytes(out)
+        if _OBS.on and data:
+            _M_ENC_BYTES.inc(len(data))
         if self._journal is not None and data:
             # journal BEFORE the flush callbacks: when an on_flush hook
             # acks the journal window, the bytes it acks must be there
@@ -438,9 +469,11 @@ class Encoder:
         if self._open_blobs:
             self._open_blobs[0]._uncork()
         parked, self._parked_changes = self._parked_changes, []
-        for payload, cb in parked:
+        for payload, cb, t0 in parked:
             if self._open_blobs:  # a later blob is still open: stay parked
-                self._parked_changes.append((payload, cb))
+                self._parked_changes.append((payload, cb, t0))
             else:
                 self._parked_bytes -= len(payload)
+                if t0 is not None and _OBS.on:
+                    _H_ENC_PARK.observe(_now() - t0)
                 self._frame_change(payload, cb)

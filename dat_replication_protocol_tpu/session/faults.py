@@ -38,6 +38,19 @@ import random
 import time
 from typing import Callable, Optional
 
+from ..obs.events import emit as _emit
+from ..obs.metrics import OBS as _OBS, counter as _counter
+
+# Ground-truth telemetry: the injector records every fault it actually
+# fires, so the conformance sweep (tests/test_obs_conformance.py) can
+# assert the session layers' own metrics/events agree with what chaos
+# really did — the oracle side of the contract (OBSERVABILITY.md).
+_M_INJ_DROP = _counter("fault.injected.drop")
+_M_INJ_TRUNCATE = _counter("fault.injected.truncate")
+_M_INJ_FLIP = _counter("fault.injected.flip")
+_M_INJ_STALL = _counter("fault.injected.stall")
+_M_INJ_RESEG = _counter("fault.injected.reseg_segments")
+
 __all__ = [
     "TransportFault",
     "FaultPlan",
@@ -137,6 +150,7 @@ class _FaultState:
         self._rng = random.Random(plan.seed)
         self._stalled = False
         self._dead = False
+        self._truncated = False
 
     def pre_read(self, n: int) -> tuple[Optional[int], float]:
         """(segment limit, sleep seconds) for the next read; limit None
@@ -148,14 +162,24 @@ class _FaultState:
                 offset=self.offset)
         if p.drop_at is not None and self.offset >= p.drop_at:
             self._dead = True
+            if _OBS.on:
+                _M_INJ_DROP.inc()
+                _emit("fault.drop", offset=self.offset)
             raise TransportFault(
                 f"injected disconnect at byte {self.offset}",
                 offset=self.offset)
         if p.truncate_at is not None and self.offset >= p.truncate_at:
+            if not self._truncated:
+                self._truncated = True
+                if _OBS.on:
+                    _M_INJ_TRUNCATE.inc()
+                    _emit("fault.truncate", offset=self.offset)
             return None, 0.0
         limit = max(1, n)
         if p.max_segment:
             limit = self._rng.randint(1, max(1, min(limit, p.max_segment)))
+            if _OBS.on:
+                _M_INJ_RESEG.inc()
         if p.drop_at is not None:
             limit = min(limit, p.drop_at - self.offset)
         if p.truncate_at is not None:
@@ -164,6 +188,9 @@ class _FaultState:
         if (p.stall_at is not None and not self._stalled
                 and self.offset >= p.stall_at):
             self._stalled = True
+            if _OBS.on:
+                _M_INJ_STALL.inc()
+                _emit("fault.stall", offset=self.offset, seconds=p.stall_s)
             sleep_s += p.stall_s
         if p.latency_prob and self._rng.random() < p.latency_prob:
             sleep_s += p.latency_s
@@ -177,6 +204,9 @@ class _FaultState:
             i = p.flip_at - self.offset
             mask = p.flip_mask or 0xFF
             chunk = chunk[:i] + bytes((chunk[i] ^ mask,)) + chunk[i + 1:]
+            if _OBS.on:
+                _M_INJ_FLIP.inc()
+                _emit("fault.flip", offset=p.flip_at, mask=mask)
         self.offset += len(chunk)
         return chunk
 

@@ -25,6 +25,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..obs.events import emit as _emit
+from ..obs.metrics import OBS as _OBS, counter as _counter, \
+    histogram as _histogram
 from ..wire.framing import ProtocolError
 from .decoder import Decoder, DecoderDestroyedError
 from .faults import TransportFault
@@ -32,6 +35,14 @@ from .resume import SessionCheckpoint
 from .transport import DEFAULT_CHUNK
 
 __all__ = ["BackoffPolicy", "retrying", "run_resumable"]
+
+# Reconnect telemetry (OBSERVABILITY.md): the conformance oracle
+# compares these against the driver's own stats dict — attempt and
+# backoff counts must equal the ground truth exactly.
+_M_ATTEMPTS = _counter("reconnect.attempts")
+_M_FAULTS = _counter("reconnect.faults")
+_M_BACKOFFS = _counter("reconnect.backoffs")
+_H_BACKOFF = _histogram("reconnect.backoff.seconds")
 
 
 class BackoffPolicy:
@@ -61,6 +72,13 @@ class BackoffPolicy:
 
     def sleep_before(self, attempt: int) -> float:
         d = self.delay(attempt)
+        if _OBS.on:
+            # the single backoff choke point: run_resumable, retrying(),
+            # and the sidecar's bind/accept retries all sleep HERE, so
+            # one site covers every backoff in the stack
+            _M_BACKOFFS.inc()
+            _H_BACKOFF.observe(d)
+            _emit("reconnect.backoff", attempt=attempt, seconds=d)
         if d > 0:
             self._sleep(d)
         return d
@@ -140,6 +158,11 @@ def run_resumable(
         while True:
             ckpt = decoder.checkpoint()
             stats["attempts"] += 1
+            if _OBS.on:
+                _M_ATTEMPTS.inc()
+                _emit("session.connect", attempt=stats["attempts"],
+                      wire_offset=ckpt.wire_offset,
+                      resumed=stats["attempts"] > 1)
             # The fault catches wrap ONLY the transport calls (source()
             # and reader.read) — catching OSError around decoder.write
             # would misclassify an app handler's own OSError (e.g.
@@ -166,6 +189,9 @@ def run_resumable(
                         # silent truncation: the connection closed
                         # cleanly short of the sender's declared length
                         # — same recovery path as a drop
+                        if _OBS.on:
+                            _emit("session.truncated", at=decoder.bytes,
+                                  expected=expected_total)
                         fault = TransportFault(
                             f"truncated: clean EOF at byte "
                             f"{decoder.bytes} of {expected_total}",
@@ -183,8 +209,15 @@ def run_resumable(
             if fault is not None:
                 failures += 1
                 stats["faults"].append(str(fault))
+                if _OBS.on:
+                    _M_FAULTS.inc()
+                    _emit("reconnect.fault", failures=failures,
+                          offset=decoder.bytes, cause=str(fault))
                 if failures > policy.max_retries:
                     last = decoder.checkpoint()
+                    if _OBS.on:
+                        _emit("session.failed", failures=failures,
+                              frame=last.frame, offset=last.wire_offset)
                     raise ProtocolError(
                         f"session lost after {failures} transport fault(s)",
                         frame=last.frame, offset=last.wire_offset,
@@ -200,6 +233,10 @@ def run_resumable(
                 decoder.end()
                 if decoder.destroyed:  # e.g. EOF mid-frame
                     raise _wire_error(errors, decoder.checkpoint())
+            if _OBS.on:
+                _emit("session.complete", bytes=decoder.bytes,
+                      reconnects=stats["reconnects"],
+                      attempts=stats["attempts"])
             return stats
     finally:
         decoder._remove_drain_watcher(wake.set)
@@ -222,6 +259,10 @@ def _wait_writable(decoder: Decoder, wake: threading.Event,
     while not (decoder.writable() or decoder.destroyed or decoder.finished):
         if deadline is not None and time.monotonic() > deadline:
             ckpt = decoder.checkpoint()
+            if _OBS.on:
+                _emit("session.stall", kind="app-ack",
+                      seconds=stall_timeout, frame=ckpt.frame,
+                      offset=ckpt.wire_offset)
             err = ProtocolError(
                 f"app stalled: no ack for {stall_timeout}s",
                 frame=ckpt.frame, offset=ckpt.wire_offset,

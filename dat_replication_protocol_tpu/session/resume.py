@@ -28,9 +28,20 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+from ..obs.events import emit as _emit
+from ..obs.metrics import OBS as _OBS, counter as _counter
 from ..wire.framing import ProtocolError
 
 __all__ = ["SessionCheckpoint", "WireJournal", "ResumeError"]
+
+# Journal telemetry (OBSERVABILITY.md): replayed bytes are the resume
+# cost a reconnect actually pays on the wire; acked bytes are the
+# duplicate-suppressed history a resume can never re-deliver (trimmed,
+# so a checkpoint below them is a structured ResumeError, not a silent
+# replay from the wrong place).
+_M_J_APPEND = _counter("journal.append.bytes")
+_M_J_REPLAY = _counter("journal.replay.bytes")
+_M_J_ACKED = _counter("journal.acked.bytes")
 
 
 class ResumeError(ProtocolError):
@@ -104,6 +115,8 @@ class WireJournal:
 
     def append(self, data) -> None:
         self._buf += data
+        if _OBS.on:
+            _M_J_APPEND.inc(len(data))
 
     def seek(self, offset: int) -> None:
         """Align an EMPTY journal's window to an absolute wire offset —
@@ -120,6 +133,8 @@ class WireJournal:
         if offset > self.end:
             raise ValueError(
                 f"ack({offset}) beyond journal end {self.end}")
+        if _OBS.on:
+            _M_J_ACKED.inc(offset - self._start)
         del self._buf[: offset - self._start]
         self._start = offset
 
@@ -127,15 +142,24 @@ class WireJournal:
         """Every journaled byte at ``offset`` and beyond (a copy: the
         journal may keep growing while the replay is in flight)."""
         if offset < self._start:
+            if _OBS.on:
+                _emit("journal.replay_miss", offset=offset,
+                      start=self._start)
             raise ResumeError(
                 "checkpoint predates the journal's retained window "
                 f"(asked for byte {offset}, journal starts at {self._start})",
                 offset=offset,
             )
         if offset > self.end:
+            if _OBS.on:
+                _emit("journal.replay_miss", offset=offset, end=self.end)
             raise ResumeError(
                 f"checkpoint is ahead of everything produced (byte {offset}, "
                 f"journal ends at {self.end})",
                 offset=offset,
             )
-        return bytes(self._buf[offset - self._start:])
+        out = bytes(self._buf[offset - self._start:])
+        if _OBS.on:
+            _M_J_REPLAY.inc(len(out))
+            _emit("journal.replay", offset=offset, bytes=len(out))
+        return out

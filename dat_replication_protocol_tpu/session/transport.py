@@ -40,10 +40,20 @@ import socket
 import threading
 from typing import Callable
 
+from ..obs.metrics import OBS as _OBS, counter as _counter
 from .decoder import Decoder, DecoderDestroyedError
 from .encoder import Encoder, EncoderDestroyedError
 
 DEFAULT_CHUNK = 64 * 1024
+
+# Wakeup attribution (OBSERVABILITY.md): `.event` counts waits ended by
+# the drain-watcher / readable-hook actually firing, `.poll` counts
+# WAKE_FALLBACK expiries — quantifying whether the event plumbing from
+# PR 2 really carries the wakeups or the guarded poll is doing the work.
+_M_RECV_WAKE_EVENT = _counter("transport.recv.wake.event")
+_M_RECV_WAKE_POLL = _counter("transport.recv.wake.poll")
+_M_SEND_WAKE_EVENT = _counter("transport.send.wake.event")
+_M_SEND_WAKE_POLL = _counter("transport.send.wake.poll")
 
 # Guarded-fallback poll period: wakeups are event-driven (the encoder's
 # readable hook / the decoder's drain watchers), so this bound only
@@ -79,7 +89,10 @@ def send_over(
                 # bounded: the readable hook fires on every push, but a
                 # hang here has no recovery path at all — re-check on the
                 # fallback period rather than trusting a single wakeup
-                readable.wait(WAKE_FALLBACK)
+                woke = readable.wait(WAKE_FALLBACK)
+                if _OBS.on:
+                    (_M_SEND_WAKE_EVENT if woke
+                     else _M_SEND_WAKE_POLL).inc()
                 readable.clear()
                 continue
             write_bytes(bytes(data))
@@ -130,7 +143,10 @@ def recv_over(
             if not consumed:
                 while not (decoder.writable() or decoder.destroyed
                            or decoder.finished):
-                    wake.wait(WAKE_FALLBACK)
+                    woke = wake.wait(WAKE_FALLBACK)
+                    if _OBS.on:
+                        (_M_RECV_WAKE_EVENT if woke
+                         else _M_RECV_WAKE_POLL).inc()
                     wake.clear()
     finally:
         decoder._remove_drain_watcher(wake.set)

@@ -32,11 +32,17 @@ malformed client observes EOF rather than a hang.
 
 from __future__ import annotations
 
+import json
+import os
 import socket
 import sys
 import threading
 import time
 
+from .obs import events as obs_events
+from .obs import metrics as obs_metrics
+from .obs.events import emit as _emit
+from .obs.metrics import OBS as _OBS, counter as _counter
 from .session.transport import recv_over, send_over
 
 DIGEST_SUBSET_CHANGE = "digest:change"
@@ -46,6 +52,11 @@ DIGEST_SUBSET_BLOB = "digest:blob"
 # its reply must not park a session thread forever (ADVICE.md round 5).
 DEFAULT_DRAIN_TIMEOUT = 600.0
 _DRAIN_POLL = 0.25
+
+DEFAULT_STATS_INTERVAL = 5.0
+
+_M_SESSIONS = _counter("sidecar.sessions")
+_M_STALLS = _counter("sidecar.stalls")
 
 
 def run_session(read_bytes, write_bytes, close_write=None,
@@ -88,6 +99,13 @@ def run_session(read_bytes, write_bytes, close_write=None,
                 and now - progress["t"] > drain_timeout)
 
     def _teardown_stalled() -> None:
+        # the drain deadline fired: the client stopped reading its reply
+        # (ADVICE.md round 5 low) — record it as a structured stall
+        # event so the leak class is visible at runtime, then tear down
+        if _OBS.on:
+            _M_STALLS.inc()
+            _emit("sidecar.stall", kind="reply-drain",
+                  seconds=drain_timeout, reply_bytes=enc.bytes)
         enc.destroy(TimeoutError(
             f"reply stream stalled for {drain_timeout}s"))
         if close_write is not None:
@@ -185,7 +203,7 @@ def run_session(read_bytes, write_bytes, close_write=None,
                 _teardown_stalled()
                 sender.join(timeout=5)
                 break
-    return {
+    out = {
         "changes": dec.changes,
         "blobs": dec.blobs,
         "bytes": dec.bytes,
@@ -193,12 +211,14 @@ def run_session(read_bytes, write_bytes, close_write=None,
         "ok": (dec.finished and not dec.destroyed and not enc.destroyed
                and not sender.is_alive()),
     }
+    if _OBS.on:
+        _M_SESSIONS.inc()
+        _emit("sidecar.session", **out)
+    return out
 
 
 def serve_stdio(drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
     """One session over stdin/stdout (logs go to stderr only)."""
-    import os
-
     # close_write can fire from the session thread (drain-timeout
     # teardown) while the sender thread sits mid-write on fd 1, so a
     # bare os.close(1) has a reuse hazard: once fd 1 is free, any
@@ -232,8 +252,6 @@ def serve_stdio(drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
 
 
 def _write_all(fd: int, data: bytes) -> None:
-    import os
-
     view = memoryview(data)
     while view:
         view = view[os.write(fd, view):]
@@ -309,6 +327,110 @@ def serve_tcp(host: str, port: int,
         srv.close()
 
 
+class StatsEmitter:
+    """Periodic registry snapshots as JSON lines on a file descriptor.
+
+    The ``--stats-fd`` machinery: a daemon thread dumps one line every
+    ``interval`` seconds; :meth:`kick` forces an immediate dump (the
+    SIGUSR1 one-shot — the handler just sets an event, so the dump work
+    never runs in signal context).  Lines are self-contained JSON
+    objects (see OBSERVABILITY.md for the schema), so a supervisor can
+    ``tail -f`` the pipe and parse each line independently.
+    """
+
+    def __init__(self, fd: int, interval: float = DEFAULT_STATS_INTERVAL):
+        self._fd = fd
+        self._interval = interval
+        self._wake = threading.Event()
+        self._stopped = False
+        self._dead = False  # fd failed or a line tore: never write again
+        self._thread = threading.Thread(
+            target=self._run, name="sidecar-stats", daemon=True)
+
+    def start(self) -> "StatsEmitter":
+        self._thread.start()
+        return self
+
+    def kick(self) -> None:
+        """Request an immediate snapshot dump (signal-safe: only sets
+        an event; the emitter thread does the I/O)."""
+        self._wake.set()
+
+    def stop(self) -> bool:
+        """Stop the emitter thread; returns True once it has actually
+        exited.  False means it is still blocked (e.g. inside a write
+        to a pipe nobody drains) — the caller must NOT write the fd
+        itself then, or the two writers interleave past PIPE_BUF."""
+        self._stopped = True
+        self._wake.set()
+        self._thread.join(timeout=5)
+        return not self._thread.is_alive()
+
+    def dump_once(self) -> bool:
+        """Write one snapshot line now (from the calling thread);
+        returns False when the fd is dead or persistently blocked.
+        Once a record TORE (partial write, then the pipe stayed full
+        past the grace period) the emitter latches dead: appending any
+        later record to the torn fragment would merge two lines and
+        break the one-JSON-object-per-line contract."""
+        import errno
+
+        if self._dead:
+            return False
+        line = (json.dumps(snapshot_stats()) + "\n").encode("utf-8")
+        view = memoryview(line)
+        deadline = time.monotonic() + 2.0
+        while view:
+            try:
+                view = view[os.write(self._fd, view):]
+            except OSError as e:
+                # EAGAIN is a momentarily-full pipe, not a dead one: a
+                # bounded retry finishes the record (a half-written
+                # line would corrupt the JSONL stream).  Skip the tick
+                # if nothing was written yet; a pipe still full after
+                # the grace period counts as a dead consumer.
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    if time.monotonic() < deadline:
+                        time.sleep(0.01)
+                        continue
+                    if len(view) == len(line):
+                        return True  # clean skip: nothing written yet
+                self._dead = True  # torn line or hard error
+                return False
+        return True
+
+    def _run(self) -> None:
+        while not self._stopped:
+            self._wake.wait(self._interval)
+            self._wake.clear()
+            if self._stopped:
+                return
+            if not self.dump_once():
+                return  # consumer closed the stats pipe: stop quietly
+
+
+def snapshot_stats() -> dict:
+    """One self-describing stats record: the full metrics registry
+    snapshot plus event-ring health.  JSON-able as-is."""
+    return {
+        "ts": time.time(),
+        "monotonic": time.monotonic(),
+        "metrics": obs_metrics.snapshot(),
+        "events_dropped": obs_events.EVENTS.dropped,
+    }
+
+
+def _install_sigusr1(emitter: StatsEmitter) -> bool:
+    """SIGUSR1 -> one-shot stats dump; returns False when not on the
+    main thread (signal registration would raise there)."""
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    signal.signal(signal.SIGUSR1, lambda _sig, _frm: emitter.kick())
+    return True
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -341,24 +463,45 @@ def main(argv=None) -> int:
                    help="base of the exponential-backoff-with-full-jitter "
                         "retry delay: attempt k sleeps uniform(0, "
                         "min(cap, base * 2^k)) (default: 0.05)")
+    p.add_argument("--stats-fd", type=int, default=None, metavar="FD",
+                   help="enable telemetry and write one JSON metrics "
+                        "snapshot line to this file descriptor every "
+                        "--stats-interval seconds; SIGUSR1 forces an "
+                        "immediate one-shot dump (see OBSERVABILITY.md)")
+    p.add_argument("--stats-interval", type=float,
+                   default=DEFAULT_STATS_INTERVAL, metavar="SECONDS",
+                   help="period between --stats-fd snapshots "
+                        f"(default: {DEFAULT_STATS_INTERVAL:.0f})")
     args = p.parse_args(argv)
     drain = args.drain_timeout if args.drain_timeout > 0 else None
     from .session.reconnect import BackoffPolicy
 
     policy = BackoffPolicy(base=args.backoff_base,
                            max_retries=args.max_retries)
+    emitter = None
+    if args.stats_fd is not None:
+        obs_metrics.enable()  # --stats-fd IS the telemetry opt-in
+        emitter = StatsEmitter(args.stats_fd, args.stats_interval).start()
+        _install_sigusr1(emitter)
     if args.backend == "host":
-        import os
-
         os.environ["DAT_DEVICE_HASH"] = "0"  # routing-layer override:
         # force the host digest engine for this daemon's lifetime
-    if args.stdio:
-        stats = serve_stdio(drain_timeout=drain)
-        return 0 if stats["ok"] else 1
-    host, _, port = args.tcp.rpartition(":")
-    serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain,
-              retry_policy=policy)
-    return 0
+    try:
+        if args.stdio:
+            stats = serve_stdio(drain_timeout=drain)
+            return 0 if stats["ok"] else 1
+        host, _, port = args.tcp.rpartition(":")
+        serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain,
+                  retry_policy=policy)
+        return 0
+    finally:
+        if emitter is not None and emitter.stop():
+            # final snapshot — ONLY once the periodic thread really
+            # exited: two concurrent writers on one fd can interleave
+            # past PIPE_BUF and corrupt the one-JSON-object-per-line
+            # contract (an emitter still blocked on a never-drained
+            # pipe keeps sole ownership of the fd instead)
+            emitter.dump_once()
 
 
 if __name__ == "__main__":

@@ -25,6 +25,9 @@ import contextlib
 
 
 class _NullSpan:
+    def __init__(self, *_a, **_k):
+        pass
+
     def __enter__(self):
         return self
 
@@ -32,13 +35,37 @@ class _NullSpan:
         return False
 
 
+# Lazily bound span factory: jax.profiler.TraceAnnotation, or _NullSpan
+# when JAX is unavailable.  Bound ONCE at first use (the _fastpath_gate
+# trick): span() sits on dispatch hot paths, and re-attempting the
+# import on every call costs ~1.8us of import machinery per span even
+# on the cache-hit path.  Availability of jax cannot change mid-process
+# (unlike an env-var gate), so a permanent bind is safe;
+# _reset_span_binding_for_tests() exists for test isolation only.
+_span_factory = None
+
+
+def _bind_span_factory():
+    global _span_factory
+    try:
+        from jax.profiler import TraceAnnotation as factory
+    except Exception:
+        factory = _NullSpan
+    _span_factory = factory
+    return factory
+
+
+def _reset_span_binding_for_tests() -> None:
+    global _span_factory
+    _span_factory = None
+
+
 def span(name: str):
     """Named profiler annotation; inert if jax is unavailable."""
-    try:
-        from jax.profiler import TraceAnnotation
-    except Exception:
-        return _NullSpan()
-    return TraceAnnotation(name)
+    factory = _span_factory
+    if factory is None:
+        factory = _bind_span_factory()
+    return factory(name)
 
 
 @contextlib.contextmanager

@@ -36,3 +36,27 @@ if not os.environ.get("DAT_TPU_TESTS"):
     from dat_replication_protocol_tpu.utils.cache import enable_compile_cache
 
     enable_compile_cache("tests", env_var="DAT_TEST_COMPILE_CACHE")
+
+
+# -- shared telemetry isolation ---------------------------------------------
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def obs_enabled():
+    """Enable the obs gate for one test with clean metric values and an
+    empty event ring, restoring the prior gate state afterwards — the
+    registry is process-global, so isolation is explicit."""
+    from dat_replication_protocol_tpu.obs import events, metrics
+
+    was_on = metrics.OBS.on
+    metrics.REGISTRY.reset()
+    events.EVENTS.clear()
+    metrics.enable()
+    try:
+        yield metrics
+    finally:
+        metrics.OBS.on = was_on
+        metrics.REGISTRY.reset()
+        events.EVENTS.clear()

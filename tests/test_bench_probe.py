@@ -200,3 +200,70 @@ def test_peak_span_guards_drain_and_post_stall():
     assert bench._peak_span(dts) == 0.95
     # no credible spans at all -> fall back to the median
     assert bench._peak_span([1.0]) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# --metrics: per-config registry snapshots ride the artifact (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+
+def test_attach_metrics_noop_without_flag():
+    res = {"value": 1.0}
+    bench._METRICS["on"] = False
+    bench._attach_metrics(res)
+    assert "metrics" not in res
+
+
+def test_metrics_snapshot_rides_config_result_and_resets(monkeypatch):
+    """bench --metrics: each config's result carries the registry
+    snapshot for ITS run (attribution), the registry resetting between
+    configs; the snapshot itself must be JSON-able and show the
+    config's actual session traffic."""
+    import json
+
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setenv("BENCH_RESUME_ROWS", "200")
+    monkeypatch.setenv("BENCH_RESUME_REPS", "2")
+    was_on = obs_metrics.OBS.on
+    obs_metrics.REGISTRY.reset()
+    try:
+        bench._metrics_on()
+        res = bench.bench_resume(quick=True, backend="host")
+        bench._attach_metrics(res)
+    finally:
+        bench._METRICS["on"] = False
+        obs_metrics.OBS.on = was_on
+        obs_metrics.REGISTRY.reset()
+    snap = json.loads(json.dumps(res["metrics"]))  # parseable as-is
+    # the resume probe's story is in the numbers: attempts, faults, and
+    # replayed journal bytes all nonzero, decoder traffic attributed
+    assert snap["counters"]["reconnect.attempts"] > 0
+    assert snap["counters"]["reconnect.faults"] > 0
+    assert snap["counters"]["decoder.changes"] > 0
+    assert snap["histograms"]["decoder.dispatch.seconds"]["count"] > 0
+    # and the attach RESET the registry for the next config
+    assert obs_metrics.REGISTRY.counter("reconnect.attempts").value == 0
+
+
+def test_cpu_fallback_child_inherits_metrics_flag(monkeypatch):
+    """The fallback child's numbers need attribution too: when the
+    parent runs --metrics, the spawned argv must carry it."""
+    captured = {}
+
+    class FakeProc:
+        pass
+
+    def fake_popen(argv, **kwargs):
+        captured["argv"] = argv
+        return FakeProc()
+
+    import subprocess
+
+    monkeypatch.setattr(subprocess, "Popen", fake_popen)
+    bench._METRICS["on"] = True
+    try:
+        bench._start_cpu_fallback(["3"], quick=True, budget_s=60)
+    finally:
+        bench._METRICS["on"] = False
+    assert "--metrics" in captured["argv"]

@@ -477,3 +477,87 @@ def test_findings_are_sorted_and_rendered_with_location(tmp_path):
     assert findings == sorted(findings)
     rendered = findings[0].render()
     assert "aa.py" in rendered and "unbounded-join:" in rendered
+
+
+# -- obs-discipline (ISSUE 3: greppable telemetry names; stdout is wire) ----
+
+OBS_BAD = '''
+def instrument(kind, registry, emit):
+    c = registry.counter(f"decoder.{kind}")
+    c.inc()
+    emit("decoder." + kind, offset=0)
+    print("decoded a frame")
+'''
+
+OBS_GOOD = '''
+import sys
+
+def instrument(registry, emit):
+    c = registry.counter("decoder.changes")
+    c.inc()
+    emit("protocol.error", offset=0)
+    print("diagnostics", file=sys.stderr)
+'''
+
+
+def test_obs_discipline_fires_on_dynamic_names_and_bare_print(tmp_path):
+    findings = _lint(tmp_path, ("dyn.py", OBS_BAD))
+    obs = [f for f in findings if f.rule == "obs-discipline"]
+    assert len(obs) == 3  # f-string counter, concatenated emit, bare print
+    msgs = " ".join(f.message for f in obs)
+    assert "non-literal" in msgs and "print" in msgs
+
+
+def test_obs_discipline_clean_on_literals_and_stderr(tmp_path):
+    assert _lint(tmp_path, ("lit.py", OBS_GOOD)) == []
+
+
+def test_obs_discipline_matches_hoisted_underscore_aliases(tmp_path):
+    # the package idiom: `from ..obs.metrics import counter as _counter`
+    findings = _lint(tmp_path, ("alias.py", '''
+        def instrument(_counter, _emit, name):
+            _counter(name).inc()
+            _emit(name, x=1)
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 2
+
+
+def test_obs_discipline_exempts_cli_main_prints(tmp_path):
+    # a __main__.py CLI's stdout IS its interface
+    main_dir = tmp_path / "somepkg"
+    main_dir.mkdir()
+    (main_dir / "__main__.py").write_text('print("findings: 0")\n')
+    findings = run_paths([tmp_path])
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
+def test_obs_discipline_exempts_the_obs_plumbing_itself(tmp_path):
+    # obs/metrics.py forwards `name` params by design — not a site
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    (obs_dir / "metrics.py").write_text(textwrap.dedent('''
+        def counter(name):
+            return REGISTRY.counter(name)
+    '''))
+    findings = run_paths([tmp_path])
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
+def test_obs_discipline_suppression(tmp_path):
+    findings = _lint(tmp_path, ("sup.py", '''
+        def instrument(emit, name):
+            emit(name, x=1)  # datlint: disable=obs-discipline
+    '''))
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
+def test_obs_discipline_ignores_unrelated_emit_and_histogram_apis(tmp_path):
+    # same method NAMES on non-telemetry receivers: logging handlers,
+    # sockets, numpy — none of these touch the obs registry
+    findings = _lint(tmp_path, ("other.py", '''
+        def f(handler, sock, np, record, event, data, bins):
+            handler.emit(record)
+            sock.emit(event, data)
+            np.histogram(data, bins)
+    '''))
+    assert "obs-discipline" not in _rules_fired(findings)

@@ -39,6 +39,7 @@ def test_registry_ships_the_incident_rules():
         "bounded-wait",
         "jit-purity",
         "wire-constant-parity",
+        "obs-discipline",
     }
 
 

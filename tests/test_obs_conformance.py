@@ -1,0 +1,281 @@
+"""Chaos-oracle conformance: telemetry must agree with ground truth.
+
+The fault injector (session/faults.py) is the observability layer's
+oracle (ISSUE 3): it KNOWS what it did to the wire — every drop,
+truncation, stall, flip, and re-segmentation it injected — and the
+reconnect driver independently counts attempts/reconnects in its stats
+dict (the PR-2 machinery, tested on its own in test_session_faults.py).
+This suite runs the 20-seed ``FaultPlan.for_sweep`` sweep with
+telemetry enabled and asserts three-way agreement:
+
+* every injected fault kind is reflected by a matching metric/event
+  (drop/truncate -> ``reconnect.fault``; stall -> ``fault.stall`` with
+  the plan's duration; reseg -> the segment counter; flip -> a
+  ``protocol.error`` event, targeted test);
+* reconnect attempt/backoff counts in the metrics equal the driver's
+  stats AND the actual sleeps taken (captured via the policy's
+  injectable sleep);
+* the telemetry counters mirror the session's passive counters
+  (``decoder.changes`` metric == ``dec.changes`` attribute, ...) — the
+  layer measures the session, not itself.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.obs import events as obs_events
+from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+from dat_replication_protocol_tpu.session.faults import (
+    FaultPlan,
+    FaultyReader,
+    bytes_reader,
+)
+from dat_replication_protocol_tpu.session.reconnect import (
+    BackoffPolicy,
+    run_resumable,
+)
+from dat_replication_protocol_tpu.session.resume import WireJournal
+from dat_replication_protocol_tpu.wire.framing import ProtocolError
+
+EVENTS = obs_events.EVENTS
+
+
+def _build_wire() -> bytes:
+    """Same scenario coverage as the PR-2 sweep: a bulk change run, two
+    interleaved corked blobs, a parked change, a multi-KiB blob, tails."""
+    e = protocol.encode()
+    j = WireJournal()
+    e.attach_journal(j)
+    for i in range(24):
+        e.change({"key": f"bulk-{i}", "change": i, "from": i, "to": i + 1,
+                  "value": b"v%03d" % i})
+    b1 = e.blob(11)
+    b2 = e.blob(11)
+    b1.write(b"hello ")
+    b2.write(b"HELLO ")
+    b1.write(b"world")
+    b2.write(b"WORLD")
+    b1.end()
+    b2.end()
+    big = e.blob(3000)
+    big.write(b"x" * 1700)
+    e.change({"key": "parked", "change": 99, "from": 0, "to": 1,
+              "value": b"after-blob"})
+    big.end(b"y" * 1300)
+    for i in range(8):
+        e.change({"key": f"tail-{i}", "change": i, "from": i, "to": i + 1})
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    return j.read_from(0)
+
+
+_WIRE = _build_wire()
+
+
+def _counter_value(name: str) -> int:
+    return obs_metrics.REGISTRY.counter(name).value
+
+
+def _plan_kind(plan: FaultPlan) -> str | None:
+    if plan.drop_at is not None:
+        return "drop"
+    if plan.truncate_at is not None:
+        return "truncate"
+    if plan.stall_at is not None:
+        return "stall"
+    if plan.max_segment == 1:
+        return "reseg"
+    return None
+
+
+def _run_seed_with_oracle(seed: int) -> dict:
+    """One fully-instrumented seed; returns every ground-truth record
+    the assertions need."""
+    obs_metrics.REGISTRY.reset()
+    EVENTS.clear()
+    dec = protocol.decode()
+    delivered: list = []
+    dec.change(lambda c, done: (delivered.append(("change", c.key)), done()))
+    dec.blob(lambda b, done: b.collect(
+        lambda data: (delivered.append(("blob", len(data))), done())))
+
+    journal = WireJournal()
+    journal.append(_WIRE)
+    plans: list[FaultPlan] = []
+    source_offsets: list[int] = []
+
+    def source(ckpt, failures):
+        source_offsets.append(ckpt.wire_offset)
+        replay = journal.read_from(ckpt.wire_offset)
+        plan = FaultPlan.for_sweep(seed, len(replay), attempt=failures)
+        plans.append(plan)
+        return FaultyReader(bytes_reader(replay), plan)
+
+    sleeps: list[float] = []  # ground truth: the sleeps actually taken
+
+    def sleep(d: float) -> None:
+        sleeps.append(d)
+
+    stats = run_resumable(
+        source, dec,
+        BackoffPolicy(base=0.0005, cap=0.005, max_retries=8, seed=seed,
+                      sleep=sleep),
+        chunk_size=1024, expected_total=len(_WIRE), stall_timeout=15)
+    return {
+        "stats": stats, "dec": dec, "plans": plans,
+        "source_offsets": source_offsets, "sleeps": sleeps,
+        "delivered": delivered,
+    }
+
+
+def test_sweep_telemetry_matches_ground_truth(obs_enabled):
+    kinds_seen: set[str] = set()
+    for seed in range(20):
+        r = _run_seed_with_oracle(seed)
+        stats, dec = r["stats"], r["dec"]
+        ctx = f"seed {seed}"
+
+        # -- driver ground truth vs reconnect metrics/events ------------
+        assert _counter_value("reconnect.attempts") == stats["attempts"], ctx
+        assert len(EVENTS.events("session.connect")) == stats["attempts"], ctx
+        assert _counter_value("reconnect.faults") == len(stats["faults"]), ctx
+        assert len(EVENTS.events("reconnect.fault")) == len(stats["faults"]), ctx
+        # converged sweep seeds absorb every fault: reconnects == faults
+        assert _counter_value("reconnect.backoffs") == stats["reconnects"], ctx
+
+        # -- backoff: events match the sleeps the policy actually took --
+        backoffs = [e["fields"]["seconds"]
+                    for e in EVENTS.events("reconnect.backoff")]
+        assert len(backoffs) == stats["reconnects"], ctx
+        # sleep() is skipped for d == 0 but the event always fires: every
+        # nonzero recorded sleep must appear, in order, with exact values
+        assert [d for d in backoffs if d > 0] == r["sleeps"], ctx
+
+        # -- injected faults vs session-layer recovery ------------------
+        inj_drops = EVENTS.events("fault.drop")
+        inj_truncs = EVENTS.events("fault.truncate")
+        # every disconnect-class injection produced exactly one driver
+        # fault, and nothing else did
+        assert len(inj_drops) + len(inj_truncs) == len(stats["faults"]), ctx
+        assert len(EVENTS.events("session.truncated")) == len(inj_truncs), ctx
+        for plan, off0 in zip(r["plans"], r["source_offsets"]):
+            kind = _plan_kind(plan)
+            if kind:
+                kinds_seen.add(kind)
+            if kind == "drop":
+                assert any(e["fields"]["offset"] == plan.drop_at
+                           for e in inj_drops), ctx
+            elif kind == "truncate":
+                assert any(e["fields"]["offset"] == plan.truncate_at
+                           for e in inj_truncs), ctx
+            elif kind == "stall":
+                stall_events = EVENTS.events("fault.stall")
+                assert any(e["fields"]["seconds"] == plan.stall_s
+                           for e in stall_events), ctx
+            elif kind == "reseg":
+                assert _counter_value(
+                    "fault.injected.reseg_segments") > 0, ctx
+
+        # -- journal replay bytes == what the source really re-read -----
+        expected_replay = sum(len(_WIRE) - off for off in r["source_offsets"])
+        assert _counter_value("journal.replay.bytes") == expected_replay, ctx
+        assert len(EVENTS.events("journal.replay")) == len(
+            r["source_offsets"]), ctx
+
+        # -- telemetry mirrors the session's passive counters -----------
+        assert _counter_value("decoder.changes") == dec.changes, ctx
+        assert _counter_value("decoder.blobs") == dec.blobs, ctx
+        assert _counter_value("decoder.bytes") == dec.bytes, ctx
+        # a clean completion emits exactly one session.complete carrying
+        # the driver's own totals
+        completes = EVENTS.events("session.complete")
+        assert len(completes) == 1, ctx
+        assert completes[0]["fields"]["reconnects"] == stats["reconnects"], ctx
+        assert completes[0]["fields"]["bytes"] == dec.bytes, ctx
+
+    # 20 seeds must exercise every disconnect-class kind the sweep
+    # generator can draw (flip is corruption-class: targeted below)
+    assert kinds_seen == {"drop", "truncate", "stall", "reseg"}, kinds_seen
+
+
+@pytest.mark.slow
+def test_sweep_soak_seeds_20_to_120(obs_enabled):
+    """Soak arm (marker already registered in pyproject): 100 more
+    seeds of the core agreement invariants."""
+    for seed in range(20, 120):
+        r = _run_seed_with_oracle(seed)
+        stats = r["stats"]
+        ctx = f"seed {seed}"
+        assert _counter_value("reconnect.attempts") == stats["attempts"], ctx
+        assert _counter_value("reconnect.faults") == len(stats["faults"]), ctx
+        assert len(EVENTS.events("fault.drop")) + len(
+            EVENTS.events("fault.truncate")) == len(stats["faults"]), ctx
+        assert _counter_value("decoder.changes") == r["dec"].changes, ctx
+
+
+def test_header_flip_surfaces_as_matching_protocol_error_event(obs_enabled):
+    def source(ckpt, failures):
+        plan = FaultPlan(seed=1,
+                         flip_at=1 - ckpt.wire_offset
+                         if ckpt.wire_offset <= 1 else None, flip_mask=0x44)
+        return FaultyReader(bytes_reader(_WIRE[ckpt.wire_offset:]), plan)
+
+    dec = protocol.decode()
+    with pytest.raises(ProtocolError) as ei:
+        run_resumable(source, dec,
+                      BackoffPolicy(base=0, max_retries=2, seed=0),
+                      expected_total=len(_WIRE), stall_timeout=5)
+    # the injector recorded the flip, the decoder recorded the error,
+    # and the two coordinates agree with the raised exception
+    assert EVENTS.count("fault.flip") >= 1
+    errors = EVENTS.events("protocol.error")
+    assert len(errors) >= 1
+    assert errors[-1]["fields"]["offset"] == ei.value.offset
+    assert errors[-1]["fields"]["frame"] == ei.value.frame
+    assert obs_metrics.REGISTRY.counter("decoder.errors").value >= 1
+
+
+def test_app_stall_emits_structured_stall_event(obs_enabled):
+    dec = protocol.decode()
+    dec.change(lambda c, done: None)  # never acks: the app stall
+
+    def source(ckpt, failures):
+        return FaultyReader(bytes_reader(_WIRE[ckpt.wire_offset:]),
+                            FaultPlan(seed=0))
+
+    with pytest.raises(ProtocolError) as ei:
+        run_resumable(source, dec, BackoffPolicy(base=0, max_retries=0),
+                      expected_total=len(_WIRE),
+                      stall_timeout=0.2, wait_step=0.05)
+    assert "stalled" in str(ei.value)
+    stalls = EVENTS.events("session.stall")
+    assert len(stalls) == 1
+    assert stalls[0]["fields"]["kind"] == "app-ack"
+    assert stalls[0]["fields"]["offset"] == ei.value.offset
+
+
+def test_sweep_seed_disabled_gate_records_nothing():
+    """The whole instrumented stack behind one dark gate: a faulted,
+    resumed session with obs off must leave zero telemetry."""
+    obs_metrics.REGISTRY.reset()
+    EVENTS.clear()
+    assert not obs_metrics.OBS.on
+    dec = protocol.decode()
+
+    def source(ckpt, failures):
+        plan = FaultPlan.for_sweep(3, len(_WIRE) - ckpt.wire_offset,
+                                   attempt=failures)
+        return FaultyReader(bytes_reader(_WIRE[ckpt.wire_offset:]), plan)
+
+    stats = run_resumable(
+        source, dec,
+        BackoffPolicy(base=0.0005, cap=0.005, max_retries=8, seed=3),
+        chunk_size=1024, expected_total=len(_WIRE), stall_timeout=15)
+    assert stats is not None and dec.finished
+    snap = obs_metrics.snapshot()
+    assert all(v == 0 for v in snap["counters"].values())
+    assert all(h["count"] == 0 for h in snap["histograms"].values())
+    assert EVENTS.events() == []

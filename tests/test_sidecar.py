@@ -261,3 +261,133 @@ def test_slow_upload_then_burst_is_not_torn_down_as_stalled():
                                 drain_timeout=1.5)
     assert stats["ok"] is True, f"healthy session torn down: {stats}"
     assert stats["digests"] == n
+
+
+# -- telemetry (ISSUE 3): stall events + --stats-fd machinery ----------------
+
+
+def test_stall_teardown_emits_structured_stall_event(obs_enabled):
+    """Satellite of ISSUE 3: the reply-drain deadline firing must be
+    VISIBLE — a sidecar.stall event with the deadline and reply
+    progress, plus the stalls counter — not just a silent teardown."""
+    from dat_replication_protocol_tpu.obs.events import EVENTS
+
+    fed = {"done": False}
+
+    def read_bytes(n):
+        if fed["done"]:
+            return b""
+        fed["done"] = True
+        return SESSION_1
+
+    released = threading.Event()
+    closed = threading.Event()
+
+    def write_bytes(data):
+        if closed.is_set():
+            raise OSError("EPIPE")
+        released.wait(30)
+        raise OSError("EPIPE")
+
+    def close_write():
+        closed.set()
+        released.set()
+
+    stats = sidecar.run_session(read_bytes, write_bytes,
+                                close_write=close_write,
+                                drain_timeout=0.5)
+    assert stats["ok"] is False
+    stalls = EVENTS.events("sidecar.stall")
+    assert len(stalls) == 1
+    assert stalls[0]["fields"]["kind"] == "reply-drain"
+    assert stalls[0]["fields"]["seconds"] == 0.5
+    assert obs_enabled.REGISTRY.counter("sidecar.stalls").value == 1
+    # the session record rides the same event stream
+    sessions = EVENTS.events("sidecar.session")
+    assert len(sessions) == 1 and sessions[0]["fields"]["ok"] is False
+
+
+def test_stats_emitter_kick_forces_immediate_parseable_dump(obs_enabled):
+    import json
+    import os
+
+    obs_enabled.REGISTRY.counter("sidecar.test.marker").inc(7)
+    r, w = os.pipe()
+    emitter = sidecar.StatsEmitter(w, interval=60.0).start()
+    try:
+        emitter.kick()
+        line = b""
+        while not line.endswith(b"\n"):
+            line += os.read(r, 65536)
+        rec = json.loads(line.decode())
+        assert rec["metrics"]["counters"]["sidecar.test.marker"] == 7
+        assert "ts" in rec and "monotonic" in rec
+        assert "events_dropped" in rec
+    finally:
+        emitter.stop()
+        os.close(r)
+        os.close(w)
+
+
+def test_sigusr1_one_shot_dump(obs_enabled):
+    import json
+    import os
+    import signal
+
+    r, w = os.pipe()
+    emitter = sidecar.StatsEmitter(w, interval=60.0).start()
+    old = signal.getsignal(signal.SIGUSR1)
+    try:
+        assert sidecar._install_sigusr1(emitter)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        line = b""
+        while not line.endswith(b"\n"):
+            line += os.read(r, 65536)
+        rec = json.loads(line.decode())
+        assert "metrics" in rec and "counters" in rec["metrics"]
+    finally:
+        signal.signal(signal.SIGUSR1, old)
+        emitter.stop()
+        os.close(r)
+        os.close(w)
+
+
+def test_stdio_sidecar_stats_fd_emits_parseable_snapshots():
+    """ISSUE 3 acceptance: `sidecar --stats-fd` emits parseable JSON
+    snapshots — end-to-end through main(), over a real inherited fd."""
+    import json
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["DAT_DEVICE_HASH"] = "0"
+    r, w = os.pipe()
+    os.set_inheritable(w, True)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dat_replication_protocol_tpu.sidecar",
+         "--stdio", "--stats-fd", str(w), "--stats-interval", "0.2"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        cwd=repo_root, env=env, pass_fds=(w,), close_fds=True,
+    )
+    out, err = proc.communicate(SESSION_4, timeout=120)
+    os.close(w)
+    assert proc.returncode == 0, err.decode()
+    raw = b""
+    while True:
+        chunk = os.read(r, 65536)
+        if not chunk:
+            break
+        raw += chunk
+    os.close(r)
+    lines = [ln for ln in raw.decode().splitlines() if ln.strip()]
+    assert lines, "no stats snapshots emitted"
+    for ln in lines:
+        rec = json.loads(ln)  # every line parses independently
+        assert "metrics" in rec
+    # the final pre-exit snapshot carries the session's whole story
+    final = json.loads(lines[-1])["metrics"]["counters"]
+    assert final["sidecar.sessions"] == 1
+    assert final["decoder.digests"] == 2  # blob-0 + change-0
+    # the reply stream's own encode traffic is attributed too
+    assert final["encoder.changes"] == 2
