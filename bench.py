@@ -1253,6 +1253,33 @@ def _attach_metrics(res: dict) -> None:
     obs_metrics.REGISTRY.reset()
 
 
+def _export_config_trace(name: str, trace_dir) -> None:
+    """--trace artifact per config: the obs span/event rings exported
+    as one Chrome trace JSON (Perfetto-loadable) under
+    <trace_dir>/configs/<name>.trace.json, rings cleared after so each
+    artifact is that config's own story.  The rings only fill while
+    telemetry is on (--metrics / DAT_OBS) — frame spans and joined
+    jax-annotation spans alike — so without it the artifact is an
+    empty shell; pass --metrics alongside --trace for span content."""
+    if not trace_dir:
+        return
+    try:
+        from dat_replication_protocol_tpu.obs import events as obs_events
+        from dat_replication_protocol_tpu.obs import tracing as obs_tracing
+
+        try:
+            out = os.path.join(trace_dir, "configs", f"{name}.trace.json")
+            obs_tracing.export_chrome_trace(out)
+            log(f"bench: config {name} trace -> {out}")
+        finally:
+            # clear even when the export failed: a leftover ring would
+            # leak THIS config's spans into the next config's artifact
+            obs_tracing.SPANS.clear()
+            obs_events.EVENTS.clear()
+    except Exception as e:  # an unwritable dir must not blank the run
+        log(f"bench: config {name} trace export failed ({e})")
+
+
 def _emit() -> None:
     """Print the one JSON artifact line from whatever has completed.
 
@@ -1333,6 +1360,7 @@ def main() -> None:
             err_res = {"error": f"{type(e).__name__}: {e}"}
             _attach_metrics(err_res)  # partial-work attribution
             _state["configs"][name] = err_res
+        _export_config_trace(name, trace_dir)
 
     # configs 1, 2, 6 need no JAX: run them before any backend init so a
     # wedged/broken device stack cannot cost their numbers

@@ -10,10 +10,13 @@ dynamic name becomes a brand-new metric instead of an error.
 
 Flagged shapes (Python sources only):
 
-* a call to a registry factory or event emitter — ``counter(...)``,
-  ``gauge(...)``, ``histogram(...)``, ``emit(...)`` (bare, aliased
-  with leading underscores, or as an attribute like ``EVENTS.emit``) —
-  whose first argument is not a string literal;
+* a call to a registry factory, event emitter, or span opener —
+  ``counter(...)``, ``gauge(...)``, ``histogram(...)``, ``emit(...)``,
+  ``trace_span(...)``, ``trace_instant(...)`` (bare, aliased with
+  leading underscores, or as an attribute like ``EVENTS.emit``) —
+  whose first argument is not a string literal: span names carry the
+  SAME greppability contract as event names (ISSUE 4), since the
+  timeline CLI and trace viewers key on them;
 * a bare ``print(...)`` (no ``file=`` keyword, i.e. stdout) anywhere
   in the package: stdout belongs to the wire/CLI protocol, and
   diagnostics belong in the structured event log (:mod:`...obs.events`)
@@ -36,17 +39,21 @@ from typing import Iterator
 
 from ..engine import Finding, Project
 
-_TELEMETRY_FNS = {"counter", "gauge", "histogram", "emit"}
+_TELEMETRY_FNS = {"counter", "gauge", "histogram", "emit",
+                  "trace_span", "trace_instant"}
 # attribute-call receivers that denote the obs layer (normalized:
 # underscores stripped, lowercased) — `EVENTS.emit(...)`,
 # `obs_metrics.counter(...)`, `registry.histogram(...)`.  Unrelated
 # APIs sharing a method name (`handler.emit(record)`,
 # `np.histogram(data, bins)`) must NOT trip the rule.
 _TELEMETRY_RECEIVERS = {"events", "metrics", "obs", "obs_events",
-                        "obs_metrics", "registry", "reg"}
+                        "obs_metrics", "obs_tracing", "registry", "reg",
+                        "spans", "tracing"}
 # the obs plumbing itself: (parent dir, filename) pairs exempt from the
-# literal-name check
+# literal-name check (they forward `name` parameters by design; the
+# greppable sites are their callers)
 _PLUMBING = {("obs", "metrics.py"), ("obs", "events.py"),
+             ("obs", "tracing.py"), ("obs", "flight.py"),
              ("obs", "__init__.py")}
 
 

@@ -12,16 +12,30 @@ the layer must be importable and near-free in every process that
 touches the session stack, including the stripped CI image
 (PAPERS: *Simplicity Scales*).
 
-Two halves:
+Four parts:
 
 * :mod:`.metrics` — Counters / Gauges / Histograms in a process-global
   registry behind ONE hoisted enable gate (``OBS.on``): the disabled
   path at an instrumentation site is a single attribute load, the same
-  trick as ``_fastpath_gate``.
+  trick as ``_fastpath_gate``.  ``to_prom_text`` renders a snapshot in
+  Prometheus text exposition.
 * :mod:`.events` — a bounded-ring structured event log (monotonic ts +
   seq) with an optional fd/JSONL sink, for session *lifecycle*:
   connect, checkpoint, resume, backoff, replay, stall, truncation,
   ProtocolError.
+* :mod:`.tracing` — wire-offset-correlated spans (ISSUE 4): nestable
+  ``trace_span`` contexts, per-frame ``trace_instant`` tags keyed on
+  the byte offset each frame starts at, and Chrome trace-event export
+  with the JAX profiler annotations of :mod:`..utils.trace` joined in.
+* :mod:`.flight` — the flight recorder: on any structured
+  ProtocolError or reconnect exhaustion, an armed recorder atomically
+  dumps a post-mortem bundle (rings + registry + checkpoint + active
+  fault plans) for offline attribution.
+
+Offline CLI: ``python -m dat_replication_protocol_tpu.obs`` merges two
+peers' JSONL logs into one causally-ordered timeline (``timeline``),
+converts logs/bundles to Perfetto-loadable traces (``export-trace``),
+and pretty-prints bundles (``dump``).
 
 The fault injector (:mod:`..session.faults`) is the layer's
 correctness oracle: it emits ground-truth ``fault.*`` events for every
@@ -35,6 +49,7 @@ Catalog, schema, overhead budget: OBSERVABILITY.md.
 from __future__ import annotations
 
 from .events import EVENTS, EventLog, emit
+from .flight import FLIGHT, FlightRecorder, read_bundle
 from .metrics import (
     OBS,
     REGISTRY,
@@ -48,13 +63,27 @@ from .metrics import (
     gauge,
     histogram,
     snapshot,
+    to_prom_text,
+)
+from .tracing import (
+    SPANS,
+    SpanLog,
+    attach_jsonl_sink,
+    export_chrome_trace,
+    to_chrome_trace,
+    trace_instant,
+    trace_span,
 )
 
 __all__ = [
     "OBS",
     "REGISTRY",
     "EVENTS",
+    "SPANS",
+    "FLIGHT",
     "EventLog",
+    "SpanLog",
+    "FlightRecorder",
     "Counter",
     "Gauge",
     "Histogram",
@@ -63,7 +92,14 @@ __all__ = [
     "gauge",
     "histogram",
     "snapshot",
+    "to_prom_text",
     "emit",
     "enable",
     "disable",
+    "trace_span",
+    "trace_instant",
+    "to_chrome_trace",
+    "export_chrome_trace",
+    "attach_jsonl_sink",
+    "read_bundle",
 ]

@@ -1,0 +1,327 @@
+"""Offline telemetry CLI: causal timelines, trace export, bundle dumps.
+
+    python -m dat_replication_protocol_tpu.obs timeline SENDER.jsonl RECEIVER.jsonl
+    python -m dat_replication_protocol_tpu.obs export-trace LOG.jsonl|BUNDLE_DIR [-o OUT]
+    python -m dat_replication_protocol_tpu.obs dump BUNDLE_DIR [--json]
+
+``timeline`` merges two peers' JSONL event/span logs (written by
+``obs.tracing.attach_jsonl_sink`` / ``EVENTS.attach_sink``) into ONE
+causally-ordered timeline keyed on wire offset — the byte offset every
+frame starts at is the same number on both sides of the wire, so a
+receiver record at offset X provably happened after the sender record
+at X, with no clock synchronization at all.  While merging it audits
+the frame streams and flags:
+
+* ``gap``        — a hole in a peer's frame coverage (bytes never
+                   emitted / never dispatched);
+* ``reorder``    — frame offsets moving backwards in a peer's own
+                   emission order;
+* ``duplicate``  — overlapping frame coverage on one peer (the
+                   duplicate-delivery class resume must never produce);
+* ``peer-divergence`` — the two peers' total frame coverage disagrees.
+
+Exit code is 1 when any flag fires, 0 on a clean merge — a clean
+resumed session (drop, reconnect, replay) flags NOTHING: that is the
+timeline's conformance contract (tests/test_obs_timeline.py).
+
+``export-trace`` converts a JSONL log (or a flight bundle directory)
+into Chrome trace-event JSON, loadable in Perfetto.  ``dump`` renders
+a flight-recorder bundle (see obs/flight.py) for humans or, with
+``--json``, for tools.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from .flight import read_bundle
+from .tracing import export_chrome_trace
+
+# span names that tag one frame (or one native-dispatch run of frames)
+# with its wire start offset; "action" distinguishes the two roles
+FRAME_SPANS = {
+    "encoder.frame": "emit",
+    "decoder.frame": "dispatch",
+    "decoder.frame.run": "dispatch",
+}
+
+# event fields that carry a wire offset (used to slot non-frame records
+# onto the offset axis)
+_OFFSET_FIELDS = ("offset", "wire_offset", "at")
+
+
+def _load_jsonl(path: str) -> list[dict]:
+    records: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                records.append(json.loads(ln))
+            except json.JSONDecodeError:
+                # a torn FINAL line is expected when a sink latched
+                # dead mid-record; keep it visible but unkeyed
+                records.append({"_unparsed": ln})
+    return records
+
+
+def _frames(records: list[dict]) -> list[dict]:
+    """Extract frame records (offset, wire_len, frames, kind) in file
+    order — the per-peer audit unit."""
+    out = []
+    for i, r in enumerate(records):
+        name = r.get("span")
+        action = FRAME_SPANS.get(name)
+        if action is None:
+            continue
+        f = r.get("fields") or {}
+        off, wl = f.get("offset"), f.get("wire_len")
+        if off is None or wl is None:
+            continue
+        out.append({
+            "i": i, "seq": r.get("seq", i), "offset": off, "wire_len": wl,
+            "frames": f.get("frames", 1), "kind": f.get("kind"),
+            "action": action, "name": name,
+        })
+    return out
+
+
+def _audit_role(role: str, frames: list[dict]) -> list[dict]:
+    """Flag gaps / reorders / duplicates in ONE direction of one peer's
+    frame stream.  Callers split a file's records by action first: a
+    duplex peer (the sidecar mirrors its request-side dispatch tags AND
+    its reply-side emission tags into one log) carries two independent
+    wire streams whose offsets both start at 0 — auditing them as one
+    stream would flag a clean session."""
+    flags: list[dict] = []
+    prev_off: Optional[int] = None
+    for fr in frames:  # emission/dispatch order = file order
+        if prev_off is not None and fr["offset"] < prev_off:
+            flags.append({"flag": "reorder", "role": role,
+                          "offset": fr["offset"],
+                          "detail": f"frame at offset {fr['offset']} "
+                                    f"recorded after offset {prev_off}"})
+        prev_off = fr["offset"]
+    end: Optional[int] = None
+    for fr in sorted(frames, key=lambda fr: (fr["offset"], fr["i"])):
+        if end is not None:
+            if fr["offset"] < end:
+                flags.append({"flag": "duplicate", "role": role,
+                              "offset": fr["offset"],
+                              "detail": f"frame coverage at offset "
+                                        f"{fr['offset']} overlaps bytes "
+                                        f"already covered up to {end}"})
+            elif fr["offset"] > end:
+                flags.append({"flag": "gap", "role": role, "offset": end,
+                              "missing": fr["offset"] - end,
+                              "detail": f"{fr['offset'] - end} byte(s) of "
+                                        f"frame coverage missing at "
+                                        f"offset {end}"})
+        end = fr["offset"] + fr["wire_len"] if end is None else max(
+            end, fr["offset"] + fr["wire_len"])
+    return flags
+
+
+def _coverage(frames: list[dict]) -> tuple[int, int]:
+    """(covered bytes, end offset) of a peer's frame stream."""
+    total = sum(fr["wire_len"] for fr in frames)
+    endo = max((fr["offset"] + fr["wire_len"] for fr in frames), default=0)
+    return total, endo
+
+
+def _record_offset(rec: dict) -> Optional[int]:
+    f = rec.get("fields") or {}
+    for k in _OFFSET_FIELDS:
+        v = f.get(k)
+        if isinstance(v, (int, float)):
+            return int(v)
+    return None
+
+
+def _merge_timeline(sender: list[dict], receiver: list[dict]) -> list[dict]:
+    """One causally-ordered merged timeline: primary key is the wire
+    offset (sender-before-receiver at equal offsets — emission causes
+    dispatch); records without an offset of their own inherit the last
+    offset seen in their file, preserving their local order."""
+    rows: list[dict] = []
+    for rank, (role, records) in enumerate(
+            (("sender", sender), ("receiver", receiver))):
+        last = 0
+        for i, r in enumerate(records):
+            off = _record_offset(r)
+            keyed = off is not None
+            if off is None:
+                off = last
+            else:
+                last = off
+            rows.append({
+                "offset": off, "role": role, "i": i, "keyed": keyed,
+                "name": r.get("event") or r.get("span") or "?",
+                "kind": "event" if "event" in r else (
+                    "span" if "span" in r else "?"),
+                "fields": r.get("fields") or {},
+                "ts": r.get("ts"),
+                "rank": rank,
+            })
+    rows.sort(key=lambda w: (w["offset"], w["rank"], w["i"]))
+    return rows
+
+
+def cmd_timeline(args) -> int:
+    sender = _load_jsonl(args.sender)
+    receiver = _load_jsonl(args.receiver)
+    # split each peer's frames by direction: emissions and dispatches
+    # are separate wire streams (a duplex peer logs both)
+    s_by = {a: [f for f in _frames(sender) if f["action"] == a]
+            for a in ("emit", "dispatch")}
+    r_by = {a: [f for f in _frames(receiver) if f["action"] == a]
+            for a in ("emit", "dispatch")}
+    flags: list[dict] = []
+    for role, by in (("sender", s_by), ("receiver", r_by)):
+        for action, frames in by.items():
+            flags.extend(_audit_role(f"{role}:{action}", frames))
+    # cross-peer coverage: one check per wire direction, each side of
+    # the pair present — forward (sender emits, receiver dispatches)
+    # and, for duplex logs, reverse (receiver emits, sender dispatches)
+    for label, a, b in (("forward", s_by["emit"], r_by["dispatch"]),
+                        ("reverse", r_by["emit"], s_by["dispatch"])):
+        if not (a and b):
+            continue
+        (a_cov, a_end), (b_cov, b_end) = _coverage(a), _coverage(b)
+        if a_cov != b_cov or a_end != b_end:
+            flags.append({
+                "flag": "peer-divergence", "role": label,
+                "offset": min(a_end, b_end),
+                "detail": f"{label} wire: emitter covered {a_cov} byte(s) "
+                          f"ending at {a_end}, dispatcher {b_cov} ending "
+                          f"at {b_end}",
+            })
+    sf = s_by["emit"] + s_by["dispatch"]
+    rf = r_by["emit"] + r_by["dispatch"]
+    (s_cov, s_end), (r_cov, r_end) = _coverage(sf), _coverage(rf)
+    rows = _merge_timeline(sender, receiver)
+    if args.json:
+        print(json.dumps({
+            "flags": flags,
+            "sender": {"frames": len(sf), "covered": s_cov, "end": s_end},
+            "receiver": {"frames": len(rf), "covered": r_cov, "end": r_end},
+            "timeline": rows,
+        }))
+    else:
+        for w in rows:
+            mark = "@" if w["keyed"] else "~"
+            extra = ""
+            if w["fields"]:
+                extra = " " + " ".join(
+                    f"{k}={v}" for k, v in sorted(w["fields"].items()))
+            print(f"{mark}{w['offset']:<10} {w['role']:<8} {w['name']}{extra}")
+        print(f"-- sender: {len(sf)} frame record(s), {s_cov} byte(s) "
+              f"covered, end {s_end}")
+        print(f"-- receiver: {len(rf)} frame record(s), {r_cov} byte(s) "
+              f"covered, end {r_end}")
+        if flags:
+            for fl in flags:
+                print(f"FLAG {fl['flag']} [{fl['role']}] @{fl['offset']}: "
+                      f"{fl['detail']}")
+        else:
+            print("-- clean: no gaps, reorders, or duplicate deliveries")
+    return 1 if flags else 0
+
+
+def cmd_export_trace(args) -> int:
+    if os.path.isdir(args.log):
+        bundle = read_bundle(args.log)
+        spans, events = bundle["spans"], bundle["events"]
+        default_out = os.path.join(args.log, "trace.json")
+    else:
+        records = _load_jsonl(args.log)
+        spans = [r for r in records if "span" in r]
+        events = [r for r in records if "event" in r]
+        default_out = args.log + ".trace.json"
+    out = export_chrome_trace(args.out or default_out, spans, events)
+    with open(out, encoding="utf-8") as f:
+        n = len(json.load(f)["traceEvents"])
+    print(f"{out}: {n} trace event(s)")
+    return 0
+
+
+def cmd_dump(args) -> int:
+    bundle = read_bundle(args.bundle)
+    if args.json:
+        print(json.dumps(bundle))
+        return 0
+    man = bundle["manifest"]
+    print(f"bundle: {bundle['path']}")
+    print(f"reason: {man.get('reason')}  pid: {man.get('pid')}  "
+          f"ts: {man.get('ts')}")
+    err = man.get("error")
+    if err:
+        print(f"error: {err.get('type')}: {err.get('message')}")
+        print(f"  coordinates: frame={err.get('frame')} "
+              f"offset={err.get('offset')} cause={err.get('cause')}")
+    ckpt = man.get("checkpoint")
+    if ckpt:
+        print(f"checkpoint: {ckpt}")
+    for plan in man.get("fault_plans", []):
+        active = {k: v for k, v in plan.items()
+                  if v not in (None, 0, 0.0) or k == "seed"}
+        print(f"fault plan: {active}")
+    faults = [e for e in bundle["events"]
+              if str(e.get("event", "")).startswith("fault.")]
+    for e in faults:
+        print(f"injected: {e['event']} {e.get('fields')}")
+    print(f"events: {len(bundle['events'])} record(s) "
+          f"(dropped {man.get('events_dropped')}), "
+          f"spans: {len(bundle['spans'])} record(s) "
+          f"(dropped {man.get('spans_dropped')})")
+    counters = bundle["metrics"].get("counters", {})
+    nonzero = {k: v for k, v in sorted(counters.items()) if v}
+    print(f"counters (nonzero): {nonzero}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dat_replication_protocol_tpu.obs",
+        description="offline telemetry tools: causal timeline merge, "
+                    "Chrome trace export, flight-bundle dumps",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    tl = sub.add_parser(
+        "timeline",
+        help="merge sender+receiver JSONL logs into one causally-ordered "
+             "timeline keyed on wire offset; flag gaps/reorders/duplicates")
+    tl.add_argument("sender", help="the sending peer's JSONL event/span log")
+    tl.add_argument("receiver", help="the receiving peer's JSONL log")
+    tl.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    tl.set_defaults(fn=cmd_timeline)
+
+    ex = sub.add_parser(
+        "export-trace",
+        help="convert a JSONL log or a flight bundle into Chrome "
+             "trace-event JSON (Perfetto-loadable)")
+    ex.add_argument("log", help="JSONL log file, or a bundle directory")
+    ex.add_argument("-o", "--out", default=None,
+                    help="output path (default: <log>.trace.json)")
+    ex.set_defaults(fn=cmd_export_trace)
+
+    dp = sub.add_parser(
+        "dump", help="render a flight-recorder bundle directory")
+    dp.add_argument("bundle", help="bundle directory (see obs/flight.py)")
+    dp.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    dp.set_defaults(fn=cmd_dump)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

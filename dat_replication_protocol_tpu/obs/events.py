@@ -18,6 +18,16 @@ attach a dedicated fd for a live event stream.  (The sidecar's
 fd with the per-event sink, because two writers interleaving past
 PIPE_BUF would corrupt the one-object-per-line contract.)
 
+Sink discipline on non-blocking fds (ISSUE 4 satellite): a record is
+written whole or not at all.  ``EAGAIN`` before the first byte drops
+the record atomically and bumps ``sink_dropped``; ``EAGAIN`` after a
+partial write gets a short bounded retry to finish the line, and if
+the pipe stays full the sink latches dead (``sink_dropped`` counts the
+record) — appending any later record to a torn fragment would merge
+two lines and break the one-JSON-object-per-line contract.  A torn
+final line is the worst a consumer can ever see, and JSONL consumers
+discard an unterminated last line harmlessly.
+
 Emission is gated on the shared :data:`~.metrics.OBS` gate; hot-path
 call sites additionally guard with ``if _OBS.on:`` so the disabled
 path never builds the kwargs dict (see OBSERVABILITY.md's budget).
@@ -38,6 +48,10 @@ __all__ = ["EventLog", "EVENTS", "emit"]
 
 DEFAULT_CAPACITY = 1024
 
+# how long a torn record may retry on EAGAIN before the sink latches
+# dead — bounded: the emitter can sit on session hot paths
+_SINK_RETRY_S = 0.05
+
 
 class EventLog:
     """Bounded ring of structured events + optional JSONL sink."""
@@ -53,7 +67,10 @@ class EventLog:
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0
         self.dropped = 0  # records overwritten by ring wraparound
+        self.sink_dropped = 0  # records the sink dropped WHOLE (EAGAIN,
+        # dead fd, torn-line latch) — never half-counted, never half-written
         self._sink = None  # int fd, or object with write(str)
+        self._sink_dead = False  # a record tore on this sink: latched
 
     # -- emission -----------------------------------------------------------
 
@@ -66,50 +83,108 @@ class EventLog:
         """
         if not OBS.on:
             return
-        now = time.monotonic()
+        self._append({"seq": 0, "ts": time.monotonic(), "event": event,
+                      "fields": fields})
+
+    def _append(self, rec: dict) -> None:
+        """Ring + sink plumbing shared by events and spans (the span
+        ring in :mod:`.tracing` subclasses this log): assigns ``seq``
+        under the lock, appends with wraparound accounting, and mirrors
+        to the sink outside the ring lock."""
         with self._lock:
-            seq = self._seq
+            rec["seq"] = self._seq
             self._seq += 1
             if len(self._ring) == self._ring.maxlen:
                 self.dropped += 1
-            rec = {"seq": seq, "ts": now, "event": event, "fields": fields}
             self._ring.append(rec)
             sink = self._sink
+            dead = self._sink_dead
         if sink is not None:
             with self._sink_lock:
-                self._write_sink(sink, rec)
+                if dead or self._sink_dead:
+                    # latched after a torn line: the record is dropped
+                    # whole (and counted), never appended to the tear
+                    self.sink_dropped += 1
+                else:
+                    self._write_sink(sink, rec)
 
-    @staticmethod
-    def _write_sink(sink, rec: dict) -> None:
+    def _latch_dead(self, sink) -> None:
+        """Latch the dead flag ONLY if ``sink`` is still the attached
+        one: a concurrent attach_sink() swapped in a fresh sink whose
+        stream has no torn fragment — latching it would silently drop
+        every later record on a healthy fd.  (_append takes _lock and
+        _sink_lock sequentially, never nested, so taking _lock here
+        while holding _sink_lock cannot deadlock.)"""
+        with self._lock:
+            if self._sink is sink:
+                self._sink_dead = True
+
+    def _write_sink(self, sink, rec: dict) -> None:
+        """One record -> one JSONL line, whole or not at all (see the
+        module docstring's sink discipline).  Runs under _sink_lock."""
         line = json.dumps(rec, default=repr) + "\n"
-        try:
-            if isinstance(sink, int):
-                # write-all loop: a short write on a blocking fd must
-                # not truncate the record mid-line (the consumer parses
-                # one JSON object per line); a non-blocking fd's EAGAIN
-                # falls through to the best-effort swallow below
-                view = memoryview(line.encode("utf-8"))
-                while view:
-                    view = view[os.write(sink, view):]
-            else:
+        if not isinstance(sink, int):
+            try:
                 sink.write(line)
                 flush = getattr(sink, "flush", None)
                 if flush is not None:
                     flush()
+            except (OSError, ValueError):
+                # a dead sink must never take the session down; a
+                # file-object write is all-or-nothing at this layer
+                self.sink_dropped += 1
+            return
+        view = memoryview(line.encode("utf-8"))
+        total = len(view)
+        deadline = None
+        try:
+            while view:
+                try:
+                    n = os.write(sink, view)
+                except InterruptedError:
+                    continue  # EINTR: retry immediately
+                except BlockingIOError:
+                    if len(view) == total:
+                        # EAGAIN before the first byte: drop the whole
+                        # record atomically — half a line would corrupt
+                        # the JSONL stream for every later record
+                        self.sink_dropped += 1
+                        return
+                    # EAGAIN mid-record: a torn line is already on the
+                    # fd — bounded retry to finish it; if the pipe
+                    # stays full, latch the sink dead so nothing is
+                    # ever appended to the torn fragment
+                    now = time.monotonic()
+                    if deadline is None:
+                        deadline = now + _SINK_RETRY_S
+                    elif now >= deadline:
+                        self._latch_dead(sink)
+                        self.sink_dropped += 1
+                        return
+                    time.sleep(0.001)
+                    continue
+                view = view[n:]
         except (OSError, ValueError):
-            pass  # a dead sink must never take the session down
+            # hard error (EPIPE, EBADF): swallow — but if the record
+            # tore first, latch dead for the same torn-fragment reason
+            if len(view) != total:
+                self._latch_dead(sink)
+            self.sink_dropped += 1
 
     # -- sink management ----------------------------------------------------
 
     def attach_sink(self, sink) -> None:
         """Mirror every subsequent event as one JSON line to ``sink``
-        (an int file descriptor, or any object with ``write(str)``)."""
+        (an int file descriptor, or any object with ``write(str)``).
+        Re-attaching clears a previous sink's dead latch."""
         with self._lock:
             self._sink = sink
+            self._sink_dead = False
 
     def detach_sink(self) -> None:
         with self._lock:
             self._sink = None
+            self._sink_dead = False
 
     # -- inspection ---------------------------------------------------------
 
@@ -120,7 +195,7 @@ class EventLog:
             records = list(self._ring)
         if event is None:
             return records
-        return [r for r in records if r["event"] == event]
+        return [r for r in records if r.get("event") == event]
 
     def count(self, event: str) -> int:
         return len(self.events(event))
@@ -130,10 +205,13 @@ class EventLog:
         return records[-1] if records else None
 
     def clear(self) -> None:
-        """Drop retained records (seq keeps counting — per-test reset)."""
+        """Drop retained records (seq keeps counting — per-test reset).
+        The sink stays attached; a torn-line dead latch stays latched
+        (clearing the ring cannot un-tear the fd's last line)."""
         with self._lock:
             self._ring.clear()
             self.dropped = 0
+            self.sink_dropped = 0
 
 
 EVENTS = EventLog()

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import math
 import os
+import re
 import threading
 from typing import Optional, Sequence
 
@@ -47,6 +48,7 @@ __all__ = [
     "gauge",
     "histogram",
     "snapshot",
+    "to_prom_text",
     "enable",
     "disable",
 ]
@@ -332,3 +334,56 @@ def histogram(name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
 
 def snapshot() -> dict:
     return REGISTRY.snapshot()
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+_PROM_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Catalog name -> Prometheus metric name: dots become underscores,
+    everything namespaced under ``dat_`` (``decoder.blob.bytes`` ->
+    ``dat_decoder_blob_bytes``)."""
+    return "dat_" + _PROM_SANITIZE.sub("_", name)
+
+
+def _prom_num(v) -> str:
+    if isinstance(v, float):
+        if v != v:  # NaN
+            return "NaN"
+        if v in (float("inf"), float("-inf")):
+            return "+Inf" if v > 0 else "-Inf"
+        return repr(v)
+    return str(v)
+
+
+def to_prom_text(snap: Optional[dict] = None) -> str:
+    """Prometheus text-exposition (v0.0.4) rendering of a registry
+    snapshot (default: the live registry).  Counters and gauges map
+    directly; histograms emit CUMULATIVE ``_bucket{le=...}`` series
+    (the snapshot stores per-bucket counts) plus ``_sum``/``_count``,
+    with the implicit overflow bucket as ``le="+Inf"``.  The sidecar's
+    ``--stats-fd`` emitter renders this with ``--stats-format prom``."""
+    if snap is None:
+        snap = REGISTRY.snapshot()
+    lines: list[str] = []
+    for name, v in sorted(snap.get("counters", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_prom_num(v)}")
+    for name, v in sorted(snap.get("gauges", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_prom_num(v)}")
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for le, count in h["buckets"]:
+            cum += count
+            label = "+Inf" if le == "+inf" else _prom_num(float(le))
+            lines.append(f'{n}_bucket{{le="{label}"}} {cum}')
+        lines.append(f"{n}_sum {_prom_num(float(h['sum']))}")
+        lines.append(f"{n}_count {h['count']}")
+    return "\n".join(lines) + "\n"

@@ -32,10 +32,13 @@ from typing import Callable, Optional
 
 from .._fastpath_gate import fastpath_mod as _fastpath_mod
 from ..obs.events import emit as _emit
+from ..obs.flight import FLIGHT as _FLIGHT
 from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
+from ..obs.tracing import trace_instant as _trace_instant
 from ..wire.change_codec import Change, decode_change
 from ..wire.framing import MAX_HEADER_LEN, TYPE_BLOB, TYPE_CHANGE, TYPE_HEADER, ProtocolError
+from ..wire.framing import header_len as _header_len
 from ..wire.varint import decode_uvarint
 
 OnDone = Optional[Callable[[], None]]
@@ -214,6 +217,16 @@ class Decoder:
         self._missing = 0  # payload bytes still to consume
         self._payload_parts: list[bytes] | None = None  # change slow path
         self._current_blob: BlobReader | None = None
+        # wire-position cursor for causal tracing (obs/tracing.py):
+        # _parsed counts wire bytes the parser fully consumed (bytes
+        # holds ACCEPTED bytes, which includes unparsed overflow);
+        # _frame_start is the wire offset of the frame being parsed —
+        # the same number the sender's encoder tagged this frame with.
+        # Maintained unconditionally (trivial int adds) so the offsets
+        # stay coherent across mid-session gate flips; the bulk path
+        # tracks its own base and re-syncs _parsed when a run retires.
+        self._parsed = 0
+        self._frame_start = 0
 
         # flow control
         self._pending = 0
@@ -344,7 +357,7 @@ class Decoder:
             or self.finished
         )
 
-    def checkpoint(self):
+    def checkpoint(self, emit_event: bool = True):
         """Export this instant's session progress (resume support).
 
         Cheap and side-effect-free: a :class:`~.resume.SessionCheckpoint`
@@ -354,10 +367,15 @@ class Decoder:
         lives on in this object).  The frame/row/blob cursors and the
         backend digest state ride along for observability and structured
         error context.  See ROBUSTNESS.md.
+
+        ``emit_event=False`` skips the ``session.checkpoint`` telemetry
+        event: the flight recorder snapshots a checkpoint as bundle
+        CONTEXT, and recording that as a checkpoint event would skew
+        any analysis treating the event as "a resume point was taken".
         """
         from .resume import SessionCheckpoint
 
-        if _OBS.on:
+        if emit_event and _OBS.on:
             _emit("session.checkpoint", wire_offset=self.bytes,
                   frame=self._frames_delivered(), row=self.changes)
         blob = self._current_blob
@@ -410,17 +428,26 @@ class Decoder:
         raises carries the frame index and byte offset where parsing
         stood — the session-context half of the robustness contract
         (ROBUSTNESS.md), so operators see *where* a stream broke instead
-        of a bare message."""
-        if _OBS.on:
-            _M_DEC_ERRORS.inc()
-            _emit("protocol.error", frame=self._frames_delivered(),
-                  offset=self.bytes, message=message)
-        return ProtocolError(
+        of a bare message.
+
+        This is also the flight recorder's primary hook (obs/flight.py):
+        every decoder-side wire error funnels through here, so an armed
+        recorder dumps its post-mortem bundle BEFORE destroy() clears
+        the parser state the bundle narrates."""
+        err = ProtocolError(
             message,
             frame=self._frames_delivered(),
             offset=self.bytes,
             cause=cause,
         )
+        if _OBS.on:
+            _M_DEC_ERRORS.inc()
+            _emit("protocol.error", frame=err.frame, offset=err.offset,
+                  message=message)
+        if _FLIGHT.armed:
+            _FLIGHT.dump("protocol-error", error=err,
+                         checkpoint=self.checkpoint(emit_event=False))
+        return err
 
     # -- flow control --------------------------------------------------------
 
@@ -712,6 +739,9 @@ class Decoder:
                            voff, vlen)
         self._bulk = {
             "buf": buf,
+            # wire offset of buf[0]: the indexed buffer is exactly the
+            # unconsumed overflow, so it starts where parsing stood
+            "base": self._parsed,
             "starts": starts[:n].tolist(),
             "lens": lens[:n].tolist(),
             "ids": ids[:n].tolist(),
@@ -790,6 +820,12 @@ class Decoder:
                 start = starts[f]
                 flen = lens[f]
                 self._missing = flen
+                # the frame's wire start offset (starts[] points at the
+                # payload AFTER the id byte; back out the header) — the
+                # tracing tag both _deliver_change and the blob open
+                # read; unconditional so offsets stay coherent across
+                # gate flips mid-run
+                self._frame_start = st["base"] + start - _header_len(flen)
                 if type_id == TYPE_CHANGE:
                     if have_cols:
                         (cg, fr, to, ko, kl, so, sl, vo, vl) = rows_l[row]
@@ -886,6 +922,10 @@ class Decoder:
             st["f"] = f
             st["row"] = row
         self._bulk = None
+        # run retired: re-sync the wire-position cursor to the exact
+        # bytes the index covered (interim _blob_data/_change_data adds
+        # during the run were provisional; this SET is authoritative)
+        self._parsed = st["base"] + st["consumed"]
         tail = buf[st["consumed"]:]
         if len(tail):
             self._ov_appendleft(tail)
@@ -911,6 +951,7 @@ class Decoder:
         use_tap = type(self).__dict__.get("_bulk_payload_sink", False)
         collect = use_tap and self._payload_sink_active()
         row0 = st["row"]
+        f0 = f
         fp = _fastpath_mod()
         if fp is not None:
             if self._ack_board is None:
@@ -939,6 +980,19 @@ class Decoder:
                 self._state = TYPE_HEADER
                 if _OBS.on and st["row"] > row0:
                     _M_DEC_CHANGES.inc(st["row"] - row0)
+                    # one run-level tag for the whole C dispatch (the
+                    # native loop cannot tag per frame): covers the
+                    # contiguous wire range of the dispatched frames
+                    k = st["f"] - f0
+                    if k > 0:
+                        fs0, fl0 = st["starts"][f0], st["lens"][f0]
+                        last = f0 + k - 1
+                        off0 = st["base"] + fs0 - _header_len(fl0)
+                        end = st["base"] + st["starts"][last] \
+                            + st["lens"][last]
+                        _trace_instant("decoder.frame.run", offset=off0,
+                                       kind="change", frames=k,
+                                       wire_len=end - off0)
                 if use_tap:
                     self._note_change_payloads(sink, st["row"] - row0)
             if status == 2:
@@ -958,6 +1012,8 @@ class Decoder:
         row = st["row"]
         on_change = self._on_change
         lock = self._ack_lock
+        obs_on = _OBS.on  # hoisted: one load for the whole run
+        base = st["base"]
         mk = Change.__new__
         mka = _FastAck.__new__
         Ch = Change
@@ -984,6 +1040,12 @@ class Decoder:
                 row += 1
                 f += 1
                 self.changes += 1
+                if obs_on:
+                    fl = flens[f - 1]
+                    hl = _header_len(fl)
+                    _trace_instant("decoder.frame",
+                                   offset=base + fstarts[f - 1] - hl,
+                                   kind="change", wire_len=hl + fl)
                 if on_change is not None:
                     ack = mka(FA)
                     ack.dec = self
@@ -1034,6 +1096,11 @@ class Decoder:
             # varint terminated iff the *previous* byte had its MSB clear and
             # we now also hold the id byte.
             if len(self._header) >= 2 and not (self._header[-2] & 0x80):
+                hdr_len = len(self._header)
+                self._parsed += i
+                # this frame's wire start: where its first header byte
+                # was consumed (the causal key both peers share)
+                self._frame_start = self._parsed - hdr_len
                 try:
                     framed_len, _ = decode_uvarint(self._header)
                 except ValueError as e:  # e.g. varint exceeds 64 bits
@@ -1068,8 +1135,10 @@ class Decoder:
                     return None
                 return chunk[i:]
             if len(self._header) >= MAX_HEADER_LEN:
+                self._parsed += i
                 self.destroy(self._protocol_error("frame header too long"))
                 return None
+        self._parsed += n  # header still accumulating across chunks
         return None
 
     # -- change frames -------------------------------------------------------
@@ -1080,6 +1149,7 @@ class Decoder:
             # (reference: decode.js:217-227)
             payload = chunk[: self._missing]
             rest = chunk[self._missing :]
+            self._parsed += self._missing
             self._missing = 0
             try:
                 self._finish_change(payload)
@@ -1093,6 +1163,7 @@ class Decoder:
             self._payload_parts = []
         take = min(len(chunk), self._missing)
         self._payload_parts.append(bytes(chunk[:take]))
+        self._parsed += take
         self._missing -= take
         rest = chunk[take:]
         if self._missing == 0:
@@ -1125,6 +1196,10 @@ class Decoder:
         self.changes += 1
         if _OBS.on:
             _M_DEC_CHANGES.inc()
+            _trace_instant("decoder.frame", offset=self._frame_start,
+                           kind="change",
+                           wire_len=_header_len(len(payload))
+                           + len(payload))
         self._state = TYPE_HEADER
         if self._on_change is not None:
             # same deferred-arm ack as the bulk fast loop: a sync ack
@@ -1158,6 +1233,10 @@ class Decoder:
         self.blobs += 1
         if _OBS.on:
             _M_DEC_BLOBS.inc()
+            _trace_instant("decoder.frame", offset=self._frame_start,
+                           kind="blob",
+                           wire_len=_header_len(self._missing)
+                           + self._missing)
         latch = {"ended": False, "acked": False}
         blob._pending_latch = latch
 
@@ -1186,6 +1265,7 @@ class Decoder:
         blob = self._current_blob
         assert blob is not None
         take = min(len(chunk), self._missing)
+        self._parsed += take
         self._missing -= take
         # materialize ONCE; bytes are immutable, so every consumer —
         # the BlobReader and any _note_blob_bytes subscriber (digest

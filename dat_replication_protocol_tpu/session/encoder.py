@@ -32,8 +32,10 @@ from typing import Callable, Optional
 
 from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
+from ..obs.tracing import trace_instant as _trace_instant
 from ..wire.change_codec import Change, encode_change
-from ..wire.framing import TYPE_BLOB, TYPE_CHANGE, frame_header
+from ..wire.framing import TYPE_BLOB, TYPE_CHANGE, frame_header, \
+    frame_wire_len
 
 OnDone = Optional[Callable[[], None]]
 
@@ -79,6 +81,7 @@ class BlobWriter:
         self._parked: list[tuple[bytes, OnDone, float | None]] = []
         self._ended = False
         self._finished = False
+        self._tag_on_uncork = False  # corked blob: frame span deferred
         self.destroyed = False
 
     # -- public API ---------------------------------------------------------
@@ -165,6 +168,15 @@ class BlobWriter:
         if not self._corked:
             return
         self._corked = False
+        if self._tag_on_uncork:
+            self._tag_on_uncork = False
+            if _OBS.on:
+                # the first parked chunk is this blob's header: the
+                # encoder's byte count right now IS the frame's wire
+                # start offset
+                _trace_instant("encoder.frame", offset=self._encoder.bytes,
+                               kind="blob",
+                               wire_len=frame_wire_len(self.length))
         for data, cb, t0 in self._parked:
             self._encoder._parked_bytes -= len(data)
             if t0 is not None and _OBS.on:
@@ -273,9 +285,15 @@ class Encoder:
 
     def _frame_change(self, payload: bytes, on_flush: OnDone) -> bool:
         self.changes += 1
+        header = frame_header(len(payload), TYPE_CHANGE)
         if _OBS.on:
             _M_ENC_CHANGES.inc()
-        header = frame_header(len(payload), TYPE_CHANGE)
+            # causal key: self.bytes BEFORE the header push is the wire
+            # offset this frame starts at — the same number the peer's
+            # decoder computes for the same frame (obs/tracing.py)
+            _trace_instant("encoder.frame", offset=self.bytes,
+                           kind="change",
+                           wire_len=len(header) + len(payload))
         self._push(header, None)
         return self._push(payload, on_flush)
 
@@ -296,8 +314,16 @@ class Encoder:
         header = frame_header(length, TYPE_BLOB)
         if self._open_blobs:
             ws._cork()
+            # the parked header reaches the wire at uncork time — the
+            # frame's true wire offset is only known there (_uncork
+            # tags it via this flag)
+            ws._tag_on_uncork = True
             ws._park(header, None)
         else:
+            if _OBS.on:
+                _trace_instant("encoder.frame", offset=self.bytes,
+                               kind="blob",
+                               wire_len=len(header) + length)
             self._push(header, None)
         self._open_blobs.append(ws)
         return ws

@@ -39,6 +39,7 @@ import time
 from typing import Callable, Optional
 
 from ..obs.events import emit as _emit
+from ..obs.flight import FLIGHT as _FLIGHT
 from ..obs.metrics import OBS as _OBS, counter as _counter
 
 # Ground-truth telemetry: the injector records every fault it actually
@@ -151,6 +152,10 @@ class _FaultState:
         self._stalled = False
         self._dead = False
         self._truncated = False
+        # chaos ground truth rides in every post-mortem bundle: an armed
+        # flight recorder notes the plan (seed + fault coordinates) the
+        # moment a faulty connection comes up (no-op while disarmed)
+        _FLIGHT.note_plan(plan)
 
     def pre_read(self, n: int) -> tuple[Optional[int], float]:
         """(segment limit, sleep seconds) for the next read; limit None

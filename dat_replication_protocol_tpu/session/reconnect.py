@@ -26,8 +26,10 @@ import time
 from typing import Callable, Optional
 
 from ..obs.events import emit as _emit
+from ..obs.flight import FLIGHT as _FLIGHT
 from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
+from ..obs.tracing import trace_span as _trace_span
 from ..wire.framing import ProtocolError
 from .decoder import Decoder, DecoderDestroyedError
 from .faults import TransportFault
@@ -96,10 +98,13 @@ def retrying(fn: Callable[[], object], policy: BackoffPolicy,
         except retry_on as e:
             failures += 1
             if failures > policy.max_retries:
-                raise ProtocolError(
+                err = ProtocolError(
                     f"{describe} failed after {failures} attempt(s)",
                     cause=e,
-                ) from e
+                )
+                if _FLIGHT.armed:  # retry exhaustion is a post-mortem
+                    _FLIGHT.dump("retry-exhausted", error=err)
+                raise err from e
             policy.sleep_before(failures)
 
 
@@ -173,39 +178,47 @@ def run_resumable(
             # (TransportFault is itself a ConnectionError), and all of
             # it must land in the reconnect path, never escape raw.
             fault: Optional[OSError] = None
-            try:
-                reader = source(ckpt, failures)
-            except OSError as e:
-                fault = e
-            while fault is None:
+            # the attempt span brackets one connection's lifetime (open
+            # -> EOF/fault), keyed on the wire offset it resumed from —
+            # the exported trace shows each reconnect as its own span
+            with _trace_span("reconnect.attempt",
+                             attempt=stats["attempts"],
+                             offset=ckpt.wire_offset):
                 try:
-                    data = reader.read(chunk_size)
+                    reader = source(ckpt, failures)
                 except OSError as e:
                     fault = e
-                    break
-                if not data:
-                    if (expected_total is not None
-                            and decoder.bytes < expected_total):
-                        # silent truncation: the connection closed
-                        # cleanly short of the sender's declared length
-                        # — same recovery path as a drop
-                        if _OBS.on:
-                            _emit("session.truncated", at=decoder.bytes,
-                                  expected=expected_total)
-                        fault = TransportFault(
-                            f"truncated: clean EOF at byte "
-                            f"{decoder.bytes} of {expected_total}",
-                            offset=decoder.bytes)
-                    break
-                wake.clear()
-                try:
-                    consumed = decoder.write(data)
-                except DecoderDestroyedError:
-                    raise _wire_error(errors, decoder.checkpoint())
-                if decoder.destroyed:
-                    raise _wire_error(errors, decoder.checkpoint())
-                if not consumed:
-                    _wait_writable(decoder, wake, wait_step, stall_timeout)
+                while fault is None:
+                    try:
+                        data = reader.read(chunk_size)
+                    except OSError as e:
+                        fault = e
+                        break
+                    if not data:
+                        if (expected_total is not None
+                                and decoder.bytes < expected_total):
+                            # silent truncation: the connection closed
+                            # cleanly short of the sender's declared
+                            # length — same recovery path as a drop
+                            if _OBS.on:
+                                _emit("session.truncated",
+                                      at=decoder.bytes,
+                                      expected=expected_total)
+                            fault = TransportFault(
+                                f"truncated: clean EOF at byte "
+                                f"{decoder.bytes} of {expected_total}",
+                                offset=decoder.bytes)
+                        break
+                    wake.clear()
+                    try:
+                        consumed = decoder.write(data)
+                    except DecoderDestroyedError:
+                        raise _wire_error(errors, decoder.checkpoint())
+                    if decoder.destroyed:
+                        raise _wire_error(errors, decoder.checkpoint())
+                    if not consumed:
+                        _wait_writable(decoder, wake, wait_step,
+                                       stall_timeout)
             if fault is not None:
                 failures += 1
                 stats["faults"].append(str(fault))
@@ -237,7 +250,27 @@ def run_resumable(
                 _emit("session.complete", bytes=decoder.bytes,
                       reconnects=stats["reconnects"],
                       attempts=stats["attempts"])
+            if stats["faults"] and _FLIGHT.armed:
+                # the session survived its turbulence, but the faults
+                # still deserve a post-mortem: an armed recorder keeps
+                # a bundle per recovered incident, so chaos coordinates
+                # stay attributable offline even when nothing failed.
+                # routine=True: recovered dumps draw from the half of
+                # the budget NOT reserved for genuine failures
+                _FLIGHT.dump(
+                    "recovered",
+                    checkpoint=decoder.checkpoint(emit_event=False),
+                    extra={"stats": dict(stats)}, routine=True)
             return stats
+    except ProtocolError as e:
+        # terminal failure (exhaustion, stall, wire error, resume-window
+        # miss): ONE bundle for the incident — the decoder's own wire
+        # errors were already dumped with this very object, and the
+        # recorder dedups on error identity, so this cannot double-dump
+        if _FLIGHT.armed:
+            _FLIGHT.dump("session-failed", error=e,
+                         checkpoint=decoder.checkpoint(emit_event=False))
+        raise
     finally:
         decoder._remove_drain_watcher(wake.set)
         # symmetric cleanup: a long-lived decoder driven through this

@@ -40,9 +40,12 @@ import threading
 import time
 
 from .obs import events as obs_events
+from .obs import flight as obs_flight
 from .obs import metrics as obs_metrics
+from .obs import tracing as obs_tracing
 from .obs.events import emit as _emit
 from .obs.metrics import OBS as _OBS, counter as _counter
+from .obs.tracing import trace_span as _trace_span
 from .session.transport import recv_over, send_over
 
 DIGEST_SUBSET_CHANGE = "digest:change"
@@ -175,7 +178,10 @@ def run_session(read_bytes, write_bytes, close_write=None,
                               daemon=True)
     sender.start()
     try:
-        recv_over(dec, read_bytes)
+        # span brackets the request-consumption phase; the per-frame
+        # wire-offset instants the decoder records nest under it
+        with _trace_span("sidecar.session.recv"):
+            recv_over(dec, read_bytes)
     except Exception as e:  # ECONNRESET etc.: transport died mid-read
         if not dec.destroyed:
             dec.destroy(e)
@@ -328,18 +334,25 @@ def serve_tcp(host: str, port: int,
 
 
 class StatsEmitter:
-    """Periodic registry snapshots as JSON lines on a file descriptor.
+    """Periodic registry snapshots on a file descriptor.
 
-    The ``--stats-fd`` machinery: a daemon thread dumps one line every
-    ``interval`` seconds; :meth:`kick` forces an immediate dump (the
-    SIGUSR1 one-shot — the handler just sets an event, so the dump work
-    never runs in signal context).  Lines are self-contained JSON
-    objects (see OBSERVABILITY.md for the schema), so a supervisor can
-    ``tail -f`` the pipe and parse each line independently.
+    The ``--stats-fd`` machinery: a daemon thread dumps one snapshot
+    every ``interval`` seconds; :meth:`kick` forces an immediate dump
+    (the SIGUSR1 one-shot — the handler just sets an event, so the dump
+    work never runs in signal context).  ``fmt="json"`` (default)
+    writes self-contained JSON lines, so a supervisor can ``tail -f``
+    the pipe and parse each line independently; ``fmt="prom"``
+    (``--stats-format prom``) writes Prometheus text-exposition blocks
+    (``obs.metrics.to_prom_text``) instead — each dump is one complete
+    scrape body, for a node-exporter-style textfile collector.
     """
 
-    def __init__(self, fd: int, interval: float = DEFAULT_STATS_INTERVAL):
+    def __init__(self, fd: int, interval: float = DEFAULT_STATS_INTERVAL,
+                 fmt: str = "json"):
+        if fmt not in ("json", "prom"):
+            raise ValueError(f"unknown stats format {fmt!r}")
         self._fd = fd
+        self._fmt = fmt
         self._interval = interval
         self._wake = threading.Event()
         self._stopped = False
@@ -377,7 +390,11 @@ class StatsEmitter:
 
         if self._dead:
             return False
-        line = (json.dumps(snapshot_stats()) + "\n").encode("utf-8")
+        if self._fmt == "prom":
+            body = snapshot_stats_prom()
+        else:
+            body = json.dumps(snapshot_stats()) + "\n"
+        line = body.encode("utf-8")
         view = memoryview(line)
         deadline = time.monotonic() + 2.0
         while view:
@@ -418,6 +435,20 @@ def snapshot_stats() -> dict:
         "metrics": obs_metrics.snapshot(),
         "events_dropped": obs_events.EVENTS.dropped,
     }
+
+
+def snapshot_stats_prom() -> str:
+    """The same stats record in Prometheus text exposition: the
+    registry via ``to_prom_text`` plus ring-health gauges."""
+    extra = (
+        "# TYPE dat_obs_events_dropped gauge\n"
+        f"dat_obs_events_dropped {obs_events.EVENTS.dropped}\n"
+        "# TYPE dat_obs_spans_dropped gauge\n"
+        f"dat_obs_spans_dropped {obs_tracing.SPANS.dropped}\n"
+        "# TYPE dat_obs_scrape_ts gauge\n"
+        f"dat_obs_scrape_ts {time.time()}\n"
+    )
+    return obs_metrics.to_prom_text() + extra
 
 
 def _install_sigusr1(emitter: StatsEmitter) -> bool:
@@ -472,6 +503,23 @@ def main(argv=None) -> int:
                    default=DEFAULT_STATS_INTERVAL, metavar="SECONDS",
                    help="period between --stats-fd snapshots "
                         f"(default: {DEFAULT_STATS_INTERVAL:.0f})")
+    p.add_argument("--stats-format", choices=("json", "prom"),
+                   default="json",
+                   help="--stats-fd output format: self-contained JSON "
+                        "lines (default) or Prometheus text exposition "
+                        "blocks (obs.metrics.to_prom_text)")
+    p.add_argument("--flight-dir", metavar="DIR", default=None,
+                   help="arm the flight recorder: on any protocol error "
+                        "or retry exhaustion, dump an atomic post-mortem "
+                        "bundle (event/span rings, metrics, checkpoint) "
+                        "into DIR for offline attribution (enables "
+                        "telemetry; see OBSERVABILITY.md)")
+    p.add_argument("--trace-jsonl", metavar="PATH", default=None,
+                   help="enable telemetry and mirror every event AND "
+                        "wire-offset span as JSONL into PATH — the "
+                        "per-peer log `python -m "
+                        "dat_replication_protocol_tpu.obs timeline` "
+                        "merges")
     args = p.parse_args(argv)
     drain = args.drain_timeout if args.drain_timeout > 0 else None
     from .session.reconnect import BackoffPolicy
@@ -479,9 +527,17 @@ def main(argv=None) -> int:
     policy = BackoffPolicy(base=args.backoff_base,
                            max_retries=args.max_retries)
     emitter = None
+    trace_sink = None
+    if args.flight_dir:
+        # arming enables telemetry: a dark ring has nothing to dump
+        obs_flight.FLIGHT.arm(args.flight_dir)
+    if args.trace_jsonl:
+        obs_metrics.enable()
+        trace_sink = obs_tracing.attach_jsonl_sink(args.trace_jsonl)
     if args.stats_fd is not None:
         obs_metrics.enable()  # --stats-fd IS the telemetry opt-in
-        emitter = StatsEmitter(args.stats_fd, args.stats_interval).start()
+        emitter = StatsEmitter(args.stats_fd, args.stats_interval,
+                               fmt=args.stats_format).start()
         _install_sigusr1(emitter)
     if args.backend == "host":
         os.environ["DAT_DEVICE_HASH"] = "0"  # routing-layer override:
@@ -502,6 +558,10 @@ def main(argv=None) -> int:
             # contract (an emitter still blocked on a never-drained
             # pipe keeps sole ownership of the fd instead)
             emitter.dump_once()
+        if trace_sink is not None:
+            obs_events.EVENTS.detach_sink()
+            obs_tracing.SPANS.detach_sink()
+            trace_sink.close()
 
 
 if __name__ == "__main__":

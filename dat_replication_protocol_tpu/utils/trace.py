@@ -17,11 +17,25 @@ every dispatch; this module is that hook.
 
 JAX is imported lazily: the session layer must stay importable (and
 fast) in processes that never touch a device.
+
+When the obs gate is on, :func:`span` ALSO records into the obs span
+ring (``obs.tracing.SPANS``, field ``src="jax"``) so device-dispatch
+phases appear in the exported Chrome trace next to the wire-offset
+frame spans — one timeline for host wire work and device work
+(ISSUE 4).  With the gate off, behavior is byte-identical to before:
+the bound factory is returned directly.
 """
+# datlint: disable-file=obs-discipline  — this module IS span plumbing:
+# it forwards caller-supplied span names into jax.profiler and the obs
+# span ring by design; its callers are the greppable sites.
 
 from __future__ import annotations
 
 import contextlib
+import sys
+
+from ..obs import tracing as _obs_tracing
+from ..obs.metrics import OBS as _OBS
 
 
 class _NullSpan:
@@ -60,11 +74,45 @@ def _reset_span_binding_for_tests() -> None:
     _span_factory = None
 
 
+class _JoinedSpan:
+    """jax TraceAnnotation + an obs span record of the same name, so
+    device-phase annotations land in the exported Chrome trace next to
+    the wire-offset spans (``src="jax"`` distinguishes them)."""
+
+    __slots__ = ("_span", "_inner")
+
+    def __init__(self, name: str, inner):
+        self._span = _obs_tracing.trace_span(name, src="jax")
+        self._inner = inner
+
+    def __enter__(self):
+        self._span.__enter__()
+        try:
+            self._inner.__enter__()
+        except BaseException:
+            # unwind the obs span: a raising jax annotation means the
+            # with-statement never runs __exit__, and an unpopped id
+            # would corrupt the thread's span-parent stack for good
+            self._span.__exit__(*sys.exc_info())
+            raise
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            return self._inner.__exit__(*exc) or False
+        finally:
+            self._span.__exit__(*exc)
+
+
 def span(name: str):
-    """Named profiler annotation; inert if jax is unavailable."""
+    """Named profiler annotation; inert if jax is unavailable.  With
+    the obs gate on, the span is additionally recorded into the obs
+    span ring (see module docstring)."""
     factory = _span_factory
     if factory is None:
         factory = _bind_span_factory()
+    if _OBS.on:
+        return _JoinedSpan(name, factory(name))
     return factory(name)
 
 

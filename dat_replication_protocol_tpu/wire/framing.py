@@ -44,6 +44,27 @@ def frame(type_id: int, payload: bytes) -> bytes:
     return frame_header(len(payload), type_id) + payload
 
 
+def header_len(payload_len: int) -> int:
+    """Byte length of ``frame_header(payload_len, ·)``: the varint of
+    ``payload_len + 1`` plus the id byte.  The tracing layer uses this
+    to recover a frame's wire START offset (and total wire length) from
+    its payload length alone — both peers must compute the same number,
+    so it lives here next to the encoder it mirrors."""
+    if payload_len < 127:
+        return 2
+    v = payload_len + 1
+    n = 1
+    while v >= 0x80:
+        v >>= 7
+        n += 1
+    return n + 1
+
+
+def frame_wire_len(payload_len: int) -> int:
+    """Total wire bytes of a frame with ``payload_len`` payload bytes."""
+    return header_len(payload_len) + payload_len
+
+
 class ProtocolError(Exception):
     """Raised (and passed to destroy) on malformed wire data.
 

@@ -45,14 +45,18 @@ import pytest  # noqa: E402
 
 @pytest.fixture
 def obs_enabled():
-    """Enable the obs gate for one test with clean metric values and an
-    empty event ring, restoring the prior gate state afterwards — the
-    registry is process-global, so isolation is explicit."""
-    from dat_replication_protocol_tpu.obs import events, metrics
+    """Enable the obs gate for one test with clean metric values, an
+    empty event ring, an empty span ring, and a disarmed flight
+    recorder, restoring the prior gate state afterwards — all four are
+    process-global, so isolation is explicit."""
+    from dat_replication_protocol_tpu.obs import events, flight, metrics, \
+        tracing
 
     was_on = metrics.OBS.on
     metrics.REGISTRY.reset()
     events.EVENTS.clear()
+    tracing.SPANS.clear()
+    flight.FLIGHT._reset_for_tests()
     metrics.enable()
     try:
         yield metrics
@@ -60,3 +64,7 @@ def obs_enabled():
         metrics.OBS.on = was_on
         metrics.REGISTRY.reset()
         events.EVENTS.clear()
+        events.EVENTS.detach_sink()
+        tracing.SPANS.clear()
+        tracing.SPANS.detach_sink()
+        flight.FLIGHT._reset_for_tests()

@@ -551,6 +551,47 @@ def test_obs_discipline_suppression(tmp_path):
     assert "obs-discipline" not in _rules_fired(findings)
 
 
+def test_obs_discipline_covers_trace_span_sites(tmp_path):
+    # ISSUE 4 satellite: span names carry the same literal-name contract
+    # as event names — the timeline CLI and trace viewers key on them
+    findings = _lint(tmp_path, ("sp.py", '''
+        def f(trace_span, trace_instant, phase):
+            with trace_span(phase):
+                trace_instant("decoder." + phase, offset=0)
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 2
+
+
+def test_obs_discipline_clean_on_literal_span_names(tmp_path):
+    assert _lint(tmp_path, ("spok.py", '''
+        def f(trace_span, trace_instant):
+            with trace_span("reconnect.attempt", attempt=1):
+                trace_instant("decoder.frame", offset=0)
+    ''')) == []
+
+
+def test_obs_discipline_matches_tracing_receiver_aliases(tmp_path):
+    # the package idiom: `from ..obs import tracing as _obs_tracing`
+    findings = _lint(tmp_path, ("recv.py", '''
+        def f(_obs_tracing, tracing, name):
+            _obs_tracing.trace_span(name)
+            tracing.trace_instant(name, offset=1)
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 2
+
+
+def test_obs_discipline_exempts_the_span_plumbing_itself(tmp_path):
+    # obs/tracing.py and obs/flight.py forward name params by design
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    (obs_dir / "tracing.py").write_text(textwrap.dedent('''
+        def trace_span(name, **fields):
+            return _make(name, fields)
+    '''))
+    findings = run_paths([tmp_path])
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
 def test_obs_discipline_ignores_unrelated_emit_and_histogram_apis(tmp_path):
     # same method NAMES on non-telemetry receivers: logging handlers,
     # sockets, numpy — none of these touch the obs registry

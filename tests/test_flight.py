@@ -1,0 +1,327 @@
+"""Flight recorder (ISSUE 4 tentpole): atomic post-mortem bundles, and
+the chaos-sweep attribution oracle — for every seed in the 20-seed
+``FaultPlan.for_sweep`` run, the injected fault's coordinates (kind,
+wire offset) must be recoverable from the flight bundle ALONE: the
+assertions below read nothing but the files inside the bundle
+directory.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.obs import flight
+from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+from dat_replication_protocol_tpu.session.faults import (
+    FaultPlan,
+    FaultyReader,
+    bytes_reader,
+)
+from dat_replication_protocol_tpu.session.reconnect import (
+    BackoffPolicy,
+    retrying,
+    run_resumable,
+)
+from dat_replication_protocol_tpu.session.resume import WireJournal
+from dat_replication_protocol_tpu.wire.framing import ProtocolError
+
+FLIGHT = flight.FLIGHT
+
+
+def _build_wire() -> bytes:
+    e = protocol.encode()
+    j = WireJournal()
+    e.attach_journal(j)
+    for i in range(24):
+        e.change({"key": f"bulk-{i}", "change": i, "from": i, "to": i + 1,
+                  "value": b"v%03d" % i})
+    big = e.blob(3000)
+    big.write(b"x" * 1700)
+    e.change({"key": "parked", "change": 99, "from": 0, "to": 1,
+              "value": b"after-blob"})
+    big.end(b"y" * 1300)
+    for i in range(8):
+        e.change({"key": f"tail-{i}", "change": i, "from": i, "to": i + 1})
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    return j.read_from(0)
+
+
+_WIRE = _build_wire()
+
+
+def _plan_kind(plan: FaultPlan) -> str | None:
+    if plan.drop_at is not None:
+        return "drop"
+    if plan.truncate_at is not None:
+        return "truncate"
+    if plan.stall_at is not None:
+        return "stall"
+    if plan.max_segment == 1:
+        return "reseg"
+    return None
+
+
+def _run_sweep_seed(seed: int):
+    """One conformance-sweep seed under an armed recorder; returns the
+    ground-truth plans + per-connection start offsets."""
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+    dec.blob(lambda b, done: b.collect(lambda _d: done()))
+    plans: list[FaultPlan] = []
+    offsets: list[int] = []
+
+    def source(ckpt, failures):
+        offsets.append(ckpt.wire_offset)
+        replay = _WIRE[ckpt.wire_offset:]
+        plan = FaultPlan.for_sweep(seed, len(replay), attempt=failures)
+        plans.append(plan)
+        return FaultyReader(bytes_reader(replay), plan)
+
+    stats = run_resumable(
+        source, dec,
+        BackoffPolicy(base=0.0005, cap=0.005, max_retries=8, seed=seed,
+                      sleep=lambda _d: None),
+        chunk_size=1024, expected_total=len(_WIRE), stall_timeout=15)
+    assert dec.finished and dec.changes == 33
+    return stats, plans, offsets
+
+
+def test_sweep_every_fault_attributable_from_bundle_alone(
+        obs_enabled, tmp_path):
+    """The acceptance criterion: 20 seeds, each fault's (kind, wire
+    offset) recovered from the bundle files alone."""
+    kinds_seen: set[str] = set()
+    for seed in range(20):
+        obs_metrics.REGISTRY.reset()
+        from dat_replication_protocol_tpu.obs.events import EVENTS
+        from dat_replication_protocol_tpu.obs.tracing import SPANS
+
+        EVENTS.clear()
+        SPANS.clear()
+        FLIGHT._reset_for_tests()
+        FLIGHT.arm(str(tmp_path / f"seed-{seed}"), enable_telemetry=False)
+        stats, plans, offsets = _run_sweep_seed(seed)
+        if FLIGHT.last_bundle is None:
+            # a seed whose faults are all absorbed without a transport
+            # fault (reseg/stall class) leaves no automatic incident
+            # bundle — the operator's explicit dump is the same bundle
+            flight.dump("sweep-complete")
+        bundle = flight.read_bundle(FLIGHT.last_bundle)
+        events = bundle["events"]
+        counters = bundle["metrics"]["counters"]
+        recorded_plans = bundle["manifest"]["fault_plans"]
+        ctx = f"seed {seed}"
+        for plan, conn_off in zip(plans, offsets):
+            kind = _plan_kind(plan)
+            if kind is None:
+                continue
+            kinds_seen.add(kind)
+            # the plan itself (seed + coordinates) rides in the manifest
+            assert any(p["seed"] == plan.seed for p in recorded_plans), ctx
+            if kind == "drop":
+                # absolute wire offset = connection start + plan offset
+                want = conn_off + plan.drop_at
+                assert any(e.get("event") == "fault.drop"
+                           and conn_off + e["fields"]["offset"] == want
+                           for e in events), ctx
+            elif kind == "truncate":
+                want = conn_off + plan.truncate_at
+                assert any(e.get("event") == "fault.truncate"
+                           and conn_off + e["fields"]["offset"] == want
+                           for e in events), ctx
+            elif kind == "stall":
+                assert any(e.get("event") == "fault.stall"
+                           and e["fields"]["seconds"] == plan.stall_s
+                           for e in events), ctx
+            elif kind == "reseg":
+                assert counters.get(
+                    "fault.injected.reseg_segments", 0) > 0, ctx
+        # the bundle's session narrative agrees with the driver
+        assert sum(1 for e in events
+                   if e.get("event") == "reconnect.fault") == len(
+                       stats["faults"]), ctx
+    assert kinds_seen == {"drop", "truncate", "stall", "reseg"}, kinds_seen
+
+
+def test_recovered_session_dumps_incident_bundle(obs_enabled, tmp_path):
+    FLIGHT.arm(str(tmp_path))
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+    dec.blob(lambda b, done: b.collect(lambda _d: done()))
+
+    def source(ckpt, failures):
+        plan = FaultPlan(seed=failures,
+                         drop_at=(50 if failures == 0 else None))
+        return FaultyReader(bytes_reader(_WIRE[ckpt.wire_offset:]), plan)
+
+    stats = run_resumable(source, dec,
+                          BackoffPolicy(base=0, max_retries=3, seed=0),
+                          expected_total=len(_WIRE))
+    assert stats["reconnects"] == 1
+    names = sorted(os.listdir(tmp_path))
+    assert len(names) == 1 and "recovered" in names[0]
+    assert not any(n.startswith(".tmp") for n in names)  # atomic rename
+    b = flight.read_bundle(os.path.join(tmp_path, names[0]))
+    assert b["manifest"]["extra"]["stats"]["reconnects"] == 1
+    assert any(e.get("event") == "fault.drop"
+               and e["fields"]["offset"] == 50 for e in b["events"])
+
+
+def test_reconnect_exhaustion_dumps_bundle_with_checkpoint(
+        obs_enabled, tmp_path):
+    FLIGHT.arm(str(tmp_path))
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+
+    def source(ckpt, failures):
+        return FaultyReader(bytes_reader(_WIRE[ckpt.wire_offset:]),
+                            FaultPlan(seed=0, drop_at=10))
+
+    with pytest.raises(ProtocolError) as ei:
+        run_resumable(source, dec,
+                      BackoffPolicy(base=0, max_retries=1, seed=0),
+                      expected_total=len(_WIRE))
+    names = os.listdir(tmp_path)
+    assert len(names) == 1 and "session-failed" in names[0]
+    b = flight.read_bundle(os.path.join(tmp_path, names[0]))
+    err = b["manifest"]["error"]
+    assert err["type"] == "ProtocolError"
+    assert err["offset"] == ei.value.offset
+    assert err["frame"] == ei.value.frame
+    # the checkpoint a resume WOULD have used rides along
+    assert b["manifest"]["checkpoint"]["wire_offset"] == dec.bytes
+
+
+def test_protocol_error_dumps_one_bundle_despite_reraise(
+        obs_enabled, tmp_path):
+    """The decoder dumps at _protocol_error; run_resumable re-raises
+    the SAME object — identity dedup keeps it to one bundle."""
+    FLIGHT.arm(str(tmp_path))
+    dec = protocol.decode()
+
+    def source(ckpt, failures):
+        return FaultyReader(bytes_reader(_WIRE[ckpt.wire_offset:]),
+                            FaultPlan(seed=0, flip_at=1, flip_mask=0x44))
+
+    with pytest.raises(ProtocolError):
+        run_resumable(source, dec,
+                      BackoffPolicy(base=0, max_retries=1, seed=0),
+                      expected_total=len(_WIRE))
+    names = os.listdir(tmp_path)
+    assert len(names) == 1 and "protocol-error" in names[0], names
+    assert FLIGHT.suppressed >= 1
+    b = flight.read_bundle(os.path.join(tmp_path, names[0]))
+    # the flip is in the bundle's events; the error coordinates are in
+    # its manifest — attribution needs nothing else
+    assert any(e.get("event") == "fault.flip" for e in b["events"])
+    assert b["manifest"]["error"]["offset"] is not None
+
+
+def test_retrying_exhaustion_dumps_bundle(obs_enabled, tmp_path):
+    FLIGHT.arm(str(tmp_path))
+
+    def always_fails():
+        raise OSError("bind refused")
+
+    with pytest.raises(ProtocolError):
+        retrying(always_fails, BackoffPolicy(base=0, max_retries=1, seed=0),
+                 describe="bind")
+    names = os.listdir(tmp_path)
+    assert len(names) == 1 and "retry-exhausted" in names[0]
+    b = flight.read_bundle(os.path.join(tmp_path, names[0]))
+    assert "bind" in b["manifest"]["error"]["message"]
+
+
+def test_bundle_budget_bounds_an_error_storm(obs_enabled, tmp_path):
+    FLIGHT.arm(str(tmp_path), max_bundles=2)
+    for i in range(5):
+        dec = protocol.decode()
+        dec.on_error(lambda _e: None)
+        dec.write(b"\x05\x09zzzz")  # unknown type id 9 -> destroy
+        assert dec.destroyed
+    names = [n for n in os.listdir(tmp_path) if not n.startswith(".")]
+    assert len(names) == 2
+    assert FLIGHT.suppressed == 3
+
+
+def test_routine_dumps_cannot_starve_failure_bundles(obs_enabled, tmp_path):
+    """Recovered-session dumps are routine: capped at half the budget,
+    so a long-lived process absorbing transient faults always has
+    bundles left for a genuine failure's post-mortem."""
+    FLIGHT.arm(str(tmp_path), max_bundles=4)
+    for i in range(5):
+        flight.dump("recovered", routine=True)
+    names = [n for n in os.listdir(tmp_path) if not n.startswith(".")]
+    assert len(names) == 2  # half of 4
+    # a failure dump still lands
+    assert flight.dump("session-failed",
+                       error=ProtocolError("boom", offset=1)) is not None
+    assert len([n for n in os.listdir(tmp_path)
+                if "session-failed" in n]) == 1
+
+
+def test_rearming_resets_the_dump_budget_and_dedup(obs_enabled, tmp_path):
+    """arm() is a fresh capture: a recorder that spent its budget (or
+    bundled an error) must not stay silently suppressed after re-arm."""
+    FLIGHT.arm(str(tmp_path / "a"), max_bundles=1)
+    err = None
+    dec = protocol.decode()
+    dec.on_error(lambda e: None)
+    dec.write(b"\x05\x09zzzz")
+    assert flight.dump("over-budget") is None  # budget of 1 is spent
+    assert FLIGHT.suppressed == 1
+    FLIGHT.arm(str(tmp_path / "b"), max_bundles=1)
+    assert FLIGHT.suppressed == 0
+    assert flight.dump("fresh-capture") is not None
+    assert os.listdir(tmp_path / "b")
+    assert err is None
+
+
+def test_rearming_the_same_directory_never_collides_bundle_names(
+        obs_enabled, tmp_path):
+    """Bundle names carry a per-arm capture generation: re-arming into
+    the SAME directory must not collide with (and silently lose) a new
+    incident whose (seq, reason) repeats a previous capture's."""
+    FLIGHT.arm(str(tmp_path))
+    assert flight.dump("protocol-error",
+                       error=ProtocolError("one", offset=1)) is not None
+    FLIGHT.arm(str(tmp_path))  # same dir, fresh capture
+    second = flight.dump("protocol-error",
+                         error=ProtocolError("two", offset=2))
+    assert second is not None, "second capture's bundle was lost"
+    names = [n for n in os.listdir(tmp_path) if not n.startswith(".")]
+    assert len(names) == 2
+    assert flight.read_bundle(second)["manifest"]["error"]["offset"] == 2
+
+
+def test_flight_checkpoint_context_emits_no_checkpoint_event(
+        obs_enabled, tmp_path):
+    """The checkpoint a bundle carries is CONTEXT, not a resume point:
+    dumping must not append session.checkpoint to the event stream."""
+    from dat_replication_protocol_tpu.obs.events import EVENTS
+
+    FLIGHT.arm(str(tmp_path))
+    dec = protocol.decode()
+    dec.on_error(lambda _e: None)
+    dec.write(b"\x05\x09zzzz")
+    assert dec.destroyed and FLIGHT.last_bundle is not None
+    assert EVENTS.count("session.checkpoint") == 0
+    assert EVENTS.count("protocol.error") == 1
+    # but the bundle still carries the checkpoint fields
+    b = flight.read_bundle(FLIGHT.last_bundle)
+    assert b["manifest"]["checkpoint"]["wire_offset"] == dec.bytes
+
+
+def test_disarmed_recorder_dumps_nothing(obs_enabled, tmp_path):
+    assert not FLIGHT.armed
+    dec = protocol.decode()
+    dec.on_error(lambda _e: None)
+    dec.write(b"\x05\x09zzzz")
+    assert dec.destroyed
+    assert FLIGHT.last_bundle is None
+    assert flight.dump("manual") is None

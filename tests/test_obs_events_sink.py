@@ -1,0 +1,226 @@
+"""EventLog sink edge cases (ISSUE 4 satellites): records reach a fd
+sink whole or not at all.
+
+Uses REAL non-blocking pipes — filling a pipe is the honest way to
+produce EAGAIN, and a record larger than the remaining capacity is the
+honest way to produce a partial write — so the tests exercise exactly
+the syscall behavior production sees, with no monkeypatched os.write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+from dat_replication_protocol_tpu.obs.events import EventLog
+
+
+def _nonblocking_pipe():
+    r, w = os.pipe()
+    os.set_blocking(w, False)
+    return r, w
+
+
+def _fill_pipe(w: int) -> int:
+    """Write until EAGAIN; returns bytes stuffed."""
+    total = 0
+    pad = b"x" * 65536
+    while True:
+        try:
+            total += os.write(w, pad)
+        except BlockingIOError:
+            return total
+
+
+def _drain(r: int) -> bytes:
+    os.set_blocking(r, False)
+    out = b""
+    while True:
+        try:
+            chunk = os.read(r, 65536)
+        except BlockingIOError:
+            return out
+        if not chunk:
+            return out
+        out += chunk
+
+
+def test_dead_fd_swallow_counts_and_never_raises(obs_enabled):
+    r, w = os.pipe()
+    os.close(r)  # EPIPE on write (Python maps it to BrokenPipeError)
+    log = EventLog(capacity=8)
+    log.attach_sink(w)
+    log.emit("sink.dead", i=1)
+    log.emit("sink.dead", i=2)
+    os.close(w)
+    # the session never noticed; the ring kept everything; the sink
+    # accounted for each record it dropped whole
+    assert log.count("sink.dead") == 2
+    assert log.sink_dropped == 2
+
+
+def test_eagain_before_first_byte_drops_record_atomically(obs_enabled):
+    r, w = _nonblocking_pipe()
+    try:
+        log = EventLog(capacity=8)
+        log.attach_sink(w)
+        _fill_pipe(w)
+        mark = _drain(r)  # note: pipe now empty again
+        _fill_pipe(w)  # refill: zero room for the next record
+        log.emit("sink.full", i=1)
+        assert log.sink_dropped == 1
+        drained = _drain(r)
+        # nothing of the record reached the fd — no torn line, and the
+        # sink did NOT latch: with room again, the next record lands
+        assert b"sink.full" not in drained
+        log.emit("sink.retry", i=2)
+        rec = json.loads(_drain(r).decode())
+        assert rec["event"] == "sink.retry"
+        assert len(mark) > 0  # sanity: the pipe really was full before
+    finally:
+        os.close(r)
+        os.close(w)
+
+
+def test_eagain_mid_record_latches_sink_dead_and_counts(obs_enabled):
+    r, w = _nonblocking_pipe()
+    try:
+        log = EventLog(capacity=8)
+        log.attach_sink(w)
+        filled = _fill_pipe(w)
+        # leave exactly 64 bytes of room: the next (much larger) record
+        # MUST tear mid-line, and with nobody draining, the bounded
+        # retry expires and the sink latches dead
+        os.read(r, 64)
+        log.emit("sink.torn", pad="y" * 4096)
+        assert log.sink_dropped == 1
+        # latched: later records write NOTHING after the torn fragment
+        log.emit("sink.after", i=1)
+        assert log.sink_dropped == 2
+        drained = _drain(r)
+        assert b"sink.after" not in drained
+        # the torn fragment is the LAST thing on the fd and is exactly
+        # the stream prefix + 64 bytes of the record — a JSONL consumer
+        # discards the unterminated final line harmlessly
+        assert len(drained) == filled
+        assert not drained.endswith(b"\n")
+        # the ring itself kept both records (the sink is best-effort)
+        assert log.count("sink.torn") == 1 and log.count("sink.after") == 1
+        # re-attaching clears the latch
+        log.attach_sink(w)
+        _drain(r)
+        log.emit("sink.reborn", i=1)
+        assert json.loads(_drain(r).decode())["event"] == "sink.reborn"
+    finally:
+        os.close(r)
+        os.close(w)
+
+
+def test_partial_writes_complete_the_line_when_the_pipe_drains(obs_enabled):
+    """A record bigger than the free capacity finishes via the bounded
+    retry loop when a consumer drains concurrently — one parseable
+    line, nothing dropped."""
+    r, w = _nonblocking_pipe()
+    collected = bytearray()
+    stop = threading.Event()
+
+    def consumer():
+        os.set_blocking(r, True)
+        while not stop.is_set() or True:
+            try:
+                chunk = os.read(r, 65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            collected.extend(chunk)
+
+    t = threading.Thread(target=consumer, daemon=True)
+    try:
+        log = EventLog(capacity=4)
+        log.attach_sink(w)
+        big = "z" * (256 * 1024)  # ≫ pipe capacity: guaranteed partial
+        t.start()
+        log.emit("sink.big", pad=big)
+        assert log.sink_dropped == 0
+    finally:
+        stop.set()
+        os.close(w)  # EOF for the consumer
+        t.join(5)
+        os.close(r)
+    lines = bytes(collected).decode().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["event"] == "sink.big" and rec["fields"]["pad"] == big
+
+
+def test_sink_attached_mid_storm_yields_only_whole_lines(obs_enabled):
+    """Threads hammering emit() while the sink attaches midway: every
+    line on the sink parses, and post-attach records are contiguous
+    (the sink lock serializes whole records, never characters)."""
+    log = EventLog(capacity=4096)
+
+    class Sink:
+        def __init__(self):
+            self.chunks = []
+
+        def write(self, s):
+            self.chunks.append(s)
+
+    sink = Sink()
+    N, T = 200, 4
+    start = threading.Barrier(T + 1)
+
+    def storm(tid):
+        start.wait()
+        for i in range(N):
+            log.emit("storm.ev", tid=tid, i=i)
+
+    threads = [threading.Thread(target=storm, args=(k,)) for k in range(T)]
+    for t in threads:
+        t.start()
+    start.wait()
+    log.attach_sink(sink)  # mid-storm
+    for t in threads:
+        t.join()
+    for chunk in sink.chunks:
+        rec = json.loads(chunk)  # each write() call is one whole record
+        assert rec["event"] == "storm.ev"
+    # seq strictly increases across the mirrored stream
+    seqs = [json.loads(c)["seq"] for c in sink.chunks]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_clear_keeps_seq_monotonic(obs_enabled):
+    log = EventLog(capacity=8)
+    log.emit("seq.a")
+    log.emit("seq.b")
+    last = log.events()[-1]["seq"]
+    log.clear()
+    assert log.events() == [] and log.dropped == 0
+    log.emit("seq.c")
+    assert log.events()[0]["seq"] == last + 1  # never reused after clear
+
+
+def test_file_object_sink_failure_counts_and_session_survives(obs_enabled):
+    log = EventLog(capacity=4)
+
+    class Dying:
+        def write(self, s):
+            raise ValueError("closed file")
+
+    log.attach_sink(Dying())
+    log.emit("sink.objdead", i=1)
+    assert log.count("sink.objdead") == 1
+    assert log.sink_dropped == 1
+
+
+def test_gate_off_means_no_sink_traffic():
+    assert not obs_metrics.OBS.on
+    log = EventLog(capacity=4)
+    written = []
+    log.attach_sink(type("S", (), {"write": lambda self, s: written.append(s)})())
+    log.emit("dark.event")
+    assert written == [] and log.events() == []

@@ -301,6 +301,42 @@ def test_disabled_path_coarse_absolute_budget():
     assert dt < N * 5e-6, f"disabled path {dt / N * 1e9:.0f}ns/check"
 
 
+# -- Prometheus text exposition (ISSUE 4 satellite) --------------------------
+
+
+def test_prom_text_counters_gauges_and_names():
+    reg = Registry()
+    reg.counter("decoder.blob.bytes").inc(7)
+    reg.gauge("queue.depth").set(2.5)
+    text = obs_metrics.to_prom_text(reg.snapshot())
+    assert "# TYPE dat_decoder_blob_bytes counter\n" \
+           "dat_decoder_blob_bytes 7" in text
+    assert "# TYPE dat_queue_depth gauge\ndat_queue_depth 2.5" in text
+
+
+def test_prom_text_histogram_buckets_are_cumulative_with_inf():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    text = obs_metrics.to_prom_text(reg.snapshot())
+    # snapshot stores per-bucket counts (1, 2, 1); exposition must be
+    # cumulative (1, 3, 4) with the overflow as le="+Inf"
+    assert 'dat_lat_bucket{le="0.1"} 1' in text
+    assert 'dat_lat_bucket{le="1.0"} 3' in text
+    assert 'dat_lat_bucket{le="+Inf"} 4' in text
+    assert "dat_lat_count 4" in text
+    assert "dat_lat_sum 6.05" in text
+
+
+def test_prom_text_of_live_registry_parses_line_shaped():
+    obs_metrics.REGISTRY.counter("decoder.bytes")  # ensure present
+    text = obs_metrics.to_prom_text()
+    for ln in text.strip().splitlines():
+        assert ln.startswith("#") or len(ln.split(" ")) == 2, ln
+    assert text.endswith("\n")
+
+
 def test_registry_histogram_param_mismatch_raises():
     reg = Registry()
     reg.histogram("h.par", buckets=(1.0, 2.0), ring=8)

@@ -1,0 +1,303 @@
+"""The offline timeline CLI (ISSUE 4 tentpole): merging two peers'
+JSONL logs into one causally-ordered timeline keyed on wire offset,
+with zero spurious gap/reorder/duplicate flags on clean runs — a clean
+RESUMED run included (resume must never look like duplicate delivery)
+— and true positives on doctored logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.obs import events as obs_events
+from dat_replication_protocol_tpu.obs import tracing
+from dat_replication_protocol_tpu.obs.__main__ import main as obs_main
+from dat_replication_protocol_tpu.session.faults import (
+    FaultPlan,
+    FaultyReader,
+    bytes_reader,
+)
+from dat_replication_protocol_tpu.session.reconnect import (
+    BackoffPolicy,
+    run_resumable,
+)
+from dat_replication_protocol_tpu.session.resume import WireJournal
+
+
+def _detach():
+    obs_events.EVENTS.detach_sink()
+    tracing.SPANS.detach_sink()
+
+
+def _peer_logs(tmp_path, drop: bool):
+    """Run a sender phase then a receiver phase, each mirroring its
+    telemetry into its own JSONL file — the two-peer log pair the CLI
+    merges.  ``drop`` injects a mid-session disconnect + resume."""
+    send_log = str(tmp_path / "sender.jsonl")
+    recv_log = str(tmp_path / "receiver.jsonl")
+
+    sink = tracing.attach_jsonl_sink(send_log)
+    e = protocol.encode()
+    j = WireJournal()
+    e.attach_journal(j)
+    for i in range(50):
+        e.change({"key": f"k{i}", "change": i, "from": i, "to": i + 1,
+                  "value": b"v" * (i % 20)})
+    b = e.blob(100)
+    b.write(b"x" * 100)
+    b.end()
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    wire = j.read_from(0)
+    _detach()
+    sink.close()
+
+    sink = tracing.attach_jsonl_sink(recv_log)
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+    dec.blob(lambda blob, done: blob.collect(lambda _d: done()))
+
+    def source(ckpt, failures):
+        plan = FaultPlan(
+            seed=failures, max_segment=64,
+            drop_at=(len(wire) // 2 - ckpt.wire_offset)
+            if (drop and failures == 0) else None)
+        return FaultyReader(bytes_reader(wire[ckpt.wire_offset:]), plan)
+
+    stats = run_resumable(source, dec,
+                          BackoffPolicy(base=0, max_retries=3, seed=1),
+                          expected_total=len(wire))
+    _detach()
+    sink.close()
+    assert stats["reconnects"] == (1 if drop else 0)
+    return send_log, recv_log
+
+
+def test_clean_run_merges_with_zero_flags(obs_enabled, tmp_path, capsys):
+    send_log, recv_log = _peer_logs(tmp_path, drop=False)
+    rc = obs_main(["timeline", send_log, recv_log, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["flags"] == []
+    assert out["sender"]["covered"] == out["receiver"]["covered"] > 0
+    # causal order: at any shared offset, the sender's emission row
+    # precedes the receiver's dispatch row
+    seen_roles_at: dict[int, list[str]] = {}
+    for row in out["timeline"]:
+        if row["name"] in ("encoder.frame", "decoder.frame"):
+            seen_roles_at.setdefault(row["offset"], []).append(row["role"])
+    for off, roles in seen_roles_at.items():
+        assert roles == ["sender", "receiver"], (off, roles)
+
+
+def test_resumed_run_still_flags_nothing(obs_enabled, tmp_path, capsys):
+    """A drop + reconnect + journal replay delivers every frame exactly
+    once — the timeline must NOT read recovery as duplication."""
+    send_log, recv_log = _peer_logs(tmp_path, drop=True)
+    rc = obs_main(["timeline", send_log, recv_log, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["flags"]
+    assert out["flags"] == []
+    # the fault and the resumed connection are ON the timeline
+    names = [row["name"] for row in out["timeline"]]
+    assert "fault.drop" in names and "session.connect" in names
+
+
+def _doctor(path: str, mutate) -> str:
+    lines = open(path).read().splitlines()
+    out = path + ".doctored"
+    with open(out, "w") as f:
+        f.write("\n".join(mutate(lines)) + "\n")
+    return out
+
+
+def test_duplicate_delivery_is_flagged(obs_enabled, tmp_path, capsys):
+    send_log, recv_log = _peer_logs(tmp_path, drop=False)
+    dup = _doctor(recv_log, lambda lines: lines + [
+        next(ln for ln in lines if '"decoder.frame"' in ln)])
+    rc = obs_main(["timeline", send_log, dup, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["flag"] == "duplicate"
+               and f["role"] == "receiver:dispatch" for f in out["flags"])
+
+
+def test_gap_is_flagged_with_missing_byte_count(obs_enabled, tmp_path,
+                                                capsys):
+    send_log, recv_log = _peer_logs(tmp_path, drop=False)
+
+    def drop_one(lines):
+        victim = [ln for ln in lines if '"decoder.frame"' in ln][3]
+        missing = json.loads(victim)["fields"]["wire_len"]
+        drop_one.missing = missing
+        return [ln for ln in lines if ln != victim]
+
+    gap = _doctor(recv_log, drop_one)
+    rc = obs_main(["timeline", send_log, gap, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    flags = [f for f in out["flags"] if f["flag"] == "gap"]
+    assert flags and flags[0]["missing"] == drop_one.missing
+    # losing a frame also diverges the peers' totals
+    assert any(f["flag"] == "peer-divergence" for f in out["flags"])
+
+
+def test_reorder_is_flagged(obs_enabled, tmp_path, capsys):
+    send_log, recv_log = _peer_logs(tmp_path, drop=False)
+
+    def swap(lines):
+        idx = [i for i, ln in enumerate(lines) if '"decoder.frame"' in ln]
+        a, b = idx[2], idx[3]
+        lines[a], lines[b] = lines[b], lines[a]
+        return lines
+
+    swapped = _doctor(recv_log, swap)
+    rc = obs_main(["timeline", send_log, swapped, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["flag"] == "reorder"
+               and f["role"] == "receiver:dispatch" for f in out["flags"])
+
+
+def test_duplex_peer_log_does_not_self_collide(obs_enabled, tmp_path,
+                                               capsys):
+    """A sidecar-shaped peer mirrors BOTH its request-side dispatch
+    tags and its reply-side emission tags into one log; the two wire
+    streams' offsets both start at 0 and must be audited separately —
+    a clean duplex session flags nothing."""
+    client_log = str(tmp_path / "client.jsonl")
+    sidecar_log = str(tmp_path / "sidecar.jsonl")
+
+    # client phase: emit the request wire
+    sink = tracing.attach_jsonl_sink(client_log)
+    e = protocol.encode()
+    j = WireJournal()
+    e.attach_journal(j)
+    for i in range(10):
+        e.change({"key": f"req{i}", "change": i, "from": i, "to": i + 1})
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    wire = j.read_from(0)
+    _detach()
+    sink.close()
+
+    # "sidecar" phase: dispatch the request AND emit a reply, one log
+    sink = tracing.attach_jsonl_sink(sidecar_log)
+    dec = protocol.decode()
+    reply = protocol.encode()
+    seq = [0]
+
+    def on_change(c, done):
+        reply.change({"key": f"digest-{seq[0]}", "change": seq[0],
+                      "from": 0, "to": 1})
+        seq[0] += 1
+        done()
+
+    dec.change(on_change)
+    dec.write(wire)
+    dec.end()
+    reply.finalize()
+    while reply.read(4096) is not None:
+        pass
+    _detach()
+    sink.close()
+
+    rc = obs_main(["timeline", client_log, sidecar_log, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["flags"]
+    assert out["flags"] == []
+
+
+def test_torn_final_line_is_tolerated(obs_enabled, tmp_path, capsys):
+    """A sink that latched dead leaves an unterminated last line; the
+    CLI must keep it visible without corrupting the merge."""
+    send_log, recv_log = _peer_logs(tmp_path, drop=False)
+    with open(recv_log, "a") as f:
+        f.write('{"seq": 99999, "span": "decoder.fra')  # torn
+    rc = obs_main(["timeline", send_log, recv_log, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0  # the torn fragment is not a frame record
+    assert out["flags"] == []
+
+
+def test_text_output_summarizes_and_orders(obs_enabled, tmp_path, capsys):
+    send_log, recv_log = _peer_logs(tmp_path, drop=True)
+    rc = obs_main(["timeline", send_log, recv_log])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "no gaps, reorders, or duplicate deliveries" in text
+    # offsets in the rendered merge never go backwards
+    offs = [int(ln[1:].split()[0]) for ln in text.splitlines()
+            if ln.startswith(("@", "~"))]
+    assert offs == sorted(offs)
+
+
+def test_export_trace_from_jsonl_and_bundle(obs_enabled, tmp_path, capsys):
+    send_log, recv_log = _peer_logs(tmp_path, drop=False)
+    out_path = str(tmp_path / "recv.trace.json")
+    rc = obs_main(["export-trace", recv_log, "-o", out_path])
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.load(open(out_path))
+    assert doc["traceEvents"]
+    assert {ev["ph"] for ev in doc["traceEvents"]} <= {"X", "i"}
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert "decoder.frame" in names and "reconnect.attempt" in names
+
+    # bundle form: dump one and export it
+    from dat_replication_protocol_tpu.obs import flight
+
+    flight.FLIGHT.arm(str(tmp_path / "fl"), enable_telemetry=False)
+    bundle = flight.dump("timeline-test")
+    rc = obs_main(["export-trace", bundle])
+    capsys.readouterr()
+    assert rc == 0
+    assert json.load(open(os.path.join(bundle, "trace.json")))
+
+
+def test_dump_subcommand_renders_bundle(obs_enabled, tmp_path, capsys):
+    from dat_replication_protocol_tpu.obs import flight
+
+    flight.FLIGHT.arm(str(tmp_path), enable_telemetry=False)
+    dec = protocol.decode()
+    dec.on_error(lambda _e: None)
+    dec.write(b"\x05\x09zzzz")  # unknown type id -> protocol error
+    assert dec.destroyed and flight.FLIGHT.last_bundle
+    rc = obs_main(["dump", flight.FLIGHT.last_bundle])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "protocol-error" in text and "ProtocolError" in text
+    assert "offset=" in text
+    rc = obs_main(["dump", flight.FLIGHT.last_bundle, "--json"])
+    blob = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert blob["manifest"]["error"]["type"] == "ProtocolError"
+
+
+def test_timeline_cli_module_entrypoint_runs(obs_enabled, tmp_path):
+    """`python -m dat_replication_protocol_tpu.obs` is the documented
+    invocation — exercise the real subprocess once."""
+    import subprocess
+    import sys
+
+    send_log, recv_log = _peer_logs(tmp_path, drop=False)
+    r = subprocess.run(
+        [sys.executable, "-m", "dat_replication_protocol_tpu.obs",
+         "timeline", send_log, recv_log],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stderr
+    assert "no gaps" in r.stdout
+
+
+@pytest.mark.parametrize("bad", ["missing.jsonl"])
+def test_timeline_missing_file_errors_cleanly(tmp_path, bad):
+    with pytest.raises(FileNotFoundError):
+        obs_main(["timeline", str(tmp_path / bad), str(tmp_path / bad)])

@@ -1,0 +1,297 @@
+"""Span layer (ISSUE 4 tentpole): nesting/threading correctness, the
+wire-offset frame-tagging contract (sender and receiver compute the
+SAME offset for the same frame, and the tags tile the wire with no
+gaps on every parse path), Chrome trace export shape, and the
+utils.trace JAX-annotation join.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+from dat_replication_protocol_tpu.obs import tracing
+from dat_replication_protocol_tpu.obs.tracing import (
+    SPANS,
+    to_chrome_trace,
+    trace_instant,
+    trace_span,
+)
+from dat_replication_protocol_tpu.session.resume import WireJournal
+
+
+# -- span semantics ----------------------------------------------------------
+
+
+def test_spans_nest_with_parent_links(obs_enabled):
+    with trace_span("outer", layer="test"):
+        with trace_span("inner"):
+            pass
+    inner = SPANS.spans("inner")[0]
+    outer = SPANS.spans("outer")[0]
+    assert inner["parent"] == outer["id"]
+    assert outer["parent"] is None
+    assert outer["fields"] == {"layer": "test"}
+    assert outer["dur"] >= inner["dur"] >= 0.0
+
+
+def test_instants_inherit_the_enclosing_span(obs_enabled):
+    with trace_span("frame-loop"):
+        trace_instant("tagged", offset=7)
+    tag = SPANS.spans("tagged")[0]
+    assert tag["parent"] == SPANS.spans("frame-loop")[0]["id"]
+    assert tag["dur"] == 0.0
+    assert tag["fields"] == {"offset": 7}
+
+
+def test_span_records_exception_exit(obs_enabled):
+    try:
+        with trace_span("doomed"):
+            raise ValueError("boom")
+    except ValueError:
+        pass
+    assert SPANS.spans("doomed")[0]["fields"]["error"] == "ValueError"
+
+
+def test_threads_have_independent_parent_stacks(obs_enabled):
+    done = threading.Event()
+
+    def other():
+        with trace_span("thread-b"):
+            done.wait(5)
+
+    t = threading.Thread(target=other)
+    with trace_span("thread-a"):
+        t.start()
+        while not SPANS.spans():  # wait for b to at least enter
+            if not t.is_alive():
+                break
+        done.set()
+        t.join(5)
+    b = SPANS.spans("thread-b")[0]
+    a = SPANS.spans("thread-a")[0]
+    # concurrent spans on different threads must NOT parent each other
+    assert b["parent"] is None and a["parent"] is None
+    assert b["tid"] != a["tid"]
+
+
+def test_disabled_gate_records_no_spans():
+    assert not obs_metrics.OBS.on
+    SPANS.clear()
+    with trace_span("dark"):
+        pass
+    assert SPANS.spans() == []
+
+
+# -- wire-offset frame tagging -----------------------------------------------
+
+
+def _build_session():
+    """Changes, interleaved corked blobs, a parked change, a multi-KiB
+    blob, tails — the PR-2 coverage scenario, journaled for the wire."""
+    e = protocol.encode()
+    j = WireJournal()
+    e.attach_journal(j)
+    for i in range(300):  # enough consecutive changes for the C run path
+        e.change({"key": f"bulk-{i}", "change": i, "from": i, "to": i + 1,
+                  "value": b"v" * (i % 48)})
+    b1 = e.blob(11)
+    b2 = e.blob(11)
+    b1.write(b"hello ")
+    b2.write(b"HELLO ")
+    b1.write(b"world")
+    b2.write(b"WORLD")
+    b1.end()
+    b2.end()
+    big = e.blob(3000)
+    big.write(b"x" * 1700)
+    e.change({"key": "parked", "change": 99, "from": 0, "to": 1,
+              "value": b"after-blob"})
+    big.end(b"y" * 1300)
+    for i in range(8):
+        e.change({"key": f"tail-{i}", "change": i, "from": i, "to": i + 1})
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    return j.read_from(0)
+
+
+def _frame_records():
+    return [dict(r["fields"], name=r["span"]) for r in SPANS.spans()
+            if r.get("span", "").startswith(("encoder.frame",
+                                             "decoder.frame"))]
+
+
+def _assert_tiles(frames, total: int):
+    """Frame tags must cover [0, total) contiguously, no overlap."""
+    pos = 0
+    for f in sorted(frames, key=lambda f: f["offset"]):
+        assert f["offset"] == pos, (f, pos)
+        pos += f["wire_len"]
+    assert pos == total
+
+
+def test_encoder_frame_tags_tile_the_wire(obs_enabled):
+    wire = _build_session()
+    frames = [f for f in _frame_records() if f["name"] == "encoder.frame"]
+    assert sum(f.get("frames", 1) for f in frames) == 312  # 309 ch + 3 blobs
+    _assert_tiles(frames, len(wire))
+    # corked blobs were tagged at uncork with their true wire offset
+    blob_tags = [f for f in frames if f["kind"] == "blob"]
+    assert len(blob_tags) == 3
+
+
+def test_decoder_frame_tags_agree_with_encoder_on_every_parse_path(
+        obs_enabled):
+    wire = _build_session()
+    enc = {(f["offset"], f["wire_len"]) for f in _frame_records()
+           if f["name"] == "encoder.frame"}
+    # three chunkings: per-byte straddles (streaming scanner), transport
+    # chunks (bulk index + tail scanner), one shot (bulk + C run path)
+    for size in (7, 4096, len(wire)):
+        SPANS.clear()
+        dec = protocol.decode()
+        dec.change(lambda c, done: done())
+        dec.blob(lambda b, done: b.collect(lambda _d: done()))
+        for off in range(0, len(wire), size):
+            dec.write(wire[off:off + size])
+        dec.end()
+        frames = [f for f in _frame_records()
+                  if f["name"].startswith("decoder.frame")]
+        _assert_tiles(frames, len(wire))
+        # every per-frame decoder tag matches a sender tag exactly; run
+        # records cover ranges the sender's per-frame tags tile
+        for f in frames:
+            if f["name"] == "decoder.frame":
+                assert (f["offset"], f["wire_len"]) in enc, f
+        assert sum(f.get("frames", 1) for f in frames) == 312, size
+
+
+def test_frame_offsets_stay_absolute_across_resume(obs_enabled):
+    """A decoder that survives a mid-session fault keeps counting wire
+    offsets absolutely — resumed frames tag where they truly live."""
+    from dat_replication_protocol_tpu.session.faults import (
+        FaultPlan,
+        FaultyReader,
+        bytes_reader,
+    )
+    from dat_replication_protocol_tpu.session.reconnect import (
+        BackoffPolicy,
+        run_resumable,
+    )
+
+    wire = _build_session()
+    SPANS.clear()
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+    dec.blob(lambda b, done: b.collect(lambda _d: done()))
+
+    def source(ckpt, failures):
+        plan = FaultPlan(
+            seed=failures, max_segment=64,
+            drop_at=(len(wire) // 2 - ckpt.wire_offset)
+            if failures == 0 else None)
+        return FaultyReader(bytes_reader(wire[ckpt.wire_offset:]), plan)
+
+    stats = run_resumable(source, dec,
+                          BackoffPolicy(base=0.0, max_retries=3, seed=0),
+                          expected_total=len(wire))
+    assert stats["reconnects"] == 1
+    frames = [f for f in _frame_records()
+              if f["name"].startswith("decoder.frame")]
+    # no duplicate deliveries, no gaps: the tags still tile the wire
+    _assert_tiles(frames, len(wire))
+    # and the reconnect attempts left spans keyed on their resume offset
+    attempts = SPANS.spans("reconnect.attempt")
+    assert [s["fields"]["attempt"] for s in attempts] == [1, 2]
+    assert attempts[1]["fields"]["offset"] > 0
+
+
+# -- Chrome trace export -----------------------------------------------------
+
+
+def test_chrome_trace_export_shape(obs_enabled, tmp_path):
+    with trace_span("phase", offset=0):
+        trace_instant("tick", offset=10)
+    obs_metrics.REGISTRY.counter("x.y")  # registry noise must not leak in
+    from dat_replication_protocol_tpu.obs.events import emit
+
+    emit("some.event", offset=4)
+    doc = to_chrome_trace()
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert {"phase", "tick", "some.event"} <= names
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "i")
+        assert isinstance(ev["ts"], float)
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0.0
+        else:
+            assert ev["s"] in ("t", "p")
+    # timestamps sorted (viewers tolerate unsorted, humans diffing don't)
+    ts = [ev["ts"] for ev in doc["traceEvents"]]
+    assert ts == sorted(ts)
+    out = tracing.export_chrome_trace(str(tmp_path / "t.json"))
+    assert json.load(open(out))["traceEvents"]
+
+
+def test_utils_trace_span_joins_the_obs_ring(obs_enabled):
+    from dat_replication_protocol_tpu.utils.trace import span
+
+    with span("jax-phase"):
+        pass
+    rec = SPANS.spans("jax-phase")
+    assert len(rec) == 1 and rec[0]["fields"]["src"] == "jax"
+
+
+def test_utils_trace_joined_span_unwinds_on_inner_enter_raise(obs_enabled):
+    """If the jax annotation's __enter__ raises, the obs span must pop
+    its id off the threadlocal parent stack — a leaked id would corrupt
+    every later span's parent link on this thread."""
+    from dat_replication_protocol_tpu.utils.trace import _JoinedSpan
+
+    class ExplodingInner:
+        def __enter__(self):
+            raise RuntimeError("profiler in a bad state")
+
+        def __exit__(self, *exc):
+            return False
+
+    with pytest.raises(RuntimeError):
+        with _JoinedSpan("doomed-jax", ExplodingInner()):
+            raise AssertionError("body must not run")
+    assert tracing._stack() == []  # nothing leaked
+    with trace_span("clean-after"):
+        pass
+    assert SPANS.spans("clean-after")[0]["parent"] is None
+
+
+def test_utils_trace_span_unchanged_when_gate_off():
+    from dat_replication_protocol_tpu.utils import trace
+
+    assert not obs_metrics.OBS.on
+    SPANS.clear()
+    with trace.span("dark-jax"):
+        pass
+    assert SPANS.spans() == []
+
+
+def test_jsonl_sink_mirrors_spans_and_events_one_object_per_line(
+        obs_enabled, tmp_path):
+    from dat_replication_protocol_tpu.obs.events import EVENTS, emit
+
+    path = tmp_path / "peer.jsonl"
+    sink = tracing.attach_jsonl_sink(str(path))
+    try:
+        with trace_span("mirrored"):
+            emit("mirrored.event", offset=1)
+    finally:
+        EVENTS.detach_sink()
+        SPANS.detach_sink()
+        sink.close()
+    records = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert {r.get("span") or r.get("event") for r in records} == {
+        "mirrored", "mirrored.event"}

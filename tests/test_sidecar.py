@@ -391,3 +391,75 @@ def test_stdio_sidecar_stats_fd_emits_parseable_snapshots():
     assert final["decoder.digests"] == 2  # blob-0 + change-0
     # the reply stream's own encode traffic is attributed too
     assert final["encoder.changes"] == 2
+
+
+def test_stats_emitter_prom_format_exposition(obs_enabled):
+    """ISSUE 4 satellite: --stats-format prom renders Prometheus text
+    exposition blocks (cumulative buckets, dat_ namespace)."""
+    import os
+
+    obs_enabled.REGISTRY.counter("sidecar.test.prom").inc(3)
+    obs_enabled.REGISTRY.histogram("sidecar.test.lat").observe(0.5)
+    r, w = os.pipe()
+    emitter = sidecar.StatsEmitter(w, interval=60.0, fmt="prom").start()
+    try:
+        emitter.kick()
+        raw = b""
+        while b"dat_obs_scrape_ts" not in raw:
+            raw += os.read(r, 65536)
+        text = raw.decode()
+        assert "# TYPE dat_sidecar_test_prom counter\n" \
+               "dat_sidecar_test_prom 3" in text
+        assert "# TYPE dat_sidecar_test_lat histogram" in text
+        assert 'dat_sidecar_test_lat_bucket{le="+Inf"} 1' in text
+        assert "dat_obs_events_dropped 0" in text
+    finally:
+        emitter.stop()
+        os.close(r)
+        os.close(w)
+
+
+def test_stats_emitter_rejects_unknown_format():
+    import pytest
+
+    with pytest.raises(ValueError):
+        sidecar.StatsEmitter(1, fmt="xml")
+
+
+def test_stdio_sidecar_flight_dir_and_trace_jsonl(tmp_path):
+    """ISSUE 4 tentpole wiring: a malformed foreign session through
+    `--stdio --flight-dir --trace-jsonl` leaves (a) an atomic
+    post-mortem bundle whose manifest carries the error coordinates
+    and (b) a JSONL trace log the timeline CLI can consume."""
+    import json
+    import os
+
+    from dat_replication_protocol_tpu.obs import flight
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["DAT_DEVICE_HASH"] = "0"
+    flight_dir = str(tmp_path / "flight")
+    trace_log = str(tmp_path / "sidecar.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dat_replication_protocol_tpu.sidecar",
+         "--stdio", "--flight-dir", flight_dir,
+         "--trace-jsonl", trace_log],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, cwd=repo_root, env=env,
+    )
+    # valid change frame first, then garbage: type id 9 is a wire error
+    out, err = proc.communicate(SESSION_1 + b"\x05\x09zzzz", timeout=120)
+    assert proc.returncode == 1, err.decode()  # ok: False
+    bundles = [n for n in os.listdir(flight_dir)
+               if not n.startswith(".")]
+    assert len(bundles) == 1 and "protocol-error" in bundles[0], bundles
+    b = flight.read_bundle(os.path.join(flight_dir, bundles[0]))
+    assert b["manifest"]["error"]["type"] == "ProtocolError"
+    assert b["manifest"]["error"]["offset"] is not None
+    assert any(e.get("event") == "protocol.error" for e in b["events"])
+    # the trace log holds the decoder's wire-offset frame spans
+    records = [json.loads(ln)
+               for ln in open(trace_log).read().splitlines() if ln]
+    frames = [r for r in records if r.get("span") == "decoder.frame"]
+    assert frames and frames[0]["fields"]["offset"] == 0
