@@ -125,7 +125,29 @@ def _env_int(name, default):
     return int(os.environ.get(name, default))
 
 
-def _probe_backend(platform: str | None, timeout: float) -> tuple[str | None, str | None]:
+def _probe_stage(stdout: str | None) -> str | None:
+    """Last ``STAGE <name>`` marker the probe printed: the init stage it
+    was IN when it died/hung (obs.device.INIT_STAGES ladder)."""
+    stage = None
+    for line in (stdout or "").splitlines():
+        if line.startswith("STAGE "):
+            stage = line.split(None, 1)[1].strip()
+    return stage
+
+
+def _probe_failure(message: str, stdout: str | None,
+                   elapsed_s: float) -> dict:
+    """Structured backend_error record (ISSUE 5 satellite: the BENCH
+    json's ``backend_error`` carries ``stage`` and ``elapsed_s``, not
+    just an opaque string like round 5's "backend init hung (> 87s)")."""
+    return {
+        "message": message,
+        "stage": _probe_stage(stdout),
+        "elapsed_s": round(elapsed_s, 1),
+    }
+
+
+def _probe_backend(platform: str | None, timeout: float) -> tuple[str | None, dict | None]:
     """Initialize JAX in a THROWAWAY subprocess and report its backend.
 
     Round 1 died on "Unable to initialize backend 'axon': UNAVAILABLE";
@@ -133,10 +155,15 @@ def _probe_backend(platform: str | None, timeout: float) -> tuple[str | None, st
     (observed: >300 s with no exception).  A subprocess probe turns both
     failure modes into something the parent can retry or route around —
     the parent only initializes a platform the probe verified.
+
+    The probe prints staged progress markers (the obs.device watchdog
+    ladder: platform_probe -> first_device_call -> first_compile) so a
+    hang names the stage it is stuck in: ``subprocess.TimeoutExpired``
+    carries the partial stdout captured before the kill.
     """
     import subprocess
 
-    code = "import jax\n"
+    code = "print('STAGE platform_probe', flush=True)\nimport jax\n"
     if platform:
         code += f"jax.config.update('jax_platforms', {platform!r})\n"
     # EXECUTE something and fetch it, not just list devices: a wedged
@@ -144,10 +171,14 @@ def _probe_backend(platform: str | None, timeout: float) -> tuple[str | None, st
     # while every launch hangs — the probe must prove the device RUNS
     code += (
         "import numpy as np, jax.numpy as jnp\n"
+        "print('STAGE first_device_call', flush=True)\n"
+        "devs = jax.devices()\n"
+        "print('STAGE first_compile', flush=True)\n"
         "x = np.asarray(jnp.arange(8) * 2)\n"
         "assert x[3] == 6\n"
-        "print('PROBE', jax.default_backend(), len(jax.devices()))\n"
+        "print('PROBE', jax.default_backend(), len(devs))\n"
     )
+    t0 = time.monotonic()
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
@@ -155,15 +186,24 @@ def _probe_backend(platform: str | None, timeout: float) -> tuple[str | None, st
             text=True,
             timeout=timeout,
         )
-    except subprocess.TimeoutExpired:
-        return None, f"backend init hung (> {timeout:.0f}s)"
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", errors="replace")
+        return None, _probe_failure(
+            f"backend init hung (> {timeout:.0f}s)", stdout,
+            time.monotonic() - t0)
+    elapsed = time.monotonic() - t0
     if r.returncode == 0:
         for line in r.stdout.splitlines():
             if line.startswith("PROBE "):
                 return line.split()[1], None
-        return None, "probe produced no backend line"
+        return None, _probe_failure("probe produced no backend line",
+                                    r.stdout, elapsed)
     tail = [ln for ln in r.stderr.strip().splitlines() if ln.strip()]
-    return None, (tail[-1] if tail else f"probe exited {r.returncode}")
+    return None, _probe_failure(
+        tail[-1] if tail else f"probe exited {r.returncode}",
+        r.stdout, elapsed)
 
 
 def _probe_loop(
@@ -174,7 +214,7 @@ def _probe_loop(
     sleep_s: float = 20.0,
     reserve_s: float = 60.0,
     on_first_failure=None,
-) -> tuple[str | None, str | None]:
+) -> tuple[str | None, object]:
     """Probe for a working device backend across the WHOLE remaining budget.
 
     Round 3's driver artifact fell back to CPU because one 90 s probe hit a
@@ -193,7 +233,7 @@ def _probe_loop(
     ``probe_fn`` is injectable for the hang-then-recover test.
     """
     probe = probe_fn or _probe_backend
-    err: str | None = None
+    err = None  # str (scripted/legacy) or the _probe_failure dict
     failed_once = False
     while True:
         # a short deadline shrinks the probe timeout rather than skipping
@@ -215,13 +255,18 @@ def _probe_loop(
         remaining = deadline_ts - time.monotonic()
         if remaining - reserve_s <= sleep_s:
             return None, err
-        log(f"bench: backend probe failed ({err}); re-probe in {sleep_s:.0f}s "
+        msg = err.get("message") if isinstance(err, dict) else err
+        stage = err.get("stage") if isinstance(err, dict) else None
+        log(f"bench: backend probe failed ({msg}"
+            + (f", stuck in stage {stage}" if stage else "")
+            + f"); re-probe in {sleep_s:.0f}s "
             f"({remaining:.0f}s of budget left)")
         time.sleep(sleep_s)
 
 
 def _start_cpu_fallback(device_keys: list[str], quick: bool,
-                        budget_s: float, trace_dir: str | None = None):
+                        budget_s: float, trace_dir: str | None = None,
+                        flight_dir: str | None = None):
     """Launch ``bench.py`` for the device configs on the CPU backend in a
     subprocess, so fallback numbers accrue WHILE the parent keeps probing
     for the real device (a wedged tunnel must cost neither)."""
@@ -239,6 +284,8 @@ def _start_cpu_fallback(device_keys: list[str], quick: bool,
         argv.append("--metrics")
     if trace_dir:  # own subdir: the parent's device leg may trace too
         argv.append(f"--trace={os.path.join(trace_dir, 'cpu_fallback')}")
+    if flight_dir:  # shared dir is safe: bundle names carry the pid
+        argv.append(f"--flight-dir={flight_dir}")
     log(f"bench: starting CPU-fallback subprocess for configs "
         f"{env['BENCH_CONFIGS']}")
     return subprocess.Popen(
@@ -1253,6 +1300,25 @@ def _attach_metrics(res: dict) -> None:
     obs_metrics.REGISTRY.reset()
 
 
+def _device_telemetry_subset() -> dict:
+    """device./backend.-prefixed slice of the live registry — the
+    partial device telemetry that rides a failed backend init's
+    ``backend_error`` record (ISSUE 5 satellite)."""
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+
+    snap = obs_metrics.snapshot()
+
+    def pick(d: dict) -> dict:
+        return {k: v for k, v in d.items()
+                if k.startswith(("device.", "backend."))}
+
+    return {"counters": pick(snap.get("counters", {})),
+            "gauges": pick(snap.get("gauges", {})),
+            # device.chiplock.wait lives here — the contention story a
+            # failed device run most needs in its post-mortem
+            "histograms": pick(snap.get("histograms", {}))}
+
+
 def _export_config_trace(name: str, trace_dir) -> None:
     """--trace artifact per config: the obs span/event rings exported
     as one Chrome trace JSON (Perfetto-loadable) under
@@ -1273,9 +1339,15 @@ def _export_config_trace(name: str, trace_dir) -> None:
             log(f"bench: config {name} trace -> {out}")
         finally:
             # clear even when the export failed: a leftover ring would
-            # leak THIS config's spans into the next config's artifact
+            # leak THIS config's spans into the next config's artifact.
+            # The engine-select memo resets with the rings — otherwise
+            # every config after the first would carry no
+            # device.engine.select attribution in its artifact.
+            from dat_replication_protocol_tpu.obs import device as obs_device
+
             obs_tracing.SPANS.clear()
             obs_events.EVENTS.clear()
+            obs_device.reset_engine_notes()
     except Exception as e:  # an unwritable dir must not blank the run
         log(f"bench: config {name} trace export failed ({e})")
 
@@ -1317,11 +1389,24 @@ def main() -> None:
     if "--metrics" in sys.argv:
         _metrics_on()
     trace_dir = None
-    for arg in sys.argv[1:]:
+    flight_dir = None
+    args = sys.argv[1:]
+    for i, arg in enumerate(args):
         if arg.startswith("--trace="):
             trace_dir = arg.split("=", 1)[1]
         elif arg == "--trace":
             trace_dir = "/tmp/dat_bench_trace"
+        elif arg.startswith("--flight-dir="):
+            flight_dir = arg.split("=", 1)[1]
+        elif arg == "--flight-dir" and i + 1 < len(args) \
+                and not args[i + 1].startswith("-"):
+            flight_dir = args[i + 1]
+    if flight_dir:
+        # armed recorder: a stuck backend init (the watchdog below) or
+        # any structured session error dumps a post-mortem bundle here
+        from dat_replication_protocol_tpu.obs import flight as obs_flight
+
+        obs_flight.arm(flight_dir)
     which = [
         k.strip()
         for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6").split(",")
@@ -1383,10 +1468,16 @@ def main() -> None:
         def run_device_leg(backend: str) -> None:
             import jax
 
+            from dat_replication_protocol_tpu.obs.device import (
+                BackendInitWatchdog,
+            )
             from dat_replication_protocol_tpu.utils.cache import (
                 enable_compile_cache,
             )
 
+            # host-side setup only before the chip lock: nothing below
+            # may touch the device yet (a pre-lock init would race a
+            # peer's capture — the exact contamination the lock closes)
             enable_compile_cache("bench", env_var="BENCH_COMPILE_CACHE")
             if force:
                 # the dev image's sitecustomize re-forces JAX_PLATFORMS
@@ -1417,6 +1508,30 @@ def main() -> None:
                 if not lease.uncontended:
                     log(f"bench: chip lock contended "
                         f"(held={lease.held}, waited {lease.waited_s:.0f}s)")
+                # staged init under a deadline, INSIDE the lock (the
+                # first device touch happens here): the probe verified
+                # the platform, but the in-process init can still wedge
+                # — when it does, the watchdog emits backend.init.stuck
+                # naming the stage and dumps a flight bundle (with
+                # --flight-dir) while the bench deadline watchdog
+                # handles artifact emission
+                wd_deadline = max(
+                    30.0, min(300.0, deadline_ts - time.monotonic() - 30.0)
+                )
+                with BackendInitWatchdog(deadline_s=wd_deadline) as wd:
+                    wd.stage("platform_probe")
+                    wd.stage("first_device_call")
+                    ndev = len(jax.devices())
+                    wd.stage("first_compile")
+                    import numpy as _np
+
+                    assert int(_np.asarray(
+                        jax.jit(lambda: jax.numpy.arange(4))())[3]) == 3
+                if wd.fired:
+                    log(f"bench: backend init exceeded {wd_deadline:.0f}s "
+                        f"watchdog (recovered); see backend.init.* events")
+                log(f"bench: in-process backend up ({ndev} device(s), "
+                    f"{wd.elapsed_s:.1f}s)")
                 for key in device_keys:
                     run_config(key, backend)
                     res = _state["configs"].get(BENCHES[key][0])
@@ -1435,6 +1550,34 @@ def main() -> None:
                     _state["configs"].setdefault(
                         BENCHES[key][0], {"error": f"{type(e).__name__}: {e}"}
                     )
+                if _state["backend_error"] is None:
+                    # the IN-PROCESS failure path is where the watchdog's
+                    # stage events and device gauges actually live — the
+                    # structured record + telemetry subset must ride this
+                    # branch, not just the subprocess-probe one
+                    be: dict = {"message": f"{type(e).__name__}: {e}",
+                                "stage": None, "elapsed_s": None}
+                    if _METRICS["on"]:
+                        from dat_replication_protocol_tpu.obs import (
+                            events as obs_events,
+                        )
+
+                        # attribute a stage ONLY when the init itself
+                        # failed: the watchdog's done event carries the
+                        # raising exception type when its block raised,
+                        # and no error when init completed — a
+                        # post-init failure (unwritable trace dir,
+                        # chip-lock error) must not read as "backend
+                        # init stuck in first_compile"
+                        st = obs_events.EVENTS.last("backend.init.stage")
+                        done = obs_events.EVENTS.last("backend.init.done")
+                        init_failed = done is None or \
+                            done["fields"].get("error") is not None
+                        if st is not None and init_failed:
+                            be["stage"] = st["fields"].get("stage")
+                            be["elapsed_s"] = st["fields"].get("elapsed_s")
+                        be["telemetry"] = _device_telemetry_subset()
+                    _state["backend_error"] = be
 
         if force == "cpu":
             # explicit CPU run (and the fallback child itself): no probing
@@ -1450,7 +1593,7 @@ def main() -> None:
                         fb["proc"] = _start_cpu_fallback(
                             device_keys, quick,
                             budget_s=deadline_ts - time.monotonic() - 30,
-                            trace_dir=trace_dir,
+                            trace_dir=trace_dir, flight_dir=flight_dir,
                         )
                     except Exception as e:  # fork/ENOMEM: keep the run alive
                         log(f"bench: could not start CPU fallback ({e})")
@@ -1464,6 +1607,12 @@ def main() -> None:
             except Exception as e:  # e.g. jax import failure
                 backend, backend_err = None, f"{type(e).__name__}: {e}"
                 log(f"bench: backend probe failed outright: {e}")
+            # no telemetry subset here: the probe ran in a throwaway
+            # subprocess whose registry died with it, and the parent has
+            # not touched the device yet — the stage/elapsed fields ARE
+            # this branch's device story.  The in-process failure path
+            # (run_device_leg_guarded) attaches the subset, where it is
+            # actually populated.
             _state["backend_error"] = backend_err
             if backend is not None:
                 _state["backend"] = backend
@@ -1506,7 +1655,16 @@ def main() -> None:
                 for key in device_keys:
                     name = BENCHES[key][0]
                     if name not in _state["configs"]:
-                        _state["configs"][name] = {"error": backend_err}
+                        # slim per-config record: the telemetry subset
+                        # rides ONCE on the top-level backend_error, not
+                        # duplicated into every missing config
+                        if isinstance(backend_err, dict):
+                            _state["configs"][name] = {
+                                "error": backend_err["message"],
+                                "stage": backend_err.get("stage"),
+                            }
+                        else:
+                            _state["configs"][name] = {"error": backend_err}
 
     watchdog.cancel()
     _emit()

@@ -10,13 +10,16 @@ dynamic name becomes a brand-new metric instead of an error.
 
 Flagged shapes (Python sources only):
 
-* a call to a registry factory, event emitter, or span opener —
-  ``counter(...)``, ``gauge(...)``, ``histogram(...)``, ``emit(...)``,
-  ``trace_span(...)``, ``trace_instant(...)`` (bare, aliased with
+* a call to a registry factory, event emitter, span opener, or
+  jit-site registration — ``counter(...)``, ``gauge(...)``,
+  ``histogram(...)``, ``emit(...)``, ``trace_span(...)``,
+  ``trace_instant(...)``, ``jit_site(...)`` (bare, aliased with
   leading underscores, or as an attribute like ``EVENTS.emit``) —
   whose first argument is not a string literal: span names carry the
-  SAME greppability contract as event names (ISSUE 4), since the
-  timeline CLI and trace viewers key on them;
+  SAME greppability contract as event names (ISSUE 4), and the
+  recompile sentinel's per-site names (ISSUE 5) the same again — the
+  sentinel's snapshot, ``device.jit.trace`` events, and the docs
+  catalog all key on them;
 * a bare ``print(...)`` (no ``file=`` keyword, i.e. stdout) anywhere
   in the package: stdout belongs to the wire/CLI protocol, and
   diagnostics belong in the structured event log (:mod:`...obs.events`)
@@ -40,7 +43,7 @@ from typing import Iterator
 from ..engine import Finding, Project
 
 _TELEMETRY_FNS = {"counter", "gauge", "histogram", "emit",
-                  "trace_span", "trace_instant"}
+                  "trace_span", "trace_instant", "jit_site"}
 # attribute-call receivers that denote the obs layer (normalized:
 # underscores stripped, lowercased) — `EVENTS.emit(...)`,
 # `obs_metrics.counter(...)`, `registry.histogram(...)`.  Unrelated
@@ -48,13 +51,13 @@ _TELEMETRY_FNS = {"counter", "gauge", "histogram", "emit",
 # `np.histogram(data, bins)`) must NOT trip the rule.
 _TELEMETRY_RECEIVERS = {"events", "metrics", "obs", "obs_events",
                         "obs_metrics", "obs_tracing", "registry", "reg",
-                        "spans", "tracing"}
+                        "spans", "tracing", "device", "obs_device"}
 # the obs plumbing itself: (parent dir, filename) pairs exempt from the
 # literal-name check (they forward `name` parameters by design; the
 # greppable sites are their callers)
 _PLUMBING = {("obs", "metrics.py"), ("obs", "events.py"),
              ("obs", "tracing.py"), ("obs", "flight.py"),
-             ("obs", "__init__.py")}
+             ("obs", "device.py"), ("obs", "__init__.py")}
 
 
 def _telemetry_fn_name(call: ast.Call) -> str | None:

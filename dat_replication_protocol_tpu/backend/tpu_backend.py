@@ -23,7 +23,9 @@ from __future__ import annotations
 import hashlib
 from typing import Callable, Optional
 
+from ..obs.device import note_engine as _note_engine
 from ..obs.metrics import OBS as _OBS, counter as _counter
+from ..obs.tracing import trace_span as _trace_span
 from ..session.decoder import BlobReader, Decoder
 from ..session.encoder import Encoder
 from ..utils.trace import span
@@ -33,6 +35,14 @@ DIGEST_SIZE = 32  # BLAKE2b-256, dat's content-hash size
 # digest deliveries by session end (OBSERVABILITY.md catalog)
 _M_DEC_DIGESTS = _counter("decoder.digests")
 _M_ENC_DIGESTS = _counter("encoder.digests")
+# device-path pipeline traffic (OBSERVABILITY.md device-telemetry
+# catalog): payloads queued for hashing and batches dispatched.  Submit
+# accounting is counters, not per-item spans — the bulk decoder submits
+# per change, and the span story lives at the dispatch/deliver batch
+# boundaries (same run-granularity discipline as `decoder.changes`).
+_M_SUBMIT_ITEMS = _counter("device.submit.items")
+_M_SUBMIT_BYTES = _counter("device.submit.bytes")
+_M_DISPATCHES = _counter("device.dispatch.batches")
 
 OnDigest = Callable[[str, int, bytes], None]  # (kind, seq, digest)
 
@@ -56,7 +66,12 @@ def _host_hash_batch(payloads: list[bytes]) -> list[bytes]:
                     np.frombuffer(b"".join(payloads), np.uint8), offs, lens
                 )
             if out is not None:
+                if _OBS.on:
+                    _note_engine("digest.hash", "native-host",
+                                 items=len(payloads))
                 return [row.tobytes() for row in out]
+    if _OBS.on:
+        _note_engine("digest.hash", "hashlib", items=len(payloads))
     return [
         hashlib.blake2b(p, digest_size=DIGEST_SIZE).digest() for p in payloads
     ]
@@ -78,6 +93,8 @@ def _device_hash_begin_factory():
     try:
         from ..ops.blake2b import blake2b_batch_begin  # noqa: PLC0415
 
+        if _OBS.on:
+            _note_engine("digest.hash", "device-batch")
         return blake2b_batch_begin
     except Exception:
         return None
@@ -178,6 +195,9 @@ class DigestPipeline:
         ``on_digest(tag, digest)`` — a shared bound method + tag costs no
         per-item closure, which matters at the bulk decoder's change
         rates (a lambda per change was ~20% of the digest path)."""
+        if _OBS.on:
+            _M_SUBMIT_ITEMS.inc()
+            _M_SUBMIT_BYTES.inc(len(payload))
         self._entries.append(("payload", payload, on_digest, tag))
         self._pending_bytes += len(payload)
         if (
@@ -191,6 +211,11 @@ class DigestPipeline:
         """Queue a finished incremental hash (:class:`..ops.blake2b.
         Blake2bStream`-shaped: ``.digest()``/``.length``) for in-order
         digest delivery alongside batched payloads."""
+        if _OBS.on:
+            _M_SUBMIT_ITEMS.inc()
+            # a blob-heavy session carries its dominant byte volume
+            # through streams — the bytes counter must say so
+            _M_SUBMIT_BYTES.inc(int(getattr(stream, "length", 0)))
         self._entries.append(("stream", stream, on_digest, tag))
         if len(self._entries) >= self._max_batch:
             self.dispatch()
@@ -209,10 +234,14 @@ class DigestPipeline:
         if not self._entries:
             return
         entries, self._entries = self._entries, []
+        pending = self._pending_bytes
         self._pending_bytes = 0
         self.dispatches += 1
+        if _OBS.on:
+            _M_DISPATCHES.inc()
         payloads = [e[1] for e in entries if e[0] == "payload"]
-        with span("digest.dispatch"):
+        with _trace_span("device.dispatch", items=len(entries),
+                         bytes=pending), span("digest.dispatch"):
             collect = self._hash_begin(payloads) if payloads else (lambda: [])
         self._inflight.append((entries, collect))
         while len(self._inflight) > self._max_inflight:
@@ -221,7 +250,8 @@ class DigestPipeline:
     def _deliver_oldest(self) -> None:
         entries, collect = self._inflight.pop(0)
         payload_count = sum(1 for e in entries if e[0] == "payload")
-        with span("digest.collect"):
+        with _trace_span("device.deliver", items=len(entries)), \
+                span("digest.collect"):
             digest_list = collect()
         if len(digest_list) != payload_count:
             raise RuntimeError(

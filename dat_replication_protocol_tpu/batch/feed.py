@@ -26,9 +26,19 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..obs.device import note_engine as _note_engine
+from ..obs.metrics import OBS as _OBS
+from ..obs.metrics import counter as _counter
+from ..obs.tracing import trace_span as _trace_span
 from ..ops import blake2b
 
 BLOCK_BYTES = blake2b.BLOCK_BYTES
+
+# staged uploads / digest fetches through the feed layer (device-path
+# telemetry; OBSERVABILITY.md catalog) — same names as ops.blake2b's
+# batch edge: one pair of counters tells the whole transfer story
+_M_H2D = _counter("device.h2d.bytes")
+_M_D2H = _counter("device.d2h.bytes")
 
 
 def pack_ragged(buf: np.ndarray, offs: np.ndarray, lens: np.ndarray,
@@ -108,6 +118,8 @@ def hash_extents(buf: np.ndarray, offs, lens,
     if not n:
         return np.empty((0, 32), dtype=np.uint8)
     hh, hl = hash_extents_device(buf, offs, lens, use_pallas, **pipeline_kw)
+    if _OBS.on:
+        _M_D2H.inc(32 * n)  # (N, 4) u32 hi + lo halves fetched
     raw = np.empty((n, 8), dtype="<u4")
     raw[:, 0::2] = np.asarray(hl)
     raw[:, 1::2] = np.asarray(hh)
@@ -174,20 +186,30 @@ def hash_extents_device(buf: np.ndarray, offs, lens,
             from ..ops.blake2b_pallas import blake2b_packed_pallas as fn
         else:
             fn = blake2b.blake2b_packed
+        if _OBS.on:
+            # keyed per bucket, same rationale as the blake2b batch edge
+            _note_engine(
+                "feed.hash_extents",
+                "pallas" if fn is not blake2b.blake2b_packed else "xla-scan",
+                key=nb, items=B, nblocks=nb)
         for c0 in range(0, B, chunk_b):
             sub = idx[c0:c0 + chunk_b]
             bs = len(sub)
-            mh, ml, blens = pack_ragged(buf, offs[sub], lens[sub], nb)
-            if bs != chunk_b:  # tail chunk: same shape, one compile
-                pad = ((0, chunk_b - bs),)
-                mh = np.pad(mh, pad + ((0, 0), (0, 0)))
-                ml = np.pad(ml, pad + ((0, 0), (0, 0)))
-                blens = np.pad(blens, (0, chunk_b - bs))
-            # stage the upload: the transfer streams while earlier
-            # chunks are still compressing
-            mh_d = jax.device_put(mh)
-            ml_d = jax.device_put(ml)
-            hh, hl = fn(mh_d, ml_d, jnp.asarray(blens))
+            with _trace_span("device.dispatch", site="feed.hash_extents",
+                             items=bs, nblocks=nb):
+                mh, ml, blens = pack_ragged(buf, offs[sub], lens[sub], nb)
+                if bs != chunk_b:  # tail chunk: same shape, one compile
+                    pad = ((0, chunk_b - bs),)
+                    mh = np.pad(mh, pad + ((0, 0), (0, 0)))
+                    ml = np.pad(ml, pad + ((0, 0), (0, 0)))
+                    blens = np.pad(blens, (0, chunk_b - bs))
+                if _OBS.on:
+                    _M_H2D.inc(mh.nbytes + ml.nbytes + blens.nbytes)
+                # stage the upload: the transfer streams while earlier
+                # chunks are still compressing
+                mh_d = jax.device_put(mh)
+                ml_d = jax.device_put(ml)
+                hh, hl = fn(mh_d, ml_d, jnp.asarray(blens))
             at = jnp.asarray(sub)
             out_hh = out_hh.at[at].set(hh[:bs, :4])
             out_hl = out_hl.at[at].set(hl[:bs, :4])

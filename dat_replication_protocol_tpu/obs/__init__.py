@@ -31,6 +31,16 @@ Four parts:
   ProtocolError or reconnect exhaustion, an armed recorder atomically
   dumps a post-mortem bundle (rings + registry + checkpoint + active
   fault plans) for offline attribution.
+* :mod:`.device` — the device boundary (ISSUE 5): the recompile
+  sentinel (:func:`~.device.jit_site` wrappers counting traces vs
+  cache hits per jit call-site, with a :class:`~.device.RecompileBudget`),
+  the backend-init watchdog (staged ``backend.init`` progress with a
+  deadline that dumps a flight bundle naming the stuck stage), device
+  memory gauges, and engine-selection attribution.
+* :mod:`.perf` — the perf-budget regression gate: compares a
+  ``bench.py --metrics`` artifact against checked-in per-metric
+  budgets (``artifacts/perf_budgets.json``); the CLI's ``perf-check``
+  exits nonzero on regression.
 
 Offline CLI: ``python -m dat_replication_protocol_tpu.obs`` merges two
 peers' JSONL logs into one causally-ordered timeline (``timeline``),
@@ -48,6 +58,15 @@ Catalog, schema, overhead budget: OBSERVABILITY.md.
 
 from __future__ import annotations
 
+from .device import (
+    SENTINEL,
+    BackendInitWatchdog,
+    JitSentinel,
+    RecompileBudget,
+    jit_site,
+    note_engine,
+    sample_device_gauges,
+)
 from .events import EVENTS, EventLog, emit
 from .flight import FLIGHT, FlightRecorder, read_bundle
 from .metrics import (
@@ -102,4 +121,11 @@ __all__ = [
     "export_chrome_trace",
     "attach_jsonl_sink",
     "read_bundle",
+    "SENTINEL",
+    "JitSentinel",
+    "RecompileBudget",
+    "BackendInitWatchdog",
+    "jit_site",
+    "note_engine",
+    "sample_device_gauges",
 ]

@@ -3,6 +3,7 @@
     python -m dat_replication_protocol_tpu.obs timeline SENDER.jsonl RECEIVER.jsonl
     python -m dat_replication_protocol_tpu.obs export-trace LOG.jsonl|BUNDLE_DIR [-o OUT]
     python -m dat_replication_protocol_tpu.obs dump BUNDLE_DIR [--json]
+    python -m dat_replication_protocol_tpu.obs perf-check BENCH.json [--budgets PATH] [--host-only]
 
 ``timeline`` merges two peers' JSONL event/span logs (written by
 ``obs.tracing.attach_jsonl_sink`` / ``EVENTS.attach_sink``) into ONE
@@ -28,6 +29,14 @@ timeline's conformance contract (tests/test_obs_timeline.py).
 into Chrome trace-event JSON, loadable in Perfetto.  ``dump`` renders
 a flight-recorder bundle (see obs/flight.py) for humans or, with
 ``--json``, for tools.
+
+``perf-check`` is the perf-budget regression gate (ISSUE 5): it
+compares one bench artifact (the one JSON line ``bench.py`` prints)
+against the checked-in per-metric budgets
+(``artifacts/perf_budgets.json`` by default; see :mod:`.perf` for the
+file format) and exits 1 on any regression — the bench trajectory as
+an enforced contract instead of an unread JSON trail.  ``--host-only``
+evaluates only the host-group configs (CPU-safe, what tier-1 runs).
 """
 
 from __future__ import annotations
@@ -267,6 +276,10 @@ def cmd_dump(args) -> int:
     ckpt = man.get("checkpoint")
     if ckpt:
         print(f"checkpoint: {ckpt}")
+    extra = man.get("extra")
+    if extra:
+        # e.g. the backend-init watchdog's stuck stage + stage timeline
+        print(f"extra: {extra}")
     for plan in man.get("fault_plans", []):
         active = {k: v for k, v in plan.items()
                   if v not in (None, 0, 0.0) or k == "seed"}
@@ -283,6 +296,21 @@ def cmd_dump(args) -> int:
     nonzero = {k: v for k, v in sorted(counters.items()) if v}
     print(f"counters (nonzero): {nonzero}")
     return 0
+
+
+def cmd_perf_check(args) -> int:
+    from .perf import DEFAULT_BUDGETS_PATH, run_check
+
+    budgets = args.budgets
+    if budgets is None:
+        # repo-checkout default first (the file is checked in next to
+        # the package), falling back to CWD-relative
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        cand = os.path.join(repo, DEFAULT_BUDGETS_PATH)
+        budgets = cand if os.path.exists(cand) else DEFAULT_BUDGETS_PATH
+    return run_check(args.snapshot, budgets_path=budgets,
+                     host_only=args.host_only)
 
 
 def main(argv=None) -> int:
@@ -318,6 +346,19 @@ def main(argv=None) -> int:
     dp.add_argument("--json", action="store_true",
                     help="machine-readable output")
     dp.set_defaults(fn=cmd_dump)
+
+    pc = sub.add_parser(
+        "perf-check",
+        help="compare a bench.py artifact against the checked-in "
+             "perf budgets; exit 1 on regression")
+    pc.add_argument("snapshot", help="bench artifact JSON (the one-line "
+                                     "object bench.py prints)")
+    pc.add_argument("--budgets", default=None, metavar="PATH",
+                    help="budget file (default: artifacts/perf_budgets.json "
+                         "next to the package, else CWD-relative)")
+    pc.add_argument("--host-only", action="store_true",
+                    help="evaluate only host-group configs (CPU-safe)")
+    pc.set_defaults(fn=cmd_perf_check)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,531 @@
+"""Device-path telemetry: recompile sentinel + backend-init watchdog.
+
+PRs 3-4 made the *host* session datapath observable; the device path —
+Pallas kernels, the DigestPipeline, mesh programs — stayed dark: the
+round-5 bench artifact ends with an opaque ``"backend init hung
+(> 87s)"`` and the recompile hazards behind the round-2 ~2000x CDC
+regression (SURVEY.md §5) were guarded only by code comments.  This
+module extends the same zero-dependency ``obs`` discipline (hoisted
+``OBS.on`` gate, literal names, bounded rings) down to the device
+boundary.  JAX is never imported at module level — the session layer
+must stay importable (and hang-proof) in device-less processes.
+
+Three parts:
+
+* **Recompile sentinel** — :func:`jit_site` wraps a jitted callable
+  with a named call-site.  Per call (gate on) it detects whether the
+  call TRACED (a fresh specialization) vs hit the jit cache, via the
+  callable's own lowering-cache size when it exposes one
+  (``PjitFunction._cache_size``) and an arg-shape-signature closure
+  otherwise.  Every trace records a ``device.jit.trace`` event with
+  the site and the arg-shape signature; :data:`SENTINEL` aggregates
+  per-site calls/traces; :class:`RecompileBudget` flags any site that
+  recompiles more than N times per process — the unbucketed-batch-size
+  failure mode ``ops/blake2b.py`` buckets against (jit specializes per
+  (B, nblocks); an unbucketed stream recompiles every distinct count,
+  minutes each on the CPU scanned path).
+* **Backend-init watchdog** — :class:`BackendInitWatchdog` wraps
+  backend bring-up in a ``backend.init`` span with staged progress
+  events (``platform_probe`` -> ``first_device_call`` ->
+  ``first_compile``) and a deadline timer that, instead of today's
+  opaque multi-minute hang, emits ``backend.init.stuck`` naming the
+  stage it is stuck IN and dumps a flight-recorder bundle (when armed)
+  whose manifest carries the stage and elapsed seconds.
+* **Device gauges / engine attribution** — :func:`sample_device_gauges`
+  snapshots live-buffer count and device bytes-in-use at phase
+  boundaries (only when a backend is ALREADY initialized: the sampler
+  must never be the thing that wedges); :func:`note_engine` records
+  ``device.engine.select`` events when a routing layer's
+  pallas/native/host choice changes.
+
+Catalog and budget: OBSERVABILITY.md (device-telemetry section).
+"""
+# datlint: disable-file=obs-discipline  — plumbing: jit_site/note_engine
+# forward caller-supplied site/component names into events by design;
+# the greppable literal names live at their call sites.
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from .events import emit as _emit
+from .metrics import OBS as _OBS
+from .metrics import counter as _counter
+from .metrics import gauge as _gauge
+from . import flight as _flight
+from . import tracing as _tracing
+
+__all__ = [
+    "SENTINEL",
+    "JitSentinel",
+    "RecompileBudget",
+    "BackendInitWatchdog",
+    "jit_site",
+    "note_engine",
+    "sample_device_gauges",
+    "DEFAULT_RECOMPILE_BUDGET",
+]
+
+# jit-cache traffic across ALL sites (per-site split: SENTINEL.snapshot)
+_M_JIT_CALLS = _counter("device.jit.calls")
+_M_JIT_TRACES = _counter("device.jit.traces")
+_G_LIVE_BUFFERS = _gauge("device.mem.live_buffers")
+_G_BYTES_IN_USE = _gauge("device.mem.bytes_in_use")
+
+# traces per site before the sentinel flags it: generous enough for the
+# legitimate power-of-two bucket ladder (a handful of (B, nblocks)
+# shapes per engine), small enough to catch an unbucketed stream within
+# its first dozen batches instead of after a 2000x regression ships
+DEFAULT_RECOMPILE_BUDGET = 8
+
+# shape-signature sets are bounded: a pathological site (the exact bug
+# class the sentinel hunts) would otherwise grow the set forever — past
+# the cap every unseen signature still COUNTS as a trace, it just is
+# not retained
+_MAX_RETAINED_SIGS = 256
+
+
+def _sig_of(v) -> object:
+    shape = getattr(v, "shape", None)
+    if shape is not None:
+        return (tuple(shape), str(getattr(v, "dtype", "")))
+    if isinstance(v, (bool, int, float, str, bytes, type(None))):
+        return v
+    if isinstance(v, (tuple, list)):
+        return (type(v).__name__,) + tuple(_sig_of(x) for x in v)
+    return type(v).__name__
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    """Hashable abstract signature of one call: shapes/dtypes for
+    array-likes, values for static scalars — the same axes jit
+    specializes on, so a new signature approximates a new trace."""
+    sig = tuple(_sig_of(a) for a in args)
+    if kwargs:
+        sig += tuple((k, _sig_of(kwargs[k])) for k in sorted(kwargs))
+    return sig
+
+
+def _sig_str(sig: tuple) -> str:
+    """Compact display form for events ("(8, 16)u32" style)."""
+
+    def one(p) -> str:
+        if isinstance(p, tuple) and len(p) == 2 and isinstance(p[0], tuple):
+            return f"{p[0]}{p[1]}"
+        return repr(p)
+
+    return ",".join(one(p) for p in sig)
+
+
+class _SiteStats:
+    """Per-site aggregate; shared by every wrapper registered under one
+    name (e.g. one mesh program per mesh, one site name)."""
+
+    __slots__ = ("name", "lock", "calls", "traces", "sigs", "flagged",
+                 "last_signature")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.lock = threading.Lock()
+        self.calls = 0
+        self.traces = 0
+        self.sigs: set = set()
+        self.flagged = False
+        self.last_signature: Optional[str] = None
+
+
+class JitSentinel:
+    """Process-global per-site trace/call accounting."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sites: dict[str, _SiteStats] = {}
+
+    def _stats(self, name: str) -> _SiteStats:
+        with self._lock:
+            st = self._sites.get(name)
+            if st is None:
+                st = self._sites[name] = _SiteStats(name)
+            return st
+
+    def snapshot(self) -> dict:
+        """``{site: {"calls": n, "traces": n}}`` for every site that has
+        been CALLED (registered-but-idle sites are omitted)."""
+        with self._lock:
+            sites = list(self._sites.values())
+        out = {}
+        for st in sites:
+            with st.lock:
+                if st.calls:
+                    out[st.name] = {"calls": st.calls, "traces": st.traces}
+        return out
+
+    def over_budget(self, limit: int = DEFAULT_RECOMPILE_BUDGET) -> list[dict]:
+        """Sites whose trace count exceeds ``limit``, worst first."""
+        out = []
+        for name, rec in self.snapshot().items():
+            if rec["traces"] > limit:
+                out.append({"site": name, **rec})
+        out.sort(key=lambda r: -r["traces"])
+        return out
+
+    def reset_for_tests(self) -> None:
+        """Zero every site's VALUES in place, keeping the registrations
+        (and the stats objects module-level ``jit_site`` wrappers hold)
+        intact — clearing the dict would orphan those handles, exactly
+        the hazard ``Registry.reset`` documents."""
+        with self._lock:
+            sites = list(self._sites.values())
+        for st in sites:
+            with st.lock:
+                st.calls = 0
+                st.traces = 0
+                st.sigs.clear()
+                st.flagged = False
+                st.last_signature = None
+
+
+SENTINEL = JitSentinel()
+
+
+class RecompileBudget:
+    """The enforceable face of the sentinel: ``check()`` returns every
+    site recompiling more than ``limit`` times this process (empty =
+    healthy), for callers that want a hard gate rather than events."""
+
+    def __init__(self, limit: int = DEFAULT_RECOMPILE_BUDGET,
+                 sentinel: JitSentinel = SENTINEL):
+        if limit < 1:
+            raise ValueError("recompile budget must be >= 1")
+        self.limit = limit
+        self._sentinel = sentinel
+
+    def check(self) -> list[dict]:
+        return self._sentinel.over_budget(self.limit)
+
+    def ok(self) -> bool:
+        return not self.check()
+
+
+_trace_state_clean: Optional[Callable[[], bool]] = None
+
+
+def _outside_jax_trace() -> bool:
+    """True when we are NOT inside a jax trace.  Sites wrapped by the
+    sentinel are also called from INSIDE other jitted programs (mesh
+    steps call ``blake2b_packed``, ``diff_root_guided_packed`` calls
+    ``diff_root_guided``); those invocations run once per OUTER trace
+    and never per execution, so counting them would report
+    calls == traces — the exact pathology signature the sentinel
+    exists to flag — for perfectly healthy inner sites.  Bound lazily:
+    jax is never imported here, only observed if already loaded."""
+    global _trace_state_clean
+    fn = _trace_state_clean
+    if fn is None:
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return True  # no jax in the process: nothing can be tracing
+        try:
+            fn = jax.core.trace_state_clean
+        except Exception:
+            fn = lambda: True  # noqa: E731 — no introspection available
+        _trace_state_clean = fn
+    try:
+        return fn()
+    except Exception:
+        return True
+
+
+class _JitSite:
+    """The wrapper :func:`jit_site` returns.  Disabled path: one gate
+    attribute load, then straight through to the wrapped callable.
+    Trace-time invocations (the wrapper called while an OUTER program
+    traces) bypass accounting entirely — see :func:`_outside_jax_trace`.
+    Unknown attributes (``lower``, ``clear_cache``, ...) delegate to the
+    wrapped jit so the site stays a drop-in."""
+
+    __slots__ = ("_fn", "_stats", "_cache_size", "_cache_seen")
+
+    def __init__(self, name: str, fn: Callable):
+        self._fn = fn
+        self._stats = SENTINEL._stats(name)
+        cs = getattr(fn, "_cache_size", None)
+        self._cache_size = cs if callable(cs) else None
+        # high-water of the jit cache size this wrapper has accounted
+        # for: the trace CLAIM happens under the stats lock against it,
+        # so two threads overlapping one trace charge it exactly once
+        self._cache_seen: Optional[int] = None
+
+    @property
+    def site(self) -> str:
+        return self._stats.name
+
+    @property
+    def __wrapped__(self) -> Callable:
+        return self._fn
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def __call__(self, *args, **kwargs):
+        if not _OBS.on:
+            return self._fn(*args, **kwargs)
+        if not _outside_jax_trace():
+            return self._fn(*args, **kwargs)
+        cs = self._cache_size
+        before = cs() if cs is not None else None
+        out = self._fn(*args, **kwargs)
+        sig = None
+        st = self._stats
+        with st.lock:
+            st.calls += 1
+            if cs is not None:
+                # a trace happened iff the cache grew DURING this call
+                # (growth outside the sampling window — e.g. trace-time
+                # bypassed invocations compiling under an outer jit —
+                # never counts), and is CLAIMED against the high-water
+                # under the lock: a cache-hit call overlapping another
+                # thread's trace sees the growth already claimed and
+                # stays silent.  (Two DISTINCT concurrent traces can
+                # collapse to one count — undercount, never a false
+                # recompile alarm.)
+                now = cs()
+                seen = self._cache_seen
+                traced = now > before and (seen is None or now > seen)
+                if seen is None or now > seen:
+                    self._cache_seen = now
+                if traced:
+                    sig = _signature(args, kwargs)
+            else:
+                sig = _signature(args, kwargs)
+                traced = sig not in st.sigs
+            if sig is not None and len(st.sigs) < _MAX_RETAINED_SIGS:
+                st.sigs.add(sig)
+            if traced:
+                st.traces += 1
+                traces = st.traces
+                st.last_signature = _sig_str(sig)
+                flag = traces > DEFAULT_RECOMPILE_BUDGET and not st.flagged
+                if flag:
+                    st.flagged = True
+            else:
+                traces = st.traces
+                flag = False
+        _M_JIT_CALLS.inc()
+        if traced:
+            _M_JIT_TRACES.inc()
+            _emit("device.jit.trace", site=st.name, signature=_sig_str(sig),
+                  traces=traces)
+            if flag:
+                # the unbucketed-batch-size failure mode, caught live:
+                # one event per site per process, however long it runs
+                _emit("device.jit.recompile_budget", site=st.name,
+                      traces=traces, budget=DEFAULT_RECOMPILE_BUDGET,
+                      signature=_sig_str(sig))
+        return out
+
+
+def jit_site(name: str, fn: Callable) -> _JitSite:
+    """Register ``fn`` (a jitted callable) as the named call-site and
+    return the sentinel wrapper.  ``name`` is a dot-separated literal
+    (the obs-discipline rule enforces greppability at call sites)."""
+    return _JitSite(name, fn)
+
+
+# -- engine-selection attribution ---------------------------------------------
+
+# last engine noted per component: the select event records CHANGES,
+# not every dispatch — a steady pipeline emits one line, a flapping
+# router shows every flap
+_engine_lock = threading.Lock()
+_engine_last: dict[str, str] = {}
+
+
+def note_engine(component: str, engine: str, key=None, **fields) -> None:
+    """Record ``device.engine.select`` when ``component``'s routed
+    engine changes (pallas / xla-scan / native / hashlib / ...).  Call
+    sites guard with ``if _OBS.on:``; this function does not re-check
+    the gate.
+
+    ``key`` widens the change-only memo for decisions that are
+    legitimately per-shape: the blake2b batch edge picks its engine
+    per block-count BUCKET, and a payload mix straddling the pallas
+    item floor would otherwise flap pallas<->xla-scan on every
+    dispatch, churning the bounded event ring with noise."""
+    memo = component if key is None else (component, key)
+    with _engine_lock:
+        if _engine_last.get(memo) == engine:
+            return
+        _engine_last[memo] = engine
+    _emit("device.engine.select", component=component, engine=engine,
+          **fields)
+
+
+def reset_engine_notes() -> None:
+    """Forget the change-only memo so the NEXT dispatch re-emits every
+    component's ``device.engine.select``.  Capture boundaries call this
+    alongside clearing the event/span rings (bench's per-config trace
+    export, the test fixture) — a cleared ring with a warm memo would
+    silently drop engine attribution from every later capture."""
+    with _engine_lock:
+        _engine_last.clear()
+
+
+# -- device memory gauges -----------------------------------------------------
+
+
+def sample_device_gauges() -> bool:
+    """Update ``device.mem.live_buffers`` / ``device.mem.bytes_in_use``
+    from an ALREADY-initialized jax backend; returns True when a sample
+    was taken.  Never initializes a backend itself: on a wedged device
+    tunnel that first init is exactly the hang the watchdog exists to
+    attribute, so an uninitialized process samples nothing."""
+    if not _OBS.on:
+        return False
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return False
+    try:
+        import jax  # noqa: PLC0415 — guaranteed imported already
+
+        _G_LIVE_BUFFERS.set(float(len(jax.live_arrays())))
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if "bytes_in_use" in stats:
+            _G_BYTES_IN_USE.set(float(stats["bytes_in_use"]))
+        return True
+    except Exception:
+        return False
+
+
+# -- backend-init watchdog ----------------------------------------------------
+
+# the canonical stage ladder (callers may add their own stages between;
+# the names below are what bench.py's probe and the docs use)
+INIT_STAGES = ("platform_probe", "first_device_call", "first_compile")
+
+
+class BackendInitWatchdog:
+    """Deadline + staged progress around backend bring-up.
+
+    Usage::
+
+        with BackendInitWatchdog(deadline_s=90) as wd:
+            wd.stage("platform_probe")
+            import jax; jax.config.update(...)
+            wd.stage("first_device_call")
+            jax.devices()
+            wd.stage("first_compile")
+            jax.jit(f)(x)
+
+    Each ``stage()`` emits ``backend.init.stage`` and samples the
+    device gauges.  If the deadline expires before ``__exit__``, the
+    timer thread emits ``backend.init.stuck`` naming the stage the init
+    is stuck IN and dumps a flight bundle (reason
+    ``backend-init-stuck``) whose manifest ``extra`` carries the stage,
+    elapsed seconds, and the full stage timeline — the answer the
+    round-5 ``"backend init hung (> 87s)"`` string never gave.  The
+    watchdog only OBSERVES: the wrapped init keeps running (callers
+    own their own timeouts/subprocesses)."""
+
+    def __init__(self, deadline_s: float = 90.0,
+                 on_timeout: Optional[Callable[["BackendInitWatchdog"], None]]
+                 = None):
+        if deadline_s <= 0:
+            raise ValueError("deadline must be positive")
+        self.deadline_s = deadline_s
+        self.fired = False
+        self.finished = False
+        self.stages: list[tuple[str, float]] = []  # (name, elapsed_s)
+        self._on_timeout = on_timeout
+        self._lock = threading.Lock()
+        self._t0 = 0.0
+        self._timer: Optional[threading.Timer] = None
+        self._span = None
+
+    @property
+    def current_stage(self) -> Optional[str]:
+        with self._lock:
+            return self.stages[-1][0] if self.stages else None
+
+    @property
+    def elapsed_s(self) -> float:
+        return time.monotonic() - self._t0
+
+    def __enter__(self) -> "BackendInitWatchdog":
+        self._t0 = time.monotonic()
+        self._span = _tracing.trace_span("backend.init",
+                                         deadline_s=self.deadline_s)
+        self._span.__enter__()
+        self._timer = threading.Timer(self.deadline_s, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def stage(self, name: str) -> None:
+        """Enter a named init stage (names are literals at call sites —
+        same greppability contract as event names)."""
+        elapsed = self.elapsed_s
+        with self._lock:
+            self.stages.append((name, round(elapsed, 3)))
+        if _OBS.on:
+            _emit("backend.init.stage", stage=name,
+                  elapsed_s=round(elapsed, 3))
+        sample_device_gauges()
+
+    def _fire(self) -> None:
+        with self._lock:
+            if self.finished:
+                return
+            self.fired = True
+            stage = self.stages[-1][0] if self.stages else None
+            timeline = list(self.stages)
+        elapsed = round(self.elapsed_s, 3)
+        if _OBS.on:
+            _emit("backend.init.stuck", stage=stage, elapsed_s=elapsed,
+                  deadline_s=self.deadline_s)
+        # bundle FIRST: sampling gauges talks to the very backend that
+        # just proved itself wedged and can block this timer thread
+        # forever — the post-mortem must already be on disk by then
+        # (the registry in the bundle carries the gauges the last
+        # healthy stage() sampled).
+        _flight.dump(
+            "backend-init-stuck",
+            extra={"stage": stage, "elapsed_s": elapsed,
+                   "deadline_s": self.deadline_s,
+                   "stages": [{"stage": s, "at_s": at} for s, at in timeline]},
+        )
+        cb = self._on_timeout
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass  # an observer callback must never break the init
+        # last, for the same reason the bundle came first: if the
+        # wedged backend hangs this sample, only the (daemon) timer
+        # thread is lost
+        sample_device_gauges()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        with self._lock:
+            self.finished = True
+        if self._timer is not None:
+            self._timer.cancel()
+            # an init that completes RIGHT AT the deadline races a
+            # _fire already past its finished check — by then the init
+            # really did exceed the deadline, so the stuck record is
+            # earned; joining just makes the ordering deterministic
+            # (stuck/dump land before done, and self.fired is stable
+            # once this returns)
+            if self._timer.is_alive():
+                self._timer.join(timeout=2.0)
+        if _OBS.on:
+            _emit("backend.init.done", elapsed_s=round(self.elapsed_s, 3),
+                  stages=len(self.stages), stuck=self.fired,
+                  error=(exc_type.__name__ if exc_type else None))
+        sample_device_gauges()
+        if self._span is not None:
+            self._span.__exit__(exc_type, exc, tb)
+        return False

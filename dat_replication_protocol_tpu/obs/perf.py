@@ -1,0 +1,198 @@
+"""Perf-budget regression gate: bench artifacts become a contract.
+
+Five rounds of ``BENCH_*.json`` files accumulated as an unread trail —
+nothing failed when a number regressed (the round-2 ~2000x CDC
+regression shipped exactly that way).  This module compares one
+``bench.py --metrics`` artifact (the one-line JSON bench prints)
+against checked-in per-metric budgets and returns a verdict; the CLI
+(``python -m dat_replication_protocol_tpu.obs perf-check``) exits
+nonzero on regression, so CI and the driver can gate on it.
+
+Budget file format (``artifacts/perf_budgets.json``)::
+
+    {
+      "configs": {
+        "<config name>": {
+          "group": "host" | "device",
+          "checks": [
+            {"field": "value",          # key in the config's result
+             "direction": "higher",     # "higher" = bigger is better
+             "reference": 16691.4,      # from BENCH history (PERF.md)
+             "ratio": 0.05,             # fail below reference*ratio
+             "reduced_ratio": 0.02}     # looser bound when the result
+          ]                             # says reduced_config: true
+        }
+      }
+    }
+
+Semantics:
+
+* ``direction: "higher"`` fails when ``value < reference * ratio``;
+  ``"lower"`` (latencies) fails when ``value > reference / ratio``.
+* **Reduced-config aware**: a result carrying ``reduced_config: true``
+  (bench's own in-band below-full-shape marker) is judged against
+  ``reduced_ratio`` when present — quick/CI shapes get the loose
+  bound, a full-config capture the real one.
+* ``--host-only`` evaluates only ``group: "host"`` configs (1/2/6 run
+  with no JAX backend at all) — the CPU-safe tier-1 mode.
+* A budgeted config that is missing from the snapshot, or carries an
+  ``"error"``, fails — a gate that passes on absent data is not a gate
+  (``"optional": true`` on the config entry downgrades that to a skip,
+  for device configs that legitimately vanish on device-less runners).
+
+Ratios are deliberately generous (PERF.md: budgets are set from BENCH
+history at ~5-20x headroom): the gate exists to catch order-of-
+magnitude cliffs mechanically, not to flake on shared-chip noise.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+__all__ = ["load_budgets", "check_snapshot", "DEFAULT_BUDGETS_PATH"]
+
+DEFAULT_BUDGETS_PATH = "artifacts/perf_budgets.json"
+
+
+def load_budgets(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        budgets = json.load(f)
+    if "configs" not in budgets or not isinstance(budgets["configs"], dict):
+        raise ValueError(f"{path}: budget file has no 'configs' table")
+    return budgets
+
+
+def _check_one(config: str, result: dict, check: dict) -> dict:
+    """Evaluate one check against one config result; returns a verdict
+    row: {config, field, status: ok|fail|skip, ...}."""
+    field = check.get("field", "value")
+    direction = check.get("direction", "higher")
+    reference = check.get("reference")
+    ratio = check.get("ratio", 0.1)
+    reduced = bool(result.get("reduced_config"))
+    if reduced and "reduced_ratio" in check:
+        ratio = check["reduced_ratio"]
+    if (reference is None or direction not in ("higher", "lower")
+            or not isinstance(ratio, (int, float)) or ratio <= 0):
+        # a malformed budget entry is a per-check failure row, never a
+        # traceback — the gate's contract is exit 1 + a readable report
+        return {"config": config, "field": field, "status": "fail",
+                "detail": "malformed check (needs reference, a "
+                          "higher/lower direction, and a positive ratio)"}
+    value = result.get(field)
+    if not isinstance(value, (int, float)):
+        return {"config": config, "field": field, "status": "fail",
+                "value": value,
+                "detail": f"field {field!r} missing or non-numeric"}
+    if direction == "higher":
+        bound = reference * ratio
+        ok = value >= bound
+        rel = "<" if not ok else ">="
+    else:
+        bound = reference / ratio
+        ok = value <= bound
+        rel = ">" if not ok else "<="
+    return {
+        "config": config, "field": field,
+        "status": "ok" if ok else "fail",
+        "value": value, "bound": bound, "reference": reference,
+        "ratio": ratio, "direction": direction, "reduced": reduced,
+        "detail": f"{field}={value:g} {rel} bound {bound:g} "
+                  f"(reference {reference:g} x ratio {ratio:g}"
+                  f"{', reduced config' if reduced else ''})",
+    }
+
+
+def check_snapshot(snapshot: dict, budgets: dict,
+                   host_only: bool = False) -> list[dict]:
+    """Evaluate every budgeted config against a bench artifact dict
+    (the parsed one-line JSON).  Returns verdict rows; callers gate on
+    ``any(r["status"] == "fail")``."""
+    configs = snapshot.get("configs", {})
+    rows: list[dict] = []
+    for name, entry in budgets["configs"].items():
+        group = entry.get("group", "device")
+        if host_only and group != "host":
+            rows.append({"config": name, "field": "-", "status": "skip",
+                         "detail": f"group {group!r} skipped (--host-only)"})
+            continue
+        result = configs.get(name)
+        optional = bool(entry.get("optional"))
+        if result is None or "error" in (result or {}):
+            status = "skip" if optional else "fail"
+            why = ("absent from snapshot" if result is None
+                   else f"errored: {result['error']}")
+            rows.append({"config": name, "field": "-", "status": status,
+                         "detail": f"config {why}"
+                                   + (" (optional)" if optional else "")})
+            continue
+        checks = entry.get("checks")
+        if not isinstance(checks, list) or not checks:
+            # a budgeted config with nothing evaluable would pass
+            # vacuously — a gate that passes on absent checks is not a
+            # gate (same contract as missing/errored configs)
+            rows.append({"config": name, "field": "-", "status": "fail",
+                         "detail": "budget entry has no evaluable checks"})
+            continue
+        for check in checks:
+            rows.append(_check_one(name, result, check))
+    return rows
+
+
+def run_check(snapshot_path: str, budgets_path: str = DEFAULT_BUDGETS_PATH,
+              host_only: bool = False,
+              out=None) -> int:
+    """Load, evaluate, report (one line per check to ``out``, default
+    stdout); returns the process exit code (1 on any failure)."""
+    import sys
+
+    out = out if out is not None else sys.stdout
+    with open(snapshot_path, encoding="utf-8") as f:
+        snapshot = _parse_snapshot(f.read(), snapshot_path)
+    budgets = load_budgets(budgets_path)
+    rows = check_snapshot(snapshot, budgets, host_only=host_only)
+    failed = 0
+    for r in rows:
+        mark = {"ok": "OK  ", "fail": "FAIL", "skip": "skip"}[r["status"]]
+        print(f"{mark} {r['config']:<12} {r['detail']}", file=out)
+        failed += r["status"] == "fail"
+    verdict = "REGRESSION" if failed else "within budget"
+    print(f"perf-check: {len(rows)} check(s), {failed} failed — {verdict}",
+          file=out)
+    return 1 if failed else 0
+
+
+def _parse_snapshot(text: str, path: str) -> dict:
+    """A bench artifact file is one JSON object, but driver logs wrap
+    noise around it — and may interleave OTHER JSON lines (periodic
+    ``--stats-fd`` snapshots).  Bench prints its artifact LAST, so scan
+    lines in reverse and prefer the first object that actually carries
+    a ``configs`` table; fall back to the last parseable object."""
+    text = text.strip()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        fallback = None
+        for ln in reversed(text.splitlines()):
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                obj = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "configs" in obj:
+                return obj
+            if fallback is None:
+                fallback = obj
+        if fallback is not None:
+            return fallback
+        raise ValueError(f"{path}: no parseable bench JSON found")
+
+
+def find_first_failure(rows: list[dict]) -> Optional[dict]:
+    for r in rows:
+        if r["status"] == "fail":
+            return r
+    return None

@@ -37,7 +37,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.device import jit_site as _jit_site
+from ..obs.device import note_engine as _note_engine
+from ..obs.metrics import OBS as _OBS
+from ..obs.metrics import counter as _counter
 from .u64 import U32, add64, add64_3, ror64
+
+# device-transfer attribution (OBSERVABILITY.md device-telemetry
+# catalog): message words staged host->device per batch dispatch, and
+# digest bytes fetched device->host at collect
+_M_H2D = _counter("device.h2d.bytes")
+_M_D2H = _counter("device.d2h.bytes")
 
 DIGEST_SIZE = 32  # BLAKE2b-256 default, dat's content-hash size
 BLOCK_BYTES = 128
@@ -334,6 +344,11 @@ def blake2b_packed(mh, ml, lengths, digest_size: int = DIGEST_SIZE):
     return jnp.stack(carry[:8], axis=1), jnp.stack(carry[8:], axis=1)
 
 
+# recompile sentinel (obs.device): jit specializes per (B, nblocks) —
+# this is THE site the power-of-two bucketing below exists to protect
+blake2b_packed = _jit_site("ops.blake2b.packed", blake2b_packed)
+
+
 @jax.jit
 def blake2b_update(hh, hl, t_hi, t_lo, mh, ml, seg_lengths, is_last):
     """Advance chaining states over one packed segment per item.
@@ -389,6 +404,9 @@ def blake2b_update(hh, hl, t_hi, t_lo, mh, ml, seg_lengths, is_last):
         nt_hi,
         nt_lo,
     )
+
+
+blake2b_update = _jit_site("ops.blake2b.update", blake2b_update)
 
 
 class Blake2bStream:
@@ -559,6 +577,13 @@ def blake2b_batch_begin(
             from .blake2b_pallas import blake2b_packed_pallas as packed_fn
         else:
             packed_fn = blake2b_packed
+        if _OBS.on:
+            # keyed per bucket: the engine choice is per block-count
+            # bucket, and the change-only memo must not flap when a
+            # payload mix straddles the pallas item floor
+            _note_engine("blake2b.batch",
+                         "pallas" if pallas_bucket else "xla-scan",
+                         key=nb, items=len(idxs), nblocks=nb)
         # pad the batch axis to a power of two as well: jit specializes
         # per (B, nblocks), so unbucketed batch sizes recompile every
         # distinct count (minutes each on the CPU scanned path).  Empty
@@ -567,6 +592,8 @@ def blake2b_batch_begin(
         Bp = _bucket_nblocks(len(batch))
         batch += [b""] * (Bp - len(batch))
         mh, ml, lengths = pack_payloads(batch, nblocks=nb)
+        if _OBS.on:
+            _M_H2D.inc(mh.nbytes + ml.nbytes + lengths.nbytes)
         hh, hl = packed_fn(
             jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths), digest_size
         )
@@ -575,6 +602,9 @@ def blake2b_batch_begin(
     def collect() -> list[bytes]:
         out: list[bytes | None] = [None] * len(payloads)
         for idxs, hh, hl in handles:
+            if _OBS.on:
+                # two (B, 8) u32 halves fetched per bucket = 64 B/item
+                _M_D2H.inc(64 * len(idxs))
             for i, d in zip(idxs, digests_to_bytes(hh, hl, digest_size)):
                 out[i] = d
         return out  # type: ignore[return-value]

@@ -38,6 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .blake2b import _IV_HI, _IV_LO, DIGEST_SIZE, compress_soa
+from ..obs.device import jit_site as _jit_site
 from .u64 import U32
 
 # batch items per kernel tile: 8 sublanes x BTL lanes
@@ -317,6 +318,11 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
         interpret=interpret,
     )(*inputs)
     return outh, outl
+
+
+# recompile sentinel: the kernel specializes per (nblocks, B) tile shape
+# plus every static knob the bench calibrates over
+blake2b_native = _jit_site("ops.blake2b_pallas.native", blake2b_native)
 
 
 def to_native(mh, ml, lengths, block_items: int = 1024):

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.device import jit_site as _jit_site
+
 from .blake2b import compress, initial_state
 from .u64 import U32
 
@@ -121,6 +123,9 @@ def build_tree(leaf_hh, leaf_hl):
     return tuple(levels_hh), tuple(levels_hl)
 
 
+build_tree = _jit_site("ops.merkle.build_tree", build_tree)
+
+
 def root(leaf_hh, leaf_hl):
     """Root digest only: (1, 4) hi/lo word pairs."""
     hhs, hls = build_tree(leaf_hh, leaf_hl)
@@ -170,6 +175,9 @@ def diff_root_guided(a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl):
     return mask, (hh[:1], hl[:1]), (hh[1:], hl[1:])
 
 
+diff_root_guided = _jit_site("ops.merkle.diff_root_guided", diff_root_guided)
+
+
 @jax.jit
 def update_leaves(levels_hh, levels_hl, idx, new_hh, new_hl):
     """Incrementally apply K leaf updates to a built tree.
@@ -205,6 +213,9 @@ def update_leaves(levels_hh, levels_hl, idx, new_hh, new_hl):
     return tuple(new_levels_hh), tuple(new_levels_hl)
 
 
+update_leaves = _jit_site("ops.merkle.update_leaves", update_leaves)
+
+
 @jax.jit
 def diff_root_guided_packed(a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl):
     """:func:`diff_root_guided` with the leaf mask packed 32 bools/word.
@@ -226,6 +237,11 @@ def diff_root_guided_packed(a_leaf_hh, a_leaf_hl, b_leaf_hh, b_leaf_hl):
         axis=1,
     )
     return bits, root_a, root_b
+
+
+diff_root_guided_packed = _jit_site(
+    "ops.merkle.diff_root_guided_packed", diff_root_guided_packed
+)
 
 
 # ---------------------------------------------------------------------------

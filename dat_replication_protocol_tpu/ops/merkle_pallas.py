@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from .blake2b import _IV_HI, _IV_LO, _ROUND_SIGMA, compress_soa
 from .merkle import DIGEST_SIZE
 from .u64 import U32
+from ..obs.device import jit_site as _jit_site
 
 _LANE = 128
 _SUBLANE = 8
@@ -103,6 +104,9 @@ def merkle_level_native(mh, ml, block_items: int = 1024,
         interpret=interpret,
     )(*inputs)
     return outh, outl
+
+
+merkle_level_native = _jit_site("ops.merkle_pallas.level", merkle_level_native)
 
 
 def merkle_level_pallas(hh, hl, block_items: int = 1024,

@@ -54,6 +54,9 @@ import numpy as np
 
 from ..utils.trace import span
 from .u64 import U32
+from ..obs.device import jit_site as _jit_site
+from ..obs.device import note_engine as _note_engine
+from ..obs.metrics import OBS as _OBS
 
 WINDOW = 64  # bytes: contributions shift out of the 64-bit state after this
 _C1 = np.uint32(0x9E3779B1)  # golden-ratio odd constants
@@ -132,6 +135,9 @@ def gear_candidates_tiled(words, avg_bits: int = 13):
     return jnp.transpose(bits, (1, 0, 2)).reshape(T, -1)
 
 
+gear_candidates_tiled = _jit_site("ops.rabin.candidates_tiled", gear_candidates_tiled)
+
+
 NO_HIT = GROUP  # first-hit sentinel: no candidate in this group
 
 
@@ -175,6 +181,9 @@ def gear_first_tiled(words, avg_bits: int = 13):
     h0 = (jnp.zeros((T,), U32), jnp.zeros((T,), U32))
     _, firsts = jax.lax.scan(group_step, h0, groups)  # (ngroups, T)
     return jnp.transpose(firsts, (1, 0))
+
+
+gear_first_tiled = _jit_site("ops.rabin.first_tiled", gear_first_tiled)
 
 
 # ---------------------------------------------------------------------------
@@ -309,6 +318,9 @@ def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
     return occ, offs
 
 
+_extract_first_occ = _jit_site("ops.rabin.extract_first_occ", _extract_first_occ)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("T", "stride", "avg_bits", "cap", "cap2", "use_pallas",
@@ -379,6 +391,9 @@ def _extract_candidates(words_padded, pre_row, T: int, stride: int,
     (pidx,) = jnp.nonzero(bitsel.reshape(-1), size=cap2, fill_value=0)
     positions = pos.reshape(-1)[pidx]
     return positions, ncand, nword
+
+
+_extract_candidates = _jit_site("ops.rabin.extract_candidates", _extract_candidates)
 
 
 def _popcount32(x):
@@ -712,8 +727,12 @@ def chunk_stream(
             buf, avg_bits, -1 if clamped is None else clamped
         )
         if cands is not None:
+            if _OBS.on:
+                _note_engine("cdc.chunk", "native-host", bytes=length)
             return _greedy_select(cands, length, min_size, max_size)
 
+    if _OBS.on:
+        _note_engine("cdc.chunk", effective_route(), bytes=length)
     candidates = _device_candidates(
         buf, avg_bits, tile_bytes, slab_tiles, thin_bits
     )

@@ -24,6 +24,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .rabin import GROUP, NO_HIT, PACK, _gear_step, _popcount32
 from .u64 import U32
+from ..obs.device import jit_site as _jit_site
 
 _SUBLANE = 8
 _LANE = 128
@@ -356,6 +357,9 @@ def gear_window_first_pallas(words, avg_bits: int, thin_bits: int,
     return out[: T * nwpt].astype(jnp.int32)
 
 
+gear_window_first_pallas = _jit_site("ops.rabin_pallas.window_first", gear_window_first_pallas)
+
+
 def _kernel_first(wref, oref, sth_ref, stl_ref, *, avg_bits: int, ilp: int):
     """First-hit-per-group variant of :func:`_kernel`: emits one u32 per
     GROUP (the group-local offset of the first candidate, or NO_HIT)
@@ -452,6 +456,9 @@ def gear_first_pallas(words, avg_bits: int = 13,
     return out[:T]
 
 
+gear_first_pallas = _jit_site("ops.rabin_pallas.first", gear_first_pallas)
+
+
 @functools.partial(
     jax.jit, static_argnames=("avg_bits", "block_tiles", "interpret", "ilp")
 )
@@ -480,3 +487,6 @@ def gear_candidates_pallas(words, avg_bits: int = 13,
         bits.reshape(ng, GROUP // PACK, Tp), (2, 0, 1)
     ).reshape(Tp, ng * GROUP // PACK)
     return out[:T]
+
+
+gear_candidates_pallas = _jit_site("ops.rabin_pallas.candidates", gear_candidates_pallas)

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..obs.device import jit_site as _jit_site
 from ..ops.rabin import GROUP, _PREFIX_WORDS, gear_candidates_tiled
 from ..ops.u64 import U32
 from .mesh import DATA_AXIS, Mesh
@@ -63,14 +64,17 @@ def _scan_program(mesh: Mesh, avg_bits: int, use_pallas: bool):
             return gear_candidates_pallas(rows, avg_bits)
         return gear_candidates_tiled(rows, avg_bits)
 
-    return jax.jit(
-        shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS), P()),
-            out_specs=P(DATA_AXIS),
-            check_vma=False,
-        )
+    return _jit_site(
+        "parallel.cdc_mesh.scan",
+        jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS), P()),
+                out_specs=P(DATA_AXIS),
+                check_vma=False,
+            )
+        ),
     )
 
 

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..obs.device import jit_site as _jit_site
 from ..ops import merkle
 from ..ops.blake2b import blake2b_packed
 from ..ops.u64 import U32
@@ -132,14 +133,17 @@ def _digest_root_program(mesh: Mesh):
 
     sharded = P(DATA_AXIS)
     rep = P()
-    return jax.jit(
-        shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(sharded, sharded, sharded),
-            out_specs=(sharded, sharded, rep, rep, rep, rep),
-            check_vma=False,
-        )
+    return _jit_site(
+        "parallel.mesh.digest_root",
+        jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(sharded, sharded, sharded),
+                out_specs=(sharded, sharded, rep, rep, rep, rep),
+                check_vma=False,
+            )
+        ),
     )
 
 
@@ -181,14 +185,17 @@ def _sharded_diff_program(mesh: Mesh):
 
     sharded = P(DATA_AXIS)
     rep = P()
-    return jax.jit(
-        shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(sharded, sharded, sharded, sharded),
-            out_specs=(sharded, rep, rep, rep, rep),
-            check_vma=False,
-        )
+    return _jit_site(
+        "parallel.mesh.sharded_diff",
+        jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(sharded, sharded, sharded, sharded),
+                out_specs=(sharded, rep, rep, rep, rep),
+                check_vma=False,
+            )
+        ),
     )
 
 
@@ -208,14 +215,17 @@ def _sharded_sketch_program(mesh: Mesh, log2_slots: int):
             sketch_table(rec_hh, rec_hl, slots, nslots), DATA_AXIS
         )
 
-    return jax.jit(
-        shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=P(),
-            check_vma=False,
-        )
+    return _jit_site(
+        "parallel.mesh.sharded_sketch",
+        jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=P(),
+                check_vma=False,
+            )
+        ),
     )
 
 

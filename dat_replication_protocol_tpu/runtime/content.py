@@ -26,6 +26,12 @@ import dataclasses
 
 import numpy as np
 
+from ..obs.metrics import OBS as _OBS
+from ..obs.metrics import counter as _counter
+from ..obs.tracing import trace_span as _trace_span
+
+_M_D2H = _counter("device.d2h.bytes")
+
 
 def _extents_from_cuts(cuts) -> tuple[np.ndarray, np.ndarray]:
     """Chunk end-offsets -> (offsets, lengths); single owner of the
@@ -90,19 +96,22 @@ def content_address(data, avg_bits: int = 13,
     ) else np.asarray(data, dtype=np.uint8)
     if buf.size == 0:
         return ContentSummary(0, [], np.empty((0, 32), np.uint8), b"\0" * 32)
-    cuts = chunk_stream(buf, avg_bits, min_size, max_size)
-    offs, lens = _extents_from_cuts(cuts)
-    # digests stay in HBM through the tree fold; the host copy is one
-    # interleave off the same device arrays (no fetch-then-reupload)
-    hh, hl = hash_extents_device(buf, offs, lens)
-    (root_bytes,) = merkle.digests_from_device(
-        *merkle.root(*merkle.pad_leaves(hh, hl))
-    )
-    n = len(cuts)
-    raw = np.empty((n, 8), dtype="<u4")
-    raw[:, 0::2] = np.asarray(hl)
-    raw[:, 1::2] = np.asarray(hh)
-    digests = raw.view(np.uint8).reshape(n, 32)
+    with _trace_span("device.content.address", bytes=int(buf.size)):
+        cuts = chunk_stream(buf, avg_bits, min_size, max_size)
+        offs, lens = _extents_from_cuts(cuts)
+        # digests stay in HBM through the tree fold; the host copy is one
+        # interleave off the same device arrays (no fetch-then-reupload)
+        hh, hl = hash_extents_device(buf, offs, lens)
+        (root_bytes,) = merkle.digests_from_device(
+            *merkle.root(*merkle.pad_leaves(hh, hl))
+        )
+        n = len(cuts)
+        if _OBS.on:
+            _M_D2H.inc(32 * n + 32)  # chunk digests + the root
+        raw = np.empty((n, 8), dtype="<u4")
+        raw[:, 0::2] = np.asarray(hl)
+        raw[:, 1::2] = np.asarray(hh)
+        digests = raw.view(np.uint8).reshape(n, 32)
     return ContentSummary(int(buf.size), list(map(int, cuts)), digests,
                           root_bytes)
 

@@ -21,6 +21,14 @@ from pathlib import Path
 
 import numpy as np
 
+from ..obs.events import emit as _emit
+from ..obs.metrics import OBS as _OBS
+from ..obs.metrics import counter as _counter
+
+# host-engine digest traffic (device-telemetry catalog): bytes hashed by
+# the native C pass — the host-side counterpart of device.h2d.bytes
+_M_NATIVE_HASH_BYTES = _counter("device.native.hash.bytes")
+
 _SRC = Path(__file__).resolve().parent.parent / "native" / "dat_native.cpp"
 # location config, not behavior gating: where build products land may
 # freeze at import  # datlint: disable=env-cache-policy
@@ -154,6 +162,11 @@ def _load_once() -> ctypes.CDLL | None:
                 lib = None
         _lib = lib
         _tried = True
+        if _OBS.on:
+            # once per process (the load is cached): which engine tier
+            # this host actually has — the first question when a bench
+            # number moves between runners
+            _emit("device.native.load", ok=lib is not None)
         return _lib
 
 
@@ -207,6 +220,8 @@ def hash_many_list(payloads: list) -> np.ndarray | None:
                               _nthreads())
     if rc != 0:
         return None
+    if _OBS.on:
+        _M_NATIVE_HASH_BYTES.inc(int(lens.sum()))
     return out
 
 
@@ -228,6 +243,8 @@ def hash_many(buf: np.ndarray, offs: np.ndarray, lens: np.ndarray):
     rc = lib.dat_blake2b_many(buf, offs, lens, n, out.reshape(-1), _nthreads())
     if rc != 0:  # only allocation failure today
         return None
+    if _OBS.on:
+        _M_NATIVE_HASH_BYTES.inc(int(lens.sum()))
     return out
 
 

@@ -26,6 +26,8 @@ import dataclasses
 
 import numpy as np
 
+from ..obs.device import note_engine as _note_engine
+from ..obs.metrics import OBS as _OBS
 from ..wire.change_codec import Change, decode_change
 from ..wire.framing import TYPE_BLOB, TYPE_CHANGE, ProtocolError
 from ..wire.varint import NeedMoreData, decode_uvarint
@@ -106,6 +108,9 @@ def split_frames(data, allow_partial_tail: bool = False) -> FrameIndex:
     """
     buf = _as_u8(data)
     lib = native.get_lib()
+    if _OBS.on:
+        _note_engine("replay.split", "native" if lib is not None
+                     else "python")
     if lib is not None:
         n, starts, lens, ids, consumed = _split_native(lib, buf)
     else:

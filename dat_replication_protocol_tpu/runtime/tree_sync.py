@@ -27,6 +27,11 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..obs.metrics import OBS as _OBS
+from ..obs.metrics import counter as _counter
+
+_M_D2H = _counter("device.d2h.bytes")
+
 _DIGEST = 32
 
 
@@ -54,6 +59,9 @@ class TreeSyncSession:
 
         if not idxs:
             return []
+        if _OBS.on:
+            # frontier digests leave the device to go on the wire
+            _M_D2H.inc(_DIGEST * len(idxs))
         at = np.asarray(idxs, dtype=np.int64)
         return merkle.digests_from_device(
             np.asarray(self._hh[level])[at], np.asarray(self._hl[level])[at]

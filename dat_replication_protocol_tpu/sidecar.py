@@ -39,6 +39,7 @@ import sys
 import threading
 import time
 
+from .obs import device as obs_device
 from .obs import events as obs_events
 from .obs import flight as obs_flight
 from .obs import metrics as obs_metrics
@@ -428,12 +429,16 @@ class StatsEmitter:
 
 def snapshot_stats() -> dict:
     """One self-describing stats record: the full metrics registry
-    snapshot plus event-ring health.  JSON-able as-is."""
+    snapshot plus event-ring health and per-site jit-cache traffic
+    (the recompile sentinel: a long-lived sidecar recompiling per
+    request is the device-path pathology --stats-fd exists to catch).
+    JSON-able as-is."""
     return {
         "ts": time.time(),
         "monotonic": time.monotonic(),
         "metrics": obs_metrics.snapshot(),
         "events_dropped": obs_events.EVENTS.dropped,
+        "jit_sites": obs_device.SENTINEL.snapshot(),
     }
 
 

@@ -32,6 +32,19 @@ import os
 import time
 from contextlib import contextmanager
 
+from ..obs.metrics import OBS as _OBS
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import histogram as _histogram
+
+# chip-mutex contention telemetry (device-telemetry catalog): every
+# acquisition's wait lands in the histogram, so `bench --metrics`
+# artifacts carry the contention story from the registry instead of
+# only the ad-hoc per-leg `waited_s` field
+_M_WAIT = _histogram("device.chiplock.wait")
+_M_ACQUIRES = _counter("device.chiplock.acquires")
+_M_CONTENDED = _counter("device.chiplock.contended")
+_M_LOCKLESS = _counter("device.chiplock.lockless")
+
 DEFAULT_LOCK_PATH = "/tmp/dat_tpu_chip.lock"
 
 
@@ -104,6 +117,8 @@ def chip_lock(max_wait: float | None = None, poll_s: float = 2.0):
         # e.g. the lock file belongs to another user (umask strips the
         # 0o666): degrade to lockless-with-a-record rather than blank
         # the run this lock exists to protect
+        if _OBS.on:
+            _M_LOCKLESS.inc()
         yield ChipLease(False, 0.0, path)
         return
     held = False
@@ -129,6 +144,14 @@ def chip_lock(max_wait: float | None = None, poll_s: float = 2.0):
                     if e2.errno not in (errno.EAGAIN, errno.EACCES):
                         raise
             waited = time.monotonic() - t0
+        if _OBS.on:
+            _M_WAIT.observe(waited)
+            if held:
+                _M_ACQUIRES.inc()
+            else:
+                _M_LOCKLESS.inc()
+            if waited > 0.0:
+                _M_CONTENDED.inc()
         if held:
             # best-effort breadcrumb for a human inspecting a contended
             # window; failures (read-only fs) must not break the lock

@@ -46,17 +46,20 @@ import pytest  # noqa: E402
 @pytest.fixture
 def obs_enabled():
     """Enable the obs gate for one test with clean metric values, an
-    empty event ring, an empty span ring, and a disarmed flight
-    recorder, restoring the prior gate state afterwards — all four are
-    process-global, so isolation is explicit."""
-    from dat_replication_protocol_tpu.obs import events, flight, metrics, \
-        tracing
+    empty event ring, an empty span ring, a disarmed flight recorder,
+    and a reset device sentinel, restoring the prior gate state
+    afterwards — all five are process-global, so isolation is
+    explicit."""
+    from dat_replication_protocol_tpu.obs import device, events, flight, \
+        metrics, tracing
 
     was_on = metrics.OBS.on
     metrics.REGISTRY.reset()
     events.EVENTS.clear()
     tracing.SPANS.clear()
     flight.FLIGHT._reset_for_tests()
+    device.SENTINEL.reset_for_tests()
+    device.reset_engine_notes()
     metrics.enable()
     try:
         yield metrics
@@ -68,3 +71,5 @@ def obs_enabled():
         tracing.SPANS.clear()
         tracing.SPANS.detach_sink()
         flight.FLIGHT._reset_for_tests()
+        device.SENTINEL.reset_for_tests()
+        device.reset_engine_notes()

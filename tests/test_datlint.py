@@ -592,6 +592,48 @@ def test_obs_discipline_exempts_the_span_plumbing_itself(tmp_path):
     assert "obs-discipline" not in _rules_fired(findings)
 
 
+def test_obs_discipline_covers_jit_site_registrations(tmp_path):
+    # ISSUE 5 satellite: the recompile sentinel's site names carry the
+    # same literal-name contract — device.jit.trace events and the
+    # sentinel snapshot key on them
+    findings = _lint(tmp_path, ("js.py", '''
+        def f(jit_site, _jit_site, kernel, name):
+            a = jit_site(name, kernel)
+            b = _jit_site("ops." + name, kernel)
+            return a, b
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 2
+
+
+def test_obs_discipline_clean_on_literal_jit_site_names(tmp_path):
+    assert _lint(tmp_path, ("jsok.py", '''
+        def f(jit_site, kernel):
+            return jit_site("ops.blake2b.packed", kernel)
+    ''')) == []
+
+
+def test_obs_discipline_matches_device_receiver_aliases(tmp_path):
+    # the package idiom: `from ..obs import device as _obs_device`
+    findings = _lint(tmp_path, ("devrecv.py", '''
+        def f(_obs_device, device, kernel, name):
+            _obs_device.jit_site(name, kernel)
+            device.emit(name, x=1)
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 2
+
+
+def test_obs_discipline_exempts_the_device_plumbing_itself(tmp_path):
+    # obs/device.py forwards site/component names by design
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    (obs_dir / "device.py").write_text(textwrap.dedent('''
+        def jit_site(name, fn):
+            return _JitSite(name, fn)
+    '''))
+    findings = run_paths([tmp_path])
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
 def test_obs_discipline_ignores_unrelated_emit_and_histogram_apis(tmp_path):
     # same method NAMES on non-telemetry receivers: logging handlers,
     # sockets, numpy — none of these touch the obs registry
