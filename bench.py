@@ -25,7 +25,7 @@ on every backend (<30 s on CPU).
 Env knobs: BENCH_ITEMS / BENCH_ITEM_MIB / BENCH_CHUNK (config 3),
 BENCH_REPLAY_ROWS, BENCH_CDC_MIB / BENCH_CDC_REPS, BENCH_MERKLE_LOG2,
 BENCH_ROUNDTRIPS, BENCH_RESUME_ROWS / BENCH_RESUME_REPS (config 6),
-BENCH_CONFIGS (comma list, default "1,2,3,4,5,6").
+BENCH_CONFIGS (comma list, default "1,2,3,4,5,6,7").
 """
 
 from __future__ import annotations
@@ -1260,6 +1260,101 @@ def bench_resume(quick: bool, backend: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# config 7: wire-level A/B — per-record Change frames vs columnar
+# ChangeBatch frames (rows/s both directions + bytes-on-wire; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def bench_wire_batch(quick: bool, backend: str) -> dict:
+    import numpy as np
+
+    from dat_replication_protocol_tpu.runtime import native, replay
+    from dat_replication_protocol_tpu.wire.change_codec import Change, \
+        encode_change
+    from dat_replication_protocol_tpu.wire.framing import TYPE_CHANGE, frame
+
+    rows = _env_int("BENCH_WIRE_BATCH_ROWS", 50_000 if quick else 1_000_000)
+    batch_rows = _env_int("BENCH_WIRE_BATCH_SIZE", 65_536)
+    # the config-2 replay shape: distinct keys within a block, the block
+    # repeated to scale — change logs revisit keys, which is exactly
+    # what the batch dictionary monetizes
+    block_n = min(rows, 4096)
+    recs = [
+        Change(
+            key=f"key-{i:07d}",
+            change=i,
+            from_=i,
+            to=i + 1,
+            value=b"v" * (i % 48),
+            subset="s" if i % 3 else None,
+        )
+        for i in range(block_n)
+    ]
+    block = b"".join(frame(TYPE_CHANGE, encode_change(c)) for c in recs)
+    reps = -(-rows // block_n)
+    per_record_wire = block * reps
+    total_rows = block_n * reps
+    cols, _frames = replay.replay_log(
+        np.frombuffer(per_record_wire, np.uint8))
+
+    # A: per-record framing — columnar bulk encoder (the incumbent)
+    replay.encode_change_columns(replay._slice_columns(cols, 0, 64))  # warm
+    t0 = time.perf_counter()
+    a_wire = replay.encode_change_columns(cols)
+    a_dt = time.perf_counter() - t0
+    assert len(a_wire) == len(per_record_wire)
+
+    # B: ChangeBatch framing — same rows, columnar frames
+    replay.encode_batch_frames(replay._slice_columns(cols, 0, 64))  # warm
+    t0 = time.perf_counter()
+    b_wire = replay.encode_batch_frames(cols, rows_per_batch=batch_rows)
+    b_dt = time.perf_counter() - t0
+
+    # B decode: whole-log replay of the batch wire (the e2e replay rate)
+    b_buf = np.frombuffer(b_wire, np.uint8)
+    t0 = time.perf_counter()
+    b_cols, _bf = replay.replay_log(b_buf)
+    bd_dt = time.perf_counter() - t0
+    assert len(b_cols) == total_rows
+    assert b_cols.row(0).to_dict() == cols.row(0).to_dict()
+    assert b_cols.row(total_rows - 1).to_dict() == \
+        cols.row(total_rows - 1).to_dict()
+
+    # A decode, for the same-shape comparison
+    t0 = time.perf_counter()
+    a_cols, _af = replay.replay_log(np.frombuffer(per_record_wire, np.uint8))
+    ad_dt = time.perf_counter() - t0
+    assert len(a_cols) == total_rows
+
+    ratio = len(b_wire) / len(per_record_wire)
+    log(
+        f"bench[wire_batch]: {total_rows} rows — encode "
+        f"{total_rows / a_dt:,.0f} rows/s per-record vs "
+        f"{total_rows / b_dt:,.0f} rows/s batch ({a_dt / b_dt:.1f}x); "
+        f"decode {total_rows / ad_dt:,.0f} vs {total_rows / bd_dt:,.0f} "
+        f"rows/s; wire {len(per_record_wire)} -> {len(b_wire)} bytes "
+        f"({(1 - ratio) * 100:.1f}% smaller)"
+    )
+    return {
+        "metric": "wire_batch_encode_rate",
+        "value": round(total_rows / b_dt, 0),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "native": native.available(),
+        "rows": total_rows,
+        "reduced_config": total_rows < 1_000_000,
+        "full_config": "1M rows (config-2 shape), 64Ki-row batches",
+        "batch_rows_per_frame": batch_rows,
+        "per_record_encode_rows_s": round(total_rows / a_dt, 0),
+        "per_record_decode_rows_s": round(total_rows / ad_dt, 0),
+        "batch_decode_rows_s": round(total_rows / bd_dt, 0),
+        "per_record_bytes": len(per_record_wire),
+        "batch_bytes": len(b_wire),
+        "bytes_ratio": round(ratio, 4),
+    }
+
+
+# ---------------------------------------------------------------------------
 
 
 BENCHES = {
@@ -1269,6 +1364,7 @@ BENCHES = {
     "4": ("cdc", bench_cdc),
     "5": ("merkle_diff", bench_merkle),
     "6": ("resume", bench_resume),
+    "7": ("wire_batch", bench_wire_batch),
 }
 
 
@@ -1409,7 +1505,7 @@ def main() -> None:
         obs_flight.arm(flight_dir)
     which = [
         k.strip()
-        for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6").split(",")
+        for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6,7").split(",")
         if k.strip() in BENCHES
     ]
 
@@ -1447,10 +1543,10 @@ def main() -> None:
             _state["configs"][name] = err_res
         _export_config_trace(name, trace_dir)
 
-    # configs 1, 2, 6 need no JAX: run them before any backend init so a
-    # wedged/broken device stack cannot cost their numbers
+    # configs 1, 2, 6, 7 need no JAX: run them before any backend init
+    # so a wedged/broken device stack cannot cost their numbers
     for key in which:
-        if key in ("1", "2", "6"):
+        if key in ("1", "2", "6", "7"):
             run_config(key, "host")
 
     # priority order for the device leg: the headline hash config first,
@@ -1458,7 +1554,7 @@ def main() -> None:
     # that appears late in the budget must still yield config 3
     priority = {"3": 0, "5": 1, "4": 2}
     device_keys = sorted(
-        (k for k in which if k not in ("1", "2", "6")),
+        (k for k in which if k not in ("1", "2", "6", "7")),
         key=lambda k: priority.get(k, 9)
     )
     if device_keys:

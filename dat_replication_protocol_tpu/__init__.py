@@ -26,6 +26,7 @@ Public entry points mirror the reference's two factories
 from __future__ import annotations
 
 from .session import (
+    BatchPolicy,
     BlobLengthError,
     BlobReader,
     BlobWriter,
@@ -34,7 +35,13 @@ from .session import (
     Pipe,
     pipe,
 )
-from .wire import Change, ProtocolError, decode_change, encode_change
+from .wire import (
+    CAP_CHANGE_BATCH,
+    Change,
+    ProtocolError,
+    decode_change,
+    encode_change,
+)
 
 __version__ = "0.1.0"
 
@@ -70,6 +77,8 @@ __all__ = [
     "decode",
     "pipe",
     "Pipe",
+    "BatchPolicy",
+    "CAP_CHANGE_BATCH",
     "Change",
     "ProtocolError",
     "encode_change",

@@ -35,10 +35,14 @@ from typing import Iterator
 from ..engine import Finding, Project
 
 WATCHLIST = frozenset({
-    "TYPE_HEADER", "TYPE_CHANGE", "TYPE_BLOB",
+    "TYPE_HEADER", "TYPE_CHANGE", "TYPE_BLOB", "TYPE_CHANGE_BATCH",
     "MAX_VARINT_LEN", "MAX_HEADER_LEN",
     "TAG_SUBSET", "TAG_KEY", "TAG_CHANGE", "TAG_FROM", "TAG_TO",
     "TAG_VALUE",
+    # ChangeBatch extension: the frame's payload version byte and the
+    # capability bit that gates emitting it (negotiation constants —
+    # a fork here is a peer that silently stops understanding itself)
+    "BATCH_VERSION", "CAP_CHANGE_BATCH",
 })
 
 _C_PATTERNS = (

@@ -375,6 +375,27 @@ class TpuDecoder(Decoder):
         else:
             self._change_seq = seq + count
 
+    def _note_change_batch(self, cols, n: int) -> None:
+        # ChangeBatch frames carry no per-record protobuf bytes on the
+        # wire, but the digest CONTRACT is framing-independent: a row's
+        # digest is the BLAKE2b of its canonical per-record encoding, so
+        # batch-framed and per-record peers produce identical digest
+        # streams (WIRE.md sidecar convention, PARITY.md).  Re-encoding
+        # rides the native columnar encoder — one C pass, no per-row
+        # Python — and submit order matches wire row order.
+        if not self._digest_cbs:
+            self._change_seq += n
+            return
+        from ..runtime.replay import canonical_change_payloads
+
+        seq = self._change_seq
+        submit = self._pipeline.submit
+        emit = self._emit_change_digest
+        for p in canonical_change_payloads(cols):
+            submit(p, emit, seq)
+            seq += 1
+        self._change_seq = seq
+
     def _open_blob_if_ready(self) -> None:
         if self._digest_cbs:
             # self._missing is the blob's wire length at header time
@@ -462,6 +483,31 @@ class TpuEncoder(Encoder):
             self._pipeline.submit(payload, self._emit_change_digest, seq)
         self._change_seq += 1
         return super()._frame_change(payload, on_flush)
+
+    def _note_batch_rows(self, rows) -> None:
+        # negotiated ChangeBatch flush: the frame carries no per-record
+        # bytes, but the digest contract is framing-independent
+        # (WIRE.md) — each row's digest hashes its canonical per-record
+        # encoding, in the same seq stream _frame_change would have
+        # produced, submitted before the frame is queued.
+        if not self._digest_cbs:
+            self._change_seq += len(rows)
+            return
+        from ..wire.change_codec import _encode_change_with, _fastpath_mod
+
+        fp = _fastpath_mod()  # bound once for the batch
+        seq = self._change_seq
+        submit = self._pipeline.submit
+        emit = self._emit_change_digest
+        for key, cg, fr, to, val, sub in rows:
+            payload = _encode_change_with(fp, {
+                "key": key.decode("utf-8"), "change": cg, "from": fr,
+                "to": to, "value": val,
+                "subset": None if sub is None else sub.decode("utf-8"),
+            })
+            submit(payload, emit, seq)
+            seq += 1
+        self._change_seq = seq
 
     def blob(self, length: int, on_flush=None):
         ws = super().blob(length, on_flush)

@@ -24,6 +24,8 @@ per-dispatch batch volume, enforced upstream by the DigestPipeline caps.
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
 from ..obs.device import note_engine as _note_engine
@@ -224,6 +226,74 @@ def hash_extents_device(buf: np.ndarray, offs, lens,
     return out_hh, out_hl
 
 
+@dataclasses.dataclass
+class DeviceChangeBatch:
+    """A decoded ``ChangeBatch`` resident in device layout.
+
+    ``change`` / ``from_`` / ``to`` are (n,) uint32 device arrays (the
+    columns land exactly as the wire carried them — no per-row host
+    work); ``buf`` is the payload buffer on device with ``val_off`` /
+    ``val_len`` extents addressing the value heap inside it, the shape
+    the digest/merkle kernels gather from.
+    """
+
+    change: object
+    from_: object
+    to: object
+    buf: object
+    val_off: object
+    val_len: object
+
+    def __len__(self) -> int:
+        return int(self.change.shape[0])
+
+
+def decode_batch_device(payload, base: int = 0) -> DeviceChangeBatch:
+    """Decode one ChangeBatch payload STRAIGHT into device arrays.
+
+    The wire's columnar layout is already the device layout: the u32
+    seq columns and the payload buffer upload as-is (``device_put`` from
+    zero-copy numpy views), so merkle/digest work downstream starts from
+    data that never took a per-row host detour.  Value extents ride
+    along for device-side gathers; key/subset dictionaries stay host-
+    side in the returned buffer (kernels address bytes, not strings).
+    """
+    import jax
+
+    from ..wire.batch_codec import decode_change_batch
+
+    cols = decode_change_batch(payload, base=base)
+    n = len(cols.change)
+    with _trace_span("device.dispatch", site="feed.decode_batch",
+                     items=n):
+        if _OBS.on:
+            _M_H2D.inc(cols.buf.nbytes + 12 * n + 16 * n)
+            _note_engine("feed.decode_batch", "device")
+        return DeviceChangeBatch(
+            change=jax.device_put(cols.change),
+            from_=jax.device_put(cols.from_),
+            to=jax.device_put(cols.to),
+            buf=jax.device_put(cols.buf),
+            val_off=jax.device_put(cols.val_off),
+            val_len=jax.device_put(cols.val_len),
+        )
+
+
+def leaves_from_change_columns(cols) -> np.ndarray:
+    """Merkle leaf digests for decoded change columns WITHOUT a matching
+    per-record frame index — the batch-framed replay path.
+
+    The leaf contract is framing-independent: a row's leaf is the
+    BLAKE2b-256 of its canonical per-record payload encoding, so a
+    batch-framed log and a per-record log of the same rows produce
+    identical trees (PARITY.md).  Rows are re-encoded canonically in one
+    native pass and hashed as extents — no per-row Python."""
+    from ..runtime.replay import canonical_change_extents
+
+    buf, offs, lens = canonical_change_extents(cols)
+    return hash_extents(buf, offs, lens)
+
+
 def leaves_from_columns(cols, frames=None) -> np.ndarray:
     """Merkle leaf digests for replayed change records, in log order.
 
@@ -238,7 +308,12 @@ def leaves_from_columns(cols, frames=None) -> np.ndarray:
         from ..wire.framing import TYPE_CHANGE
 
         sel = frames.ids == TYPE_CHANGE
-        return hash_extents(frames.buf, frames.starts[sel], frames.lens[sel])
+        if int(sel.sum()) == len(cols):
+            return hash_extents(frames.buf, frames.starts[sel],
+                                frames.lens[sel])
+        # batch frames carry rows the per-record extents don't cover:
+        # hash the canonical re-encoding (identical digests either way)
+        return leaves_from_change_columns(cols)
     # otherwise hash each record's re-encoded bytes (rarely needed) —
     # gate resolved once for the loop, same as replay's bulk encoders
     from ..wire.change_codec import _encode_change_with, _fastpath_mod

@@ -33,7 +33,7 @@ Semantics:
   (bench's own in-band below-full-shape marker) is judged against
   ``reduced_ratio`` when present — quick/CI shapes get the loose
   bound, a full-config capture the real one.
-* ``--host-only`` evaluates only ``group: "host"`` configs (1/2/6 run
+* ``--host-only`` evaluates only ``group: "host"`` configs (1/2/6/7 run
   with no JAX backend at all) — the CPU-safe tier-1 mode.
 * A budgeted config that is missing from the snapshot, or carries an
   ``"error"``, fails — a gate that passes on absent data is not a gate

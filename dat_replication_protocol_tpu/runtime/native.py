@@ -114,6 +114,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
     ]
+    lib.dat_encode_change_batch.restype = ctypes.c_int64
+    lib.dat_encode_change_batch.argtypes = [
+        _U8P, ctypes.c_int64,
+        _U32P, _U32P, _U32P,
+        _I64P, _I64P, _I64P, _I64P, _I64P, _I64P,
+        _U8P, ctypes.c_int64,
+    ]
     lib.dat_gear_candidates.restype = ctypes.c_int64
     lib.dat_gear_candidates.argtypes = [
         _U8P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
@@ -186,6 +193,49 @@ def available() -> bool:
 
 def _nthreads() -> int:
     return int(os.environ.get("DAT_NTHREADS", "0"))  # 0 = auto (hw cap)
+
+
+def encode_change_batch(buf, n: int, change, from_, to, key_off, key_len,
+                        sub_off, sub_len, val_off, val_len) -> bytes | None:
+    """One columnar ``ChangeBatch`` payload from record spans over
+    ``buf`` (the ChangeColumns layout; -1 lens = absent optionals), or
+    ``None`` when the native library is unavailable (callers fall back
+    to the Python codec in ``wire/batch_codec.py``).  The C pass owns
+    the dictionary dedup — the only per-row work numpy cannot
+    vectorize."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    key_len = np.ascontiguousarray(key_len, dtype=np.int64)
+    sub_len = np.ascontiguousarray(sub_len, dtype=np.int64)
+    val_len = np.ascontiguousarray(val_len, dtype=np.int64)
+    # capacity: header + worst-case dictionaries (every span unique) +
+    # fixed columns at max widths + value heap
+    heap = int(key_len.sum()) \
+        + int(np.where(sub_len > 0, sub_len, 0).sum()) \
+        + int(np.where(val_len > 0, val_len, 0).sum())
+    cap = 64 + 32 * n + heap
+    dst = np.empty(cap, np.uint8)
+    w = lib.dat_encode_change_batch(
+        buf, n,
+        np.ascontiguousarray(change, np.uint32),
+        np.ascontiguousarray(from_, np.uint32),
+        np.ascontiguousarray(to, np.uint32),
+        np.ascontiguousarray(key_off, np.int64), key_len,
+        np.ascontiguousarray(sub_off, np.int64), sub_len,
+        np.ascontiguousarray(val_off, np.int64), val_len,
+        dst, cap,
+    )
+    if w < 0:
+        if w == ERR_NOMEM:
+            return None  # degrade to the Python codec
+        if w == ERR_BAD_RECORD:
+            # same contract (and failure class) as _pick_width's raise
+            raise ValueError(
+                "value exceeds ChangeBatch width ladder")
+        raise RuntimeError(f"native batch encode failed (code {w})")
+    return dst[:w].tobytes()
 
 
 def hash_many_list(payloads: list) -> np.ndarray | None:

@@ -29,7 +29,8 @@ import numpy as np
 from ..obs.device import note_engine as _note_engine
 from ..obs.metrics import OBS as _OBS
 from ..wire.change_codec import Change, decode_change
-from ..wire.framing import TYPE_BLOB, TYPE_CHANGE, ProtocolError
+from ..wire.framing import TYPE_BLOB, TYPE_CHANGE, TYPE_CHANGE_BATCH, \
+    ProtocolError
 from ..wire.varint import NeedMoreData, decode_uvarint
 from . import native
 
@@ -196,6 +197,11 @@ def decode_change_columns(buf: np.ndarray, starts: np.ndarray,
         val_off=np.zeros(n, dtype=np.int64),
         val_len=np.full(n, -1, dtype=np.int64),
     )
+    if n == 0:
+        # nothing to decode — and the Python fallback below would copy
+        # the WHOLE buffer just to build its memoryview (measured 50 ms
+        # on a 40 MiB batch-framed log with zero per-record frames)
+        return cols
     lib = native.get_lib()
     if lib is not None and n:
         err = ctypes.c_int64(-1)
@@ -414,16 +420,142 @@ def replay_log(data) -> tuple[ChangeColumns, FrameIndex]:
 
     Returns the decoded change columns plus the full frame index (blob
     frames stay as extents in the index for the blob pipelines).
-    Unknown frame type ids raise ProtocolError, mirroring the decoder's
-    fail-fast (reference: decode.js:159-161).
+    Handles per-record ``Change`` frames, negotiated columnar
+    ``ChangeBatch`` frames, and any interleaving of the two — rows come
+    back in wire order either way, with every string/bytes extent
+    addressing the ONE log buffer (batch extents are decoded with their
+    payload's absolute base offset).  Unknown frame type ids raise
+    ProtocolError, mirroring the decoder's fail-fast
+    (reference: decode.js:159-161).
     """
     frames = split_frames(data)
-    known = (frames.ids == TYPE_CHANGE) | (frames.ids == TYPE_BLOB)
+    known = ((frames.ids == TYPE_CHANGE) | (frames.ids == TYPE_BLOB)
+             | (frames.ids == TYPE_CHANGE_BATCH))
     if not bool(known.all()):
         bad = int(frames.ids[~known][0])
         raise ProtocolError(f"Protocol error, unknown type: {bad}")
     sel = frames.ids == TYPE_CHANGE
-    cols = decode_change_columns(
+    bsel = frames.ids == TYPE_CHANGE_BATCH
+    if not bool(bsel.any()):
+        cols = decode_change_columns(
+            frames.buf, frames.starts[sel], frames.lens[sel]
+        )
+        return cols, frames
+    cols = _replay_with_batches(frames, sel, bsel)
+    return cols, frames
+
+
+def _replay_with_batches(frames: FrameIndex, sel: np.ndarray,
+                         bsel: np.ndarray) -> ChangeColumns:
+    """Stitch per-record and batch-frame rows back into wire order.
+
+    Only the batch frames cost Python (one decode each — there are few:
+    that is the point of batching); per-record rows decode in one native
+    pass and slice into the stitched output as runs.
+    """
+    from ..wire.batch_codec import decode_change_batch
+
+    cols_pr = decode_change_columns(
         frames.buf, frames.starts[sel], frames.lens[sel]
     )
-    return cols, frames
+    # frames contributing rows, in wire order; change-frame runs between
+    # batch frames map to consecutive cols_pr row ranges
+    row_frames = np.nonzero(sel | bsel)[0]
+    is_batch = bsel[row_frames]
+    batch_at = np.nonzero(is_batch)[0]
+    parts: list[tuple] = []  # (cols-like, lo, hi)
+    pr_done = 0
+    prev = 0
+    for k in batch_at.tolist():
+        run = k - prev  # change frames before this batch frame
+        if run:
+            parts.append((cols_pr, pr_done, pr_done + run))
+            pr_done += run
+        fi = int(row_frames[k])
+        start = int(frames.starts[fi])
+        flen = int(frames.lens[fi])
+        try:
+            bc = decode_change_batch(
+                frames.buf[start:start + flen], base=start,
+                buf=frames.buf)
+        except ValueError as e:
+            raise ProtocolError(str(e)) from e
+        parts.append((bc, 0, len(bc.change)))
+        prev = k + 1
+    tail = len(row_frames) - prev
+    if tail:
+        parts.append((cols_pr, pr_done, pr_done + tail))
+
+    def cat(field: str, dtype) -> np.ndarray:
+        if not parts:
+            return np.zeros(0, dtype)
+        return np.concatenate(
+            [np.asarray(getattr(c, field)[lo:hi]) for c, lo, hi in parts]
+        ).astype(dtype, copy=False)
+
+    return ChangeColumns(
+        buf=frames.buf,
+        change=cat("change", np.uint32),
+        from_=cat("from_", np.uint32),
+        to=cat("to", np.uint32),
+        key_off=cat("key_off", np.int64),
+        key_len=cat("key_len", np.int64),
+        sub_off=cat("sub_off", np.int64),
+        sub_len=cat("sub_len", np.int64),
+        val_off=cat("val_off", np.int64),
+        val_len=cat("val_len", np.int64),
+    )
+
+
+def _slice_columns(cols: ChangeColumns, lo: int, hi: int) -> ChangeColumns:
+    """Row-range view of decoded columns (numpy slices, shared buf)."""
+    return ChangeColumns(
+        buf=cols.buf,
+        change=cols.change[lo:hi], from_=cols.from_[lo:hi],
+        to=cols.to[lo:hi],
+        key_off=cols.key_off[lo:hi], key_len=cols.key_len[lo:hi],
+        sub_off=cols.sub_off[lo:hi], sub_len=cols.sub_len[lo:hi],
+        val_off=cols.val_off[lo:hi], val_len=cols.val_len[lo:hi],
+    )
+
+
+def encode_batch_frames(cols: ChangeColumns,
+                        rows_per_batch: int = 65536) -> bytes:
+    """Frame decoded columns as ``TYPE_CHANGE_BATCH`` wire bytes — the
+    columnar counterpart of :func:`encode_change_columns` (the bulk
+    replay encode path; ROADMAP item 5).  One frame per
+    ``rows_per_batch`` rows: bigger batches amortize the dictionary
+    further but hold more memory per frame on the receiver."""
+    from ..wire.batch_codec import encode_columns
+    from ..wire.framing import frame
+
+    n = len(cols)
+    if n == 0:
+        return b""
+    out = []
+    for lo in range(0, n, rows_per_batch):
+        payload = encode_columns(_slice_columns(cols, lo,
+                                                min(n, lo + rows_per_batch)))
+        out.append(frame(TYPE_CHANGE_BATCH, payload))
+    return b"".join(out)
+
+
+def canonical_change_extents(cols: ChangeColumns):
+    """Canonical per-record payload extents for decoded columns:
+    ``(buf, offs, lens)`` where ``buf[offs[i]:offs[i]+lens[i]]`` is row
+    i's per-record protobuf encoding.  The digest/merkle contract is
+    framing-independent — batch-framed rows hash the SAME bytes a
+    per-record peer put on the wire — so consumers re-encode through
+    the native columnar encoder (one C pass) and index the result."""
+    wire = encode_change_columns(cols)
+    idx = split_frames(np.frombuffer(wire, dtype=np.uint8))
+    return idx.buf, idx.starts, idx.lens
+
+
+def canonical_change_payloads(cols: ChangeColumns) -> list[bytes]:
+    """Row-order list of canonical per-record payload bytes (the digest
+    pipeline's submit unit) for decoded columns."""
+    buf, offs, lens = canonical_change_extents(cols)
+    data = buf.tobytes()
+    return [data[o:o + ln]
+            for o, ln in zip(offs.tolist(), lens.tolist())]

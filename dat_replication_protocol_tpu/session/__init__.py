@@ -3,6 +3,7 @@ the fault-and-recovery layer (faults / resume / reconnect)."""
 
 from .decoder import BlobReader, Decoder, DecoderDestroyedError
 from .encoder import (
+    BatchPolicy,
     BlobLengthError,
     BlobWriter,
     Encoder,
@@ -14,6 +15,7 @@ from .reconnect import BackoffPolicy, run_resumable
 from .resume import ResumeError, SessionCheckpoint, WireJournal
 
 __all__ = [
+    "BatchPolicy",
     "BlobReader",
     "Decoder",
     "DecoderDestroyedError",

@@ -37,7 +37,8 @@ from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
 from ..obs.tracing import trace_instant as _trace_instant
 from ..wire.change_codec import Change, decode_change
-from ..wire.framing import MAX_HEADER_LEN, TYPE_BLOB, TYPE_CHANGE, TYPE_HEADER, ProtocolError
+from ..wire.framing import LOCAL_CAPS, MAX_HEADER_LEN, TYPE_BLOB, \
+    TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_HEADER, ProtocolError
 from ..wire.framing import header_len as _header_len
 from ..wire.varint import decode_uvarint
 
@@ -52,6 +53,8 @@ _M_DEC_BLOBS = _counter("decoder.blobs")
 _M_DEC_BLOB_BYTES = _counter("decoder.blob.bytes")
 _M_DEC_REQUEUES = _counter("decoder.requeues")
 _M_DEC_ERRORS = _counter("decoder.errors")
+# columnar ChangeBatch frames dispatched (rows ride decoder.changes)
+_M_DEC_BATCH_FRAMES = _counter("decoder.batch.frames")
 # per-write() dispatch latency: bytes in -> handlers fired (or stalled)
 _H_DEC_DISPATCH = _histogram("decoder.dispatch.seconds")
 
@@ -206,6 +209,7 @@ class Decoder:
         self.destroyed = False
         self.finished = False
         self._on_change: Callable[[Change, Callable[[], None]], None] | None = None
+        self._on_change_batch = None  # whole-batch columnar handler
         self._on_blob: Callable[[BlobReader, Callable[[], None]], None] | None = None
         self._on_finalize: Callable[[Callable[[], None]], None] | None = None
         self._error_cbs: list[Callable[[Exception | None], None]] = []
@@ -234,6 +238,14 @@ class Decoder:
         self._overflow: deque[memoryview] = deque()  # unparsed input, in order
         self._overflow_bytes = 0  # running total (kept in sync with the deque)
         self._bulk: dict | None = None  # parked native frame-index cursor
+        # parked ChangeBatch delivery cursor: a batch frame whose rows
+        # could not all dispatch (async ack / pause) resumes here —
+        # ordering: nothing after the batch dispatches until it drains
+        self._pbatch: dict | None = None
+        # batch-frame accounting so _frames_delivered keeps counting
+        # FRAMES while self.changes counts ROWS (a batch is one frame)
+        self._batch_rows_seen = 0
+        self._batch_frames_done = 0
         self._write_cbs: list[Callable[[], None]] = []
         self._end_queued = False
         self._end_cb: OnDone = None
@@ -254,6 +266,27 @@ class Decoder:
     def change(self, cb: Callable[[Change, Callable[[], None]], None]) -> "Decoder":
         self._on_change = cb
         return self
+
+    def change_batch(self, cb) -> "Decoder":
+        """Register a whole-batch handler: ``cb(cols, done)`` receives a
+        negotiated ``ChangeBatch`` frame's decoded columns (a
+        :class:`~..runtime.replay.ChangeColumns`: ``len()`` rows,
+        ``row(i)`` lazy materialization, numpy columns for bulk work)
+        and ONE ``done`` for the whole frame — zero per-row Python on
+        the decode side.  Without this handler, batch rows are delivered
+        through the per-record :meth:`change` handler one
+        :class:`Change` at a time (same observable stream as a
+        per-record peer).  Per-record frames always go to
+        :meth:`change`."""
+        self._on_change_batch = cb
+        return self
+
+    @staticmethod
+    def capabilities() -> int:
+        """The capability mask this decoder can parse — what a receiver
+        advertises during session setup (WIRE.md "Capability
+        negotiation")."""
+        return LOCAL_CAPS
 
     def blob(self, cb: Callable[[BlobReader, Callable[[], None]], None]) -> "Decoder":
         self._on_blob = cb
@@ -311,7 +344,8 @@ class Decoder:
         if entry is not None:
             return entry not in self._write_cbs  # fired <=> consumed
         return not (
-            self._overflow or self._bulk is not None or self._stalled()
+            self._overflow or self._bulk is not None
+            or self._pbatch is not None or self._stalled()
         )
 
     def end(self, on_finished: OnDone = None) -> None:
@@ -337,6 +371,7 @@ class Decoder:
         self._overflow.clear()
         self._overflow_bytes = 0
         self._bulk = None
+        self._pbatch = None
         for cb in self._error_cbs:
             cb(err)
         # Release parked write-completion callbacks so a transport blocked on
@@ -353,6 +388,7 @@ class Decoder:
             self._stalled()
             or self._overflow
             or self._bulk is not None
+            or self._pbatch is not None
             or self.destroyed
             or self.finished
         )
@@ -391,8 +427,13 @@ class Decoder:
         """Frames fully delivered — the single frame-index authority for
         checkpoints AND structured error context (they must agree).
         ``blobs`` counts at OPEN (header time): a blob mid-payload is
-        the frame being parsed, not a delivered one."""
-        return (self.changes + self.blobs
+        the frame being parsed, not a delivered one.  A ChangeBatch is
+        ONE frame however many rows it carries: its rows are subtracted
+        back out of ``changes`` and the frame counts once, at full
+        delivery (mid-batch it is the frame being parsed, like a
+        mid-payload blob)."""
+        return (self.changes - self._batch_rows_seen
+                + self._batch_frames_done + self.blobs
                 - (1 if self._current_blob is not None else 0))
 
     def _checkpoint_digest(self) -> dict:
@@ -498,6 +539,7 @@ class Decoder:
             or self.destroyed
             or self._overflow
             or self._bulk is not None
+            or self._pbatch is not None
             or self._stalled()
             or self._consuming  # drained-check at the end of _consume re-runs this
         ):
@@ -578,6 +620,14 @@ class Decoder:
         self._consuming = True
         try:
             while not self._stalled() and not self.destroyed:
+                if self._pbatch is not None:
+                    # resume a parked ChangeBatch dispatch from its row
+                    # cursor — nothing else parses until it drains
+                    # (frame order is delivery order)
+                    self._run_pending_batch()
+                    if self._pbatch is not None:
+                        return  # still stalled mid-batch
+                    continue
                 if self._bulk is not None:
                     # resume a parked frame index from its cursor — an
                     # async ack must NOT re-index/re-decode the remainder
@@ -626,6 +676,7 @@ class Decoder:
             not self.destroyed
             and not self._overflow
             and self._bulk is None
+            and self._pbatch is None
             and not self._stalled()
         ):
             cbs, self._write_cbs = self._write_cbs, []
@@ -878,6 +929,20 @@ class Decoder:
                         self._state = TYPE_CHANGE
                         self._payload_parts = None
                         self._change_data(buf[start : start + flen])
+                elif type_id == TYPE_CHANGE_BATCH:
+                    # delivery consumes the frame (the change/blob
+                    # doctrine): advance BEFORE dispatch so a handler
+                    # raise resumes at the next frame; an async ack
+                    # parks the ROW cursor in _pbatch, and _consume
+                    # drains it before touching this index again
+                    f += 1
+                    self._missing = 0
+                    self._finish_change_batch(buf[start : start + flen])
+                    if self.destroyed:
+                        self._bulk = None
+                        return
+                    if self._pbatch is not None or self._stalled():
+                        return
                 elif type_id == TYPE_BLOB:
                     if not st["blob_open"]:
                         self._state = TYPE_BLOB
@@ -1083,6 +1148,8 @@ class Decoder:
             return self._change_data(chunk)
         if self._state == TYPE_BLOB:
             return self._blob_data(chunk)
+        if self._state == TYPE_CHANGE_BATCH:
+            return self._batch_data(chunk)
         raise AssertionError(f"bad parser state {self._state}")
 
     def _scan_header(self, chunk: memoryview) -> memoryview | None:
@@ -1114,6 +1181,9 @@ class Decoder:
                     return None
                 if type_id == TYPE_CHANGE:
                     self._state = TYPE_CHANGE
+                    self._payload_parts = None
+                elif type_id == TYPE_CHANGE_BATCH:
+                    self._state = TYPE_CHANGE_BATCH
                     self._payload_parts = None
                 elif type_id == TYPE_BLOB:
                     self._state = TYPE_BLOB
@@ -1214,6 +1284,169 @@ class Decoder:
                         ack.state = 2  # armed: handler went async
                         self._pending += 1
         # default: drop (reference: decode.js:54-56)
+
+    # -- ChangeBatch frames --------------------------------------------------
+
+    def _batch_data(self, chunk: memoryview) -> memoryview | None:
+        """Accumulate a ChangeBatch frame's payload (same slicing as
+        :meth:`_change_data`; batches are routinely larger than one
+        transport chunk, so the slow path here is ordinary)."""
+        if self._payload_parts is None and len(chunk) >= self._missing:
+            payload = chunk[: self._missing]
+            rest = chunk[self._missing :]
+            self._parsed += self._missing
+            self._missing = 0
+            try:
+                self._finish_change_batch(payload)
+            except BaseException:
+                self._requeue_tail(rest)  # handler raise: keep the tail
+                raise
+            return rest
+        if self._payload_parts is None:
+            self._payload_parts = []
+        take = min(len(chunk), self._missing)
+        self._payload_parts.append(bytes(chunk[:take]))
+        self._parsed += take
+        self._missing -= take
+        rest = chunk[take:]
+        if self._missing == 0:
+            parts, self._payload_parts = self._payload_parts, None
+            try:
+                self._finish_change_batch(b"".join(parts))
+            except BaseException:
+                self._requeue_tail(rest)  # handler raise: keep the tail
+                raise
+        return rest
+
+    def _finish_change_batch(self, payload) -> None:
+        """Decode one complete ChangeBatch payload and start dispatching
+        its rows.  Decode is pure array reinterpretation
+        (wire/batch_codec.py) — a structurally corrupt payload (bad
+        width, truncated column, out-of-range index, non-UTF-8
+        dictionary) destroys the session with a ProtocolError exactly
+        like a corrupt per-record Change payload."""
+        from ..wire import batch_codec
+
+        try:
+            cols = batch_codec.decode_change_batch(payload)
+        except ValueError as e:
+            self.destroy(self._protocol_error(str(e), cause=e))
+            return
+        n = len(cols.change)
+        if _OBS.on:
+            _M_DEC_BATCH_FRAMES.inc()
+            _trace_instant("decoder.frame", offset=self._frame_start,
+                           kind="change_batch", rows=n,
+                           wire_len=_header_len(len(payload))
+                           + len(payload))
+        self._state = TYPE_HEADER
+        # digest tap: the whole frame's rows are owed at acceptance (the
+        # blob doctrine — one frame, one accounting point), BEFORE any
+        # row reaches a handler, keeping submit order = wire order
+        self._note_change_batch(cols, n)
+        self._pbatch = {"cols": cols, "row": 0, "n": n, "bbuf": None}
+        self._run_pending_batch()
+
+    def _note_change_batch(self, cols, n: int) -> None:
+        """Hook: one call per accepted ChangeBatch frame with its decoded
+        columns, before row dispatch (the digest decoder re-encodes rows
+        canonically and submits their digests here).  Base: no-op."""
+
+    def _run_pending_batch(self) -> None:
+        """Dispatch rows from the parked batch cursor until done or
+        stalled — the per-row half of batch delivery, only as fast as
+        the registered handler shape allows (a ``change_batch`` handler
+        takes the columns whole; a per-record ``change`` handler gets
+        one slot-built :class:`Change` per row, same contract as the
+        bulk fast loop)."""
+        pb = self._pbatch
+        assert pb is not None
+        cols = pb["cols"]
+        n = pb["n"]
+        row = pb["row"]
+        on_batch = self._on_change_batch
+        if on_batch is not None and row == 0:
+            # whole-batch delivery: one handler call, one ack
+            self._pbatch = None
+            self.changes += n
+            self._batch_rows_seen += n
+            self._batch_frames_done += 1
+            if _OBS.on:
+                _M_DEC_CHANGES.inc(n)
+            ack = _FastAck(self)
+            on_batch(cols, ack)
+            if ack.state != 1:
+                with self._ack_lock:
+                    if ack.state == 0:
+                        ack.state = 2  # armed: handler went async
+                        self._pending += 1
+            return
+        on_change = self._on_change
+        if on_change is None:
+            # no handler: rows drop (reference: decode.js:54-56); the
+            # payload was already structurally validated at decode
+            k = n - row
+            self._pbatch = None
+            self.changes += k
+            self._batch_rows_seen += k
+            self._batch_frames_done += 1
+            if _OBS.on and k:
+                _M_DEC_CHANGES.inc(k)
+            return
+        bbuf = pb["bbuf"]
+        if bbuf is None:
+            # one bytes materialization per batch: bytes slicing +
+            # decoding beats going through memoryview objects (same
+            # measurement as the bulk fast loop's bbuf)
+            bbuf = pb["bbuf"] = cols.buf.tobytes()
+        ko, kl = cols.key_off, cols.key_len
+        so, sl = cols.sub_off, cols.sub_len
+        vo, vl = cols.val_off, cols.val_len
+        cg, fr, tv = cols.change, cols.from_, cols.to
+        row0 = row
+        lock = self._ack_lock
+        mk = Change.__new__
+        mka = _FastAck.__new__
+        Ch = Change
+        FA = _FastAck
+        try:
+            while row < n:
+                c = mk(Ch)
+                # dictionary UTF-8 was validated at decode; this decode
+                # cannot fail structurally
+                c.key = bbuf[ko[row] : ko[row] + kl[row]].decode("utf-8")
+                c.change = int(cg[row])
+                c.from_ = int(fr[row])
+                c.to = int(tv[row])
+                c.value = (bbuf[vo[row] : vo[row] + vl[row]]
+                           if vl[row] >= 0 else b"")
+                c.subset = (bbuf[so[row] : so[row] + sl[row]].decode("utf-8")
+                            if sl[row] >= 0 else "")
+                # delivery consumes the row BEFORE the handler can raise
+                # (the bulk-loop doctrine): a caught raise-then-resume
+                # re-enters at the next row, never re-delivering
+                row += 1
+                self.changes += 1
+                self._batch_rows_seen += 1
+                ack = mka(FA)
+                ack.dec = self
+                ack.state = 0
+                on_change(c, ack)
+                if ack.state != 1:
+                    with lock:
+                        if ack.state == 0:
+                            ack.state = 2  # armed: handler went async
+                            self._pending += 1
+                if self.destroyed or self._pending > 0 \
+                        or self._paused_readers > 0:
+                    return
+        finally:
+            pb["row"] = row
+            if _OBS.on and row > row0:
+                _M_DEC_CHANGES.inc(row - row0)
+            if row >= n and self._pbatch is pb:
+                self._pbatch = None
+                self._batch_frames_done += 1
 
     # -- blob frames ---------------------------------------------------------
 

@@ -26,6 +26,7 @@ re-designed as a pull-based Python object instead of a Node Readable:
 
 from __future__ import annotations
 
+import dataclasses
 from collections import deque
 from time import monotonic as _now
 from typing import Callable, Optional
@@ -33,9 +34,10 @@ from typing import Callable, Optional
 from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
 from ..obs.tracing import trace_instant as _trace_instant
-from ..wire.change_codec import Change, encode_change
-from ..wire.framing import TYPE_BLOB, TYPE_CHANGE, frame_header, \
-    frame_wire_len
+from ..wire.change_codec import Change, _check_uint32, \
+    _encode_change_with, _fastpath_mod, encode_change
+from ..wire.framing import CAP_CHANGE_BATCH, TYPE_BLOB, TYPE_CHANGE, \
+    TYPE_CHANGE_BATCH, frame_header, frame_wire_len
 
 OnDone = Optional[Callable[[], None]]
 
@@ -49,8 +51,34 @@ _M_ENC_PARKED = _counter("encoder.parked.bytes")
 # backpressure park time: how long bytes sat corked/parked behind the
 # blob FIFO before reaching the wire queue
 _H_ENC_PARK = _histogram("encoder.park.seconds")
+# negotiated ChangeBatch frames (OBSERVABILITY.md "wire.batch.*"):
+# frames/rows emitted columnar, and the wire bytes the columnar layout
+# saved vs framing the same rows per-record (exact arithmetic, not an
+# estimate — see batch_codec.estimate_per_record_bytes)
+_M_BATCH_FRAMES = _counter("wire.batch.frames")
+_M_BATCH_ROWS = _counter("wire.batch.rows")
+_M_BATCH_SAVED = _counter("wire.batch.bytes_saved")
 
 DEFAULT_HIGH_WATER = 64 * 1024
+
+
+@dataclasses.dataclass
+class BatchPolicy:
+    """Flush policy for negotiated columnar ``ChangeBatch`` framing.
+
+    Rows accumulate until any bound trips: ``max_rows`` / ``max_bytes``
+    (approximate payload volume), ``max_delay`` seconds since the first
+    pending row (checked on the next submit — there is no timer thread;
+    latency-sensitive producers call :meth:`Encoder.flush_batch`), or an
+    *uncork*: a consumer pulling :meth:`Encoder.read` while the queue is
+    otherwise dry flushes what is pending, so a drained transport never
+    waits on a half-full batch.  A blob open or ``finalize()`` always
+    flushes first (frame order is submission order).
+    """
+
+    max_rows: int = 4096
+    max_bytes: int = 1 << 20
+    max_delay: float | None = None
 
 
 class EncoderDestroyedError(Exception):
@@ -199,10 +227,26 @@ class BlobWriter:
 class Encoder:
     """Pull-based frame producer. See module docstring for semantics."""
 
-    def __init__(self, high_water: int = DEFAULT_HIGH_WATER):
+    def __init__(self, high_water: int = DEFAULT_HIGH_WATER,
+                 peer_caps: int = 0,
+                 batch_policy: BatchPolicy | None = None):
         self.bytes = 0
         self.changes = 0
         self.blobs = 0
+        # capability mask the RECEIVING peer advertised (WIRE.md
+        # "Capability negotiation"); 0 = assume a reference peer, emit
+        # the reference wire byte-exactly.  CAP_CHANGE_BATCH switches
+        # change() to columnar accumulation behind `batch_policy`.
+        self.peer_caps = peer_caps
+        self._batch_policy = batch_policy if batch_policy is not None \
+            else BatchPolicy()
+        # pending ChangeBatch rows: prepared (validated, utf-8 encoded)
+        # tuples + their flush callbacks; byte volume rides the
+        # high-water accounting like parked changes do
+        self._batch_rows: list[tuple] = []
+        self._batch_cbs: list[Callable[[], None]] = []
+        self._batch_pending_bytes = 0
+        self._batch_t0: float | None = None
         self.destroyed = False
         self.finalized = False
         self.finished = False  # terminal: drained past finalize, or destroyed
@@ -264,15 +308,41 @@ class Encoder:
             seek(delivered)
         self._journal = journal
 
+    # -- capability negotiation ---------------------------------------------
+
+    def negotiate(self, peer_caps: int) -> None:
+        """Adopt the receiving peer's advertised capability mask (learned
+        out of band — session setup, app handshake; WIRE.md).  Takes
+        effect for subsequent submissions; revoking ``CAP_CHANGE_BATCH``
+        re-frames any pending rows as per-record ``Change`` frames —
+        the revocation means the peer cannot parse a batch frame, so
+        one must never be emitted after it."""
+        had_batch = self._batching
+        self.peer_caps = peer_caps
+        if had_batch and not self._batching:
+            self._flush_pending_per_record()
+
+    @property
+    def _batching(self) -> bool:
+        return bool(self.peer_caps & CAP_CHANGE_BATCH) \
+            and not self.destroyed
+
     # -- public API ---------------------------------------------------------
 
     def change(self, change: Change | dict, on_flush: OnDone = None) -> bool:
         """Frame a Change. If any blob is open the change is parked and
-        replayed when the blob queue drains (reference: encode.js:102-117)."""
+        replayed when the blob queue drains (reference: encode.js:102-117).
+
+        With ``CAP_CHANGE_BATCH`` negotiated and no blob open, the change
+        instead joins the pending columnar batch (validated now, framed
+        at flush — see :class:`BatchPolicy` for when that happens)."""
         if self.destroyed:
             raise EncoderDestroyedError("change after destroy")
         if self.finalized:
             raise EncoderDestroyedError("change after finalize")
+        if self._batching and not self._open_blobs:
+            self._batch_append(self._prepare_row(change), on_flush)
+            return not self._above_high_water()
         payload = encode_change(change)
         if self._open_blobs:
             self._parked_changes.append(
@@ -282,6 +352,202 @@ class Encoder:
                 _M_ENC_PARKED.inc(len(payload))
             return not self._above_high_water()
         return self._frame_change(payload, on_flush)
+
+    def change_many(self, records, on_flush: OnDone = None) -> bool:
+        """Submit a whole run of changes with per-batch (not per-row)
+        overhead: the fastpath gate is bound ONCE, the framed bytes land
+        in ONE queue entry (one readable wakeup, one journal tee), and
+        ``on_flush`` fires when the run's bytes drain.  Wire bytes are
+        identical to calling :meth:`change` per record — this is the
+        bulk shape of the same API, for log-construction-scale callers.
+        """
+        if self.destroyed:
+            raise EncoderDestroyedError("change after destroy")
+        if self.finalized:
+            raise EncoderDestroyedError("change after finalize")
+        if not isinstance(records, (list, tuple)):
+            records = list(records)
+        if self._open_blobs:
+            # ordering behind the blob FIFO is per-record machinery;
+            # park each (rare shape — bulk producers don't interleave)
+            ok = True
+            for i, rec in enumerate(records):
+                ok = self.change(
+                    rec, on_flush if i == len(records) - 1 else None)
+            return ok
+        if self._batching:
+            prepared = [self._prepare_row(r) for r in records]
+            for i, row in enumerate(prepared):
+                self._batch_append(
+                    row, on_flush if i == len(prepared) - 1 else None,
+                    defer_flush=True)
+            self._maybe_flush_batch()
+            return not self._above_high_water()
+        fp = _fastpath_mod()  # bound once for the whole run
+        out = bytearray()
+        n = 0
+        obs_on = _OBS.on
+        for rec in records:
+            payload = _encode_change_with(fp, rec)
+            header = frame_header(len(payload), TYPE_CHANGE)
+            if obs_on:
+                _trace_instant("encoder.frame",
+                               offset=self.bytes + len(out),
+                               kind="change",
+                               wire_len=len(header) + len(payload))
+            out += header
+            out += payload
+            n += 1
+        if not n:
+            if on_flush is not None:
+                self._after_flush(on_flush)
+            return not self._above_high_water()
+        self.changes += n
+        if obs_on:
+            _M_ENC_CHANGES.inc(n)
+        return self._push(bytes(out), on_flush)
+
+    # -- ChangeBatch accumulation -------------------------------------------
+
+    @staticmethod
+    def _prepare_row(change: Change | dict) -> tuple:
+        """Validate + normalize one record at SUBMIT time (same doctrine
+        as parked changes encoding eagerly: bad input surfaces at the
+        call that supplied it, not at some later flush).  Field
+        extraction and error classes mirror ``_encode_change_with``."""
+        if isinstance(change, dict):
+            if "from" in change:
+                fr = change["from"]
+            elif "from_" in change:
+                fr = change["from_"]
+            else:
+                raise KeyError("from")  # required, same as from_dict
+            key = change["key"]
+            cg = change["change"]
+            to = change["to"]
+            value = change.get("value")
+            subset = change.get("subset")
+        else:
+            key = change.key
+            cg = change.change
+            fr = change.from_
+            to = change.to
+            value = change.value
+            subset = change.subset
+        if key is None:
+            raise ValueError("Change.key is required")
+        return (
+            key.encode("utf-8"),
+            _check_uint32("change", cg),
+            _check_uint32("from", fr),
+            _check_uint32("to", to),
+            None if value is None else bytes(value),
+            None if subset is None else subset.encode("utf-8"),
+        )
+
+    def _note_batch_rows(self, rows: list[tuple]) -> None:
+        """Hook: one call per batch flush with the prepared row tuples,
+        before the frame reaches the queue (the digest encoder submits
+        each row's canonical per-record encoding here).  Base: no-op."""
+
+    def _flush_pending_per_record(self) -> None:
+        """Capability revocation path: the peer can no longer parse
+        batch frames, so pending rows re-frame as per-record ``Change``
+        frames (their flush callbacks fire when the run drains, same
+        timing a batch flush would have given them)."""
+        rows, self._batch_rows = self._batch_rows, []
+        if not rows:
+            return
+        cbs, self._batch_cbs = self._batch_cbs, []
+        self._batch_pending_bytes = 0
+        self._batch_t0 = None
+        fp = _fastpath_mod()  # bound once for the run
+
+        def all_cbs():
+            for cb in cbs:
+                cb()
+
+        last = len(rows) - 1
+        for i, (key, cg, fr, to, val, sub) in enumerate(rows):
+            payload = _encode_change_with(fp, {
+                "key": key.decode("utf-8"), "change": cg, "from": fr,
+                "to": to, "value": val,
+                "subset": None if sub is None else sub.decode("utf-8"),
+            })
+            self._frame_change(
+                payload, all_cbs if (i == last and cbs) else None)
+
+    def _batch_append(self, row: tuple, on_flush: OnDone,
+                      defer_flush: bool = False) -> None:
+        if not self._batch_rows:
+            self._batch_t0 = _now()
+        self._batch_rows.append(row)
+        if on_flush is not None:
+            self._batch_cbs.append(on_flush)
+        # approximate pending volume: heap bytes + fixed columns
+        self._batch_pending_bytes += (
+            len(row[0]) + (len(row[4]) if row[4] is not None else 0)
+            + (len(row[5]) if row[5] is not None else 0) + 24)
+        if not defer_flush:
+            self._maybe_flush_batch()
+
+    def _maybe_flush_batch(self) -> None:
+        pol = self._batch_policy
+        if (len(self._batch_rows) >= pol.max_rows
+                or self._batch_pending_bytes >= pol.max_bytes
+                or (pol.max_delay is not None and self._batch_t0 is not None
+                    and _now() - self._batch_t0 >= pol.max_delay)):
+            self.flush_batch()
+
+    def flush_batch(self) -> None:
+        """Frame every pending batch row NOW as one ``TYPE_CHANGE_BATCH``
+        frame (no-op when nothing is pending)."""
+        rows, self._batch_rows = self._batch_rows, []
+        if not rows:
+            return
+        cbs, self._batch_cbs = self._batch_cbs, []
+        self._batch_pending_bytes = 0
+        self._batch_t0 = None
+        # flush-side tap BEFORE the frame is queued — the batch twin of
+        # _frame_change's submit-before-frame ordering (the TPU encoder
+        # submits per-row digests of the canonical encodings here)
+        self._note_batch_rows(rows)
+        from ..wire import batch_codec
+
+        payload = batch_codec.encode_rows(rows)
+        header = frame_header(len(payload), TYPE_CHANGE_BATCH)
+        n = len(rows)
+        self.changes += n
+        if _OBS.on:
+            _M_ENC_CHANGES.inc(n)
+            _M_BATCH_FRAMES.inc()
+            _M_BATCH_ROWS.inc(n)
+            import numpy as np
+
+            est = batch_codec.estimate_per_record_bytes(
+                np.asarray([len(r[0]) for r in rows], np.int64),
+                np.asarray([-1 if r[5] is None else len(r[5])
+                            for r in rows], np.int64),
+                np.asarray([-1 if r[4] is None else len(r[4])
+                            for r in rows], np.int64),
+                np.asarray([r[1] for r in rows], np.uint32),
+                np.asarray([r[2] for r in rows], np.uint32),
+                np.asarray([r[3] for r in rows], np.uint32),
+            )
+            saved = est - (len(header) + len(payload))
+            if saved > 0:
+                _M_BATCH_SAVED.inc(saved)
+            _trace_instant("encoder.frame", offset=self.bytes,
+                           kind="change_batch", rows=n,
+                           wire_len=len(header) + len(payload))
+        if len(cbs) > 1:
+            def all_cbs(cbs=cbs):
+                for cb in cbs:
+                    cb()
+            cb = all_cbs
+        else:
+            cb = cbs[0] if cbs else None
+        self._push(header + payload, cb)
 
     def _frame_change(self, payload: bytes, on_flush: OnDone) -> bool:
         self.changes += 1
@@ -307,6 +573,10 @@ class Encoder:
             raise EncoderDestroyedError("blob after finalize")
         if not isinstance(length, int) or length <= 0:
             raise ValueError("blob length is required and must be > 0")
+        # frame order is submission order: rows accumulated before this
+        # blob must hit the wire before its header
+        if self._batch_rows:
+            self.flush_batch()
         ws = BlobWriter(self, length, on_flush)
         self.blobs += 1
         if _OBS.on:
@@ -337,6 +607,8 @@ class Encoder:
             raise EncoderDestroyedError(
                 f"finalize with {len(self._open_blobs)} blob(s) still open"
             )
+        if self._batch_rows:
+            self.flush_batch()
         self.finalized = True
         self._finalize_cb = on_flush
         if not self._queue:
@@ -357,6 +629,10 @@ class Encoder:
         """
         if self.destroyed:
             raise EncoderDestroyedError("read after destroy")
+        if not self._queue and self._batch_rows:
+            # uncork: a consumer pulling a dry queue gets what is
+            # pending instead of waiting out the batch policy
+            self.flush_batch()
         if not self._queue:
             if self.finalized:
                 return None
@@ -446,6 +722,9 @@ class Encoder:
         self._queued_bytes = 0
         self._parked_bytes = 0
         self._parked_changes.clear()
+        self._batch_rows.clear()
+        self._batch_cbs.clear()
+        self._batch_pending_bytes = 0
         for cb in self._error_cbs:
             cb(err)
         # Release parked drain callbacks so a producer gated on the drain
@@ -459,7 +738,8 @@ class Encoder:
     # -- internal -----------------------------------------------------------
 
     def _above_high_water(self) -> bool:
-        return self._queued_bytes + self._parked_bytes >= self._high_water
+        return (self._queued_bytes + self._parked_bytes
+                + self._batch_pending_bytes >= self._high_water)
 
     def _push(self, data, on_consumed: OnDone) -> bool:
         data = bytes(data)

@@ -2,10 +2,13 @@
 
 from .change_codec import Change, decode_change, encode_change
 from .framing import (
+    CAP_CHANGE_BATCH,
     KNOWN_TYPES,
+    LOCAL_CAPS,
     MAX_HEADER_LEN,
     TYPE_BLOB,
     TYPE_CHANGE,
+    TYPE_CHANGE_BATCH,
     TYPE_HEADER,
     ProtocolError,
     frame,
@@ -13,14 +16,20 @@ from .framing import (
 )
 from .varint import NeedMoreData, decode_uvarint, encode_uvarint, uvarint_length
 
+# batch_codec is imported lazily by its consumers (it needs numpy; the
+# bare protocol surface must stay importable without it on the path)
+
 __all__ = [
     "Change",
     "decode_change",
     "encode_change",
+    "CAP_CHANGE_BATCH",
     "KNOWN_TYPES",
+    "LOCAL_CAPS",
     "MAX_HEADER_LEN",
     "TYPE_BLOB",
     "TYPE_CHANGE",
+    "TYPE_CHANGE_BATCH",
     "TYPE_HEADER",
     "ProtocolError",
     "frame",

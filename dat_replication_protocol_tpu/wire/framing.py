@@ -18,8 +18,27 @@ from .varint import MAX_VARINT_LEN, encode_uvarint
 TYPE_HEADER = 0  # parser state only; never a valid frame id
 TYPE_CHANGE = 1
 TYPE_BLOB = 2
+# Columnar bulk-change frame (this package's negotiated extension; NOT
+# part of the reference wire — see WIRE.md "ChangeBatch" and PARITY.md).
+# Emitted only to peers that advertised CAP_CHANGE_BATCH; a reference
+# decoder receiving one fails with its standard unknown-type error,
+# which is exactly why the capability handshake exists.
+TYPE_CHANGE_BATCH = 3
 
-KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB)
+KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB, TYPE_CHANGE_BATCH)
+
+# -- capability negotiation (WIRE.md "Capability negotiation") --------------
+#
+# Capability masks are exchanged OUT OF BAND (session setup / app
+# handshake): a session's wire is unidirectional, so the receiving peer
+# advertises what it can parse and the encoder is constructed with (or
+# later told via Encoder.negotiate) the intersection.  An encoder that
+# was never told anything assumes 0 — the reference wire, byte-exact.
+CAP_CHANGE_BATCH = 1  # peer parses TYPE_CHANGE_BATCH frames
+
+# Everything this package's Decoder can parse (the mask a receiver
+# advertises during session setup).
+LOCAL_CAPS = CAP_CHANGE_BATCH
 
 # Upper bound on header size: 10 varint bytes + 1 id byte.
 MAX_HEADER_LEN = MAX_VARINT_LEN + 1

@@ -1,0 +1,634 @@
+"""Columnar ``ChangeBatch`` frames: codec, negotiation, mixed versions.
+
+ISSUE 6 coverage:
+
+* payload codec roundtrips (rows and columns tiers, C-vs-Python
+  byte-exactness, absent-vs-present-empty, width-ladder edges) and
+  structural-corruption rejection;
+* **mixed-version sessions** — a capability-less encoder produces
+  today's wire byte-exactly (new-encoder -> old-decoder golden), and the
+  new decoder consumes per-record wire unchanged (old-encoder ->
+  new-decoder);
+* negotiated sessions end-to-end through every parse path (streaming
+  scanner, chunked straddles, native bulk index), flush policy, blob
+  ordering, backpressure, raise-then-resume;
+* digest parity: a TPU-backend decoder emits identical digests for
+  batch-framed and per-record-framed rows;
+* bulk replay: ``replay_log`` over batch and mixed logs, the columnar
+  batch encoder, canonical re-encode extents.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu import BatchPolicy, CAP_CHANGE_BATCH
+from dat_replication_protocol_tpu.runtime import native, replay
+from dat_replication_protocol_tpu.wire import batch_codec
+from dat_replication_protocol_tpu.wire.change_codec import Change, \
+    encode_change
+from dat_replication_protocol_tpu.wire.framing import LOCAL_CAPS, \
+    TYPE_CHANGE, TYPE_CHANGE_BATCH, frame
+
+
+def drain(e) -> bytes:
+    out = bytearray()
+    while (c := e.read()) not in (None, b""):
+        out += c
+    return bytes(out)
+
+
+def _records(n: int, keyspace: int = 16):
+    return [
+        Change(
+            key=f"key-{i % keyspace:05d}",
+            change=i,
+            from_=i,
+            to=i + 1,
+            value=b"v" * (i % 13) if i % 5 else None,
+            subset="s" if i % 3 else None,
+        )
+        for i in range(n)
+    ]
+
+
+def _rows(recs):
+    return [
+        (r.key.encode(), r.change, r.from_, r.to,
+         None if r.value is None else bytes(r.value),
+         None if r.subset is None else r.subset.encode())
+        for r in recs
+    ]
+
+
+def _expected_dicts(recs):
+    out = []
+    for r in recs:
+        d = r.to_dict()
+        d["value"] = d["value"] if d["value"] is not None else b""
+        d["subset"] = d["subset"] if d["subset"] is not None else ""
+        out.append(d)
+    return out
+
+
+# -- payload codec -----------------------------------------------------------
+
+
+def test_codec_roundtrip_rows_tier():
+    recs = _records(500)
+    payload = batch_codec.encode_rows(_rows(recs))
+    cols = batch_codec.decode_change_batch(payload)
+    assert len(cols.change) == 500
+    got = [cols.row(i).to_dict() for i in range(500)]
+    assert got == _expected_dicts(recs)
+
+
+def test_codec_preserves_absent_vs_present_empty():
+    recs = [
+        Change(key="a", change=1, from_=0, to=1, value=None, subset=None),
+        Change(key="a", change=2, from_=1, to=2, value=b"", subset=""),
+    ]
+    cols = batch_codec.decode_change_batch(
+        batch_codec.encode_rows(_rows(recs)))
+    assert int(cols.val_len[0]) == -1 and int(cols.sub_len[0]) == -1
+    assert int(cols.val_len[1]) == 0 and int(cols.sub_len[1]) == 0
+
+
+def test_codec_width_ladder_edges():
+    # >255 distinct keys forces a 2-byte key index; a >255-byte value
+    # forces a 2-byte value length; both survive the roundtrip
+    recs = [Change(key=f"k{i:04d}", change=i, from_=0, to=1,
+                   value=b"x" * (300 if i == 0 else i % 3))
+            for i in range(300)]
+    payload = batch_codec.encode_rows(_rows(recs))
+    assert payload[1] == 2  # kw
+    assert payload[3] == 2  # vw
+    cols = batch_codec.decode_change_batch(payload)
+    assert [cols.row(i).to_dict() for i in range(300)] \
+        == _expected_dicts(recs)
+
+
+def test_codec_c_and_python_paths_byte_identical(monkeypatch):
+    if not native.available():
+        pytest.skip("native library unavailable")
+    recs = _records(700, keyspace=40)
+    wire = b"".join(frame(TYPE_CHANGE, encode_change(r)) for r in recs)
+    cols, _ = replay.replay_log(np.frombuffer(wire, np.uint8))
+    c_payload = batch_codec.encode_columns(cols)
+    monkeypatch.setenv("DAT_NATIVE_DISABLE", "1")
+    py_payload = batch_codec.encode_columns(cols)
+    assert c_payload == py_payload
+    # and the rows tier (the session encoder's path) agrees too
+    assert batch_codec.encode_rows(_rows(recs)) == c_payload
+
+
+def test_codec_empty_batch_roundtrips():
+    cols = batch_codec.decode_change_batch(batch_codec.encode_rows([]))
+    assert len(cols.change) == 0
+
+
+@pytest.mark.parametrize("mangle, what", [
+    (lambda p: bytes([99]) + p[1:], "version"),
+    (lambda p: p[:1] + bytes([3]) + p[2:], "widths"),
+    (lambda p: p[:-3], "truncated"),
+    (lambda p: p + b"xx", "trailing"),
+])
+def test_codec_rejects_structural_corruption(mangle, what):
+    payload = batch_codec.encode_rows(_rows(_records(40)))
+    with pytest.raises(ValueError):
+        batch_codec.decode_change_batch(mangle(payload))
+
+
+def test_codec_rejects_out_of_range_key_index():
+    recs = [Change(key="only", change=1, from_=0, to=1)]
+    payload = bytearray(batch_codec.encode_rows(_rows(recs)))
+    payload[-1] = 7  # the single row's key index (1 key -> must be 0)
+    with pytest.raises(ValueError):
+        batch_codec.decode_change_batch(bytes(payload))
+
+
+def test_codec_rejects_non_utf8_dictionary():
+    recs = [Change(key="ab", change=1, from_=0, to=1)]
+    payload = bytearray(batch_codec.encode_rows(_rows(recs)))
+    at = bytes(payload).index(b"ab")
+    payload[at] = 0xFF
+    with pytest.raises(ValueError):
+        batch_codec.decode_change_batch(bytes(payload))
+
+
+def test_codec_rejects_entry_splitting_multibyte_char():
+    # two keys whose heaps concatenate to VALID utf-8 ("é" split as
+    # continuation start of key 2) must still be rejected per entry
+    rows = [(b"a\xc3", 1, 0, 1, None, None),
+            (b"\xa9b", 2, 1, 2, None, None)]
+    payload = batch_codec.encode_rows(rows)
+    with pytest.raises(ValueError):
+        batch_codec.decode_change_batch(payload)
+
+
+# -- mixed versions: the golden old-peer contract ---------------------------
+
+
+def test_capability_less_encoder_is_byte_identical_to_reference_wire():
+    """New-encoder -> old-decoder: a session that never negotiated emits
+    today's exact bytes (the test_wire_fixtures transcripts re-derived
+    here against a default-constructed encoder)."""
+    e = protocol.encode()  # no peer_caps: the old wire, byte-exact
+    e.change({"key": "key", "from": 0, "to": 1, "change": 1,
+              "value": b"hello"})
+    b = e.blob(11)
+    b.write(b"hello ")
+    b.write(b"world")
+    b.end()
+    payload = bytes.fromhex("12036b657918012000280132 0568656c6c6f"
+                            .replace(" ", ""))
+    assert drain(e) == (bytes([0x13, 0x01]) + payload
+                       + bytes([0x0C, 0x02]) + b"hello world")
+
+
+def test_old_encoder_wire_through_new_decoder_unchanged():
+    """Old-encoder -> new-decoder: per-record frames decode exactly as
+    before the batch extension existed (every chunking)."""
+    recs = _records(60)
+    wire = b"".join(frame(TYPE_CHANGE, encode_change(r)) for r in recs)
+    for size in (1, 7, len(wire)):
+        d = protocol.decode()
+        got = []
+        d.change(lambda c, done: (got.append(c.to_dict()), done()))
+        for off in range(0, len(wire), size):
+            d.write(wire[off:off + size])
+        d.end()
+        assert d.finished and got == _expected_dicts(recs), size
+
+
+def test_batch_frame_to_capability_less_peer_is_the_unknown_type_error():
+    """The other direction of negotiation: a peer that did NOT advertise
+    the capability rejects the frame id — which is exactly why an
+    encoder must never emit it unnegotiated.  (The reference decoder
+    fails the same way on any unknown id.)"""
+    payload = batch_codec.encode_rows(_rows(_records(3)))
+    wire = frame(TYPE_CHANGE_BATCH, payload)
+
+    class OldDecoder(protocol.Decoder):
+        # yesterday's parser: no batch dispatch
+        def _finish_change_batch(self, payload):
+            raise AssertionError("unreachable in this simulation")
+
+        def _scan_header(self, chunk):
+            return protocol.Decoder._scan_header(self, chunk)
+
+    d = protocol.decode()
+    errs = []
+    d.on_error(lambda e: errs.append(e))
+    d.write(wire)  # the NEW decoder accepts it...
+    assert not errs and d.changes == 3
+
+    # ...and the negotiation constants say when it may be sent
+    assert protocol.Decoder.capabilities() == LOCAL_CAPS
+    assert LOCAL_CAPS & CAP_CHANGE_BATCH
+
+
+# -- negotiated sessions end-to-end -----------------------------------------
+
+
+def _negotiated_session(n=250, policy=None, **enc_kw):
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH,
+                        batch_policy=policy, **enc_kw)
+    recs = _records(n)
+    for r in recs:
+        e.change(r)
+    e.finalize()
+    return drain(e), recs
+
+
+@pytest.mark.parametrize("size", [1, 9, 4096, 1 << 20])
+def test_negotiated_wire_delivers_per_row_on_every_parse_path(size):
+    wire, recs = _negotiated_session(300, BatchPolicy(max_rows=64))
+    d = protocol.decode()
+    got = []
+    d.change(lambda c, done: (got.append(c.to_dict()), done()))
+    for off in range(0, len(wire), size):
+        d.write(wire[off:off + size])
+    d.end()
+    assert d.finished
+    assert got == _expected_dicts(recs)
+    assert d.changes == 300
+
+
+def test_change_batch_handler_gets_whole_columns():
+    wire, recs = _negotiated_session(200)
+    d = protocol.decode()
+    batches = []
+    d.change_batch(lambda cols, done: (batches.append(cols), done()))
+    d.write(wire)
+    d.end()
+    assert d.finished and d.changes == 200
+    assert sum(len(b.change) for b in batches) == 200
+    assert batches[0].row(0).to_dict() == _expected_dicts(recs)[0]
+
+
+def test_flush_policy_max_rows_sizes_frames():
+    wire, _ = _negotiated_session(250, BatchPolicy(max_rows=100))
+    frames_idx = replay.split_frames(np.frombuffer(wire, np.uint8))
+    batch = frames_idx.ids == TYPE_CHANGE_BATCH
+    assert int(batch.sum()) == 3  # 100 + 100 + 50 (finalize flush)
+
+
+def test_blob_flushes_pending_rows_first():
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    e.change({"key": "before", "change": 1, "from": 0, "to": 1})
+    b = e.blob(3)
+    b.end(b"xyz")
+    e.change({"key": "after", "change": 2, "from": 1, "to": 2})
+    e.finalize()
+    wire = drain(e)
+    d = protocol.decode()
+    events = []
+    d.change(lambda c, done: (events.append(("change", c.key)), done()))
+    d.blob(lambda bl, done: bl.collect(
+        lambda data: (events.append(("blob", data)), done())))
+    d.write(wire)
+    d.end()
+    assert events == [("change", "before"), ("blob", b"xyz"),
+                      ("change", "after")]
+
+
+def test_read_uncorks_pending_rows():
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    # no flush trigger fired yet — but a hungry consumer must not wait
+    data = e.read()
+    assert data and data[1] == TYPE_CHANGE_BATCH
+
+
+def test_max_delay_flushes_on_next_submit():
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH,
+                        batch_policy=BatchPolicy(max_delay=0.0))
+    e.change({"key": "a", "change": 1, "from": 0, "to": 1})
+    # delay 0: the NEXT submit sees the deadline expired and flushes
+    e.change({"key": "b", "change": 2, "from": 1, "to": 2})
+    assert e.bytes > 0  # first flush happened without finalize
+
+
+def test_negotiate_revocation_reframes_pending_rows_per_record():
+    """Revoking the capability means the peer CANNOT parse a batch
+    frame — rows pending at revocation must re-frame per-record, so a
+    reference peer sees only frame ids it understands."""
+    e = protocol.encode()
+    e.negotiate(CAP_CHANGE_BATCH)
+    fired = []
+    e.change({"key": "a", "change": 1, "from": 0, "to": 1,
+              "value": b"x", "subset": "s"},
+             on_flush=lambda: fired.append(1))
+    e.negotiate(0)
+    e.change({"key": "b", "change": 2, "from": 1, "to": 2})
+    e.finalize()
+    wire = drain(e)
+    idx = replay.split_frames(np.frombuffer(wire, np.uint8))
+    assert idx.ids.tolist() == [TYPE_CHANGE, TYPE_CHANGE]
+    assert fired == [1]  # the pending row's flush callback still fires
+    # and the re-framed bytes are the canonical per-record encoding
+    assert wire == frame(TYPE_CHANGE, encode_change(
+        Change(key="a", change=1, from_=0, to=1, value=b"x", subset="s"))
+    ) + frame(TYPE_CHANGE, encode_change(
+        Change(key="b", change=2, from_=1, to=2)))
+
+
+def test_on_flush_callbacks_fire_when_batch_drains():
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    fired = []
+    e.change({"key": "a", "change": 1, "from": 0, "to": 1},
+             on_flush=lambda: fired.append("a"))
+    e.change({"key": "b", "change": 2, "from": 1, "to": 2},
+             on_flush=lambda: fired.append("b"))
+    assert fired == []
+    e.finalize()
+    drain(e)
+    assert fired == ["a", "b"]
+
+
+def test_batch_pending_rows_count_toward_high_water():
+    e = protocol.encode(high_water=256, peer_caps=CAP_CHANGE_BATCH,
+                        batch_policy=BatchPolicy(max_rows=1 << 30,
+                                                 max_bytes=1 << 30))
+    ok = True
+    for i in range(40):
+        ok = e.change({"key": f"k-{i}", "change": i, "from": i, "to": i + 1})
+    assert not ok and not e.writable()
+
+
+def test_bad_row_raises_at_submit_not_flush():
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    with pytest.raises(ValueError):
+        e.change({"key": "k", "change": -1, "from": 0, "to": 1})
+    with pytest.raises(KeyError):
+        e.change({"key": "k", "change": 1, "to": 1})
+    # the session is still healthy; pending state unpolluted
+    e.change({"key": "k", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    assert drain(e)
+
+
+def test_mid_batch_async_ack_stalls_and_resumes_in_order():
+    wire, recs = _negotiated_session(30)
+    d = protocol.decode()
+    rows, pend = [], []
+
+    def handler(c, done):
+        rows.append(c.change)
+        if c.change == 10:
+            pend.append(done)
+        else:
+            done()
+
+    d.change(handler)
+    assert not d.write(wire)
+    assert rows == list(range(11)) and not d.writable()
+    d.end()
+    assert not d.finished
+    pend.pop()()
+    assert d.finished and rows == list(range(30))
+
+
+def test_mid_batch_handler_raise_resumes_at_next_row():
+    wire, _ = _negotiated_session(20)
+    d = protocol.decode()
+    rows = []
+
+    def handler(c, done):
+        rows.append(c.change)
+        if c.change == 5 and rows.count(5) == 1:
+            raise RuntimeError("app hiccup")
+        done()
+
+    d.change(handler)
+    with pytest.raises(RuntimeError):
+        d.write(wire)
+    assert rows == list(range(6))
+    d.write(b"")  # caught-and-continue: next write resumes the cursor
+    d.end()
+    assert d.finished and rows == list(range(20))  # no redelivery
+
+
+def test_corrupt_batch_payload_is_structured_protocol_error():
+    payload = batch_codec.encode_rows(_rows(_records(10)))
+    bad = bytearray(frame(TYPE_CHANGE_BATCH, payload))
+    bad[3] = 0xEE  # inside the width header: structurally corrupt
+    d = protocol.decode()
+    errs = []
+    d.on_error(lambda e: errs.append(e))
+    d.write(bytes(bad))
+    assert d.destroyed and len(errs) == 1
+    assert errs[0].frame == 0 and errs[0].offset is not None
+
+
+def test_frames_delivered_counts_batches_as_single_frames():
+    wire, _ = _negotiated_session(100, BatchPolicy(max_rows=50))
+    d = protocol.decode()
+    d.change(lambda c, done: done())
+    d.write(wire)
+    d.end()
+    assert d.changes == 100
+    assert d._frames_delivered() == 2  # two 50-row frames
+    ckpt = d.checkpoint()
+    assert ckpt.frame == 2 and ckpt.row == 100
+    assert ckpt.wire_offset == len(wire)
+
+
+def test_change_many_per_record_mode_matches_per_call_bytes():
+    recs = _records(50)
+    e1 = protocol.encode()
+    for r in recs:
+        e1.change(r)
+    e1.finalize()
+    e2 = protocol.encode()
+    fired = []
+    e2.change_many(recs, on_flush=lambda: fired.append(1))
+    e2.finalize()
+    assert drain(e1) == drain(e2)
+    assert fired == [1] and e2.changes == 50
+
+
+def test_change_many_batching_mode_delivers_all_rows():
+    recs = _records(50)
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    e.change_many(recs)
+    e.finalize()
+    d = protocol.decode()
+    got = []
+    d.change(lambda c, done: (got.append(c.to_dict()), done()))
+    d.write(drain(e))
+    d.end()
+    assert got == _expected_dicts(recs)
+
+
+# -- digest parity (TPU backend) --------------------------------------------
+
+
+def _digests(wire: bytes):
+    d = protocol.decode(backend="tpu")
+    out = []
+    d.on_digest(lambda kind, seq, dg: out.append((kind, seq, dg)))
+    d.change(lambda c, done: done())
+    d.blob(lambda b, done: b.collect(lambda _x: done()))
+    d.write(wire)
+    d.end()
+    assert d.finished
+    return out
+
+
+def test_tpu_encoder_digest_stream_survives_batch_negotiation():
+    """Send-side digest parity: a negotiated TpuEncoder delivers the
+    SAME (kind, seq, digest) stream per-record framing would have —
+    batch flushes submit each row's canonical encoding."""
+    recs = _records(40)
+
+    def encoder_digests(**kw):
+        e = protocol.encode(backend="tpu", **kw)
+        out = []
+        e.on_digest(lambda kind, seq, dg: out.append((kind, seq, dg)))
+        for r in recs:
+            e.change(r)
+        w = e.blob(4)
+        w.end(b"data")
+        e.finalize()
+        drain(e)
+        e.digest_pipeline.flush()
+        return out
+
+    assert encoder_digests(peer_caps=CAP_CHANGE_BATCH) == encoder_digests()
+    assert len(encoder_digests()) == 41  # 40 changes + 1 blob
+
+
+def test_digest_stream_identical_for_batch_and_per_record_wire():
+    recs = _records(64)
+    per_record = b"".join(frame(TYPE_CHANGE, encode_change(r))
+                          for r in recs)
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    for r in recs:
+        e.change(r)
+    w = e.blob(4)
+    w.end(b"data")
+    e.finalize()
+    batched = drain(e)
+    assert _digests(per_record + frame(2, b"data")) == _digests(batched)
+
+
+# -- bulk replay -------------------------------------------------------------
+
+
+def _cols_equal(a, b) -> bool:
+    n = len(a.change)
+    if n != len(b.change):
+        return False
+    return all(a.row(i).to_dict() == b.row(i).to_dict()
+               for i in range(0, n, max(1, n // 64)))
+
+
+def test_replay_log_over_batch_wire_matches_per_record_wire():
+    recs = _records(5000, keyspace=128)
+    pr_wire = b"".join(frame(TYPE_CHANGE, encode_change(r)) for r in recs)
+    cols_pr, _ = replay.replay_log(np.frombuffer(pr_wire, np.uint8))
+    b_wire = replay.encode_batch_frames(cols_pr, rows_per_batch=1024)
+    assert len(b_wire) < len(pr_wire)  # the dictionary earns its bytes
+    cols_b, frames_b = replay.replay_log(np.frombuffer(b_wire, np.uint8))
+    assert _cols_equal(cols_pr, cols_b)
+    assert int((frames_b.ids == TYPE_CHANGE_BATCH).sum()) == 5
+
+
+def test_replay_log_mixed_frames_keeps_wire_order():
+    recs = _records(30)
+    pr = b"".join(frame(TYPE_CHANGE, encode_change(r)) for r in recs[:10])
+    cols_mid, _ = replay.replay_log(np.frombuffer(
+        b"".join(frame(TYPE_CHANGE, encode_change(r))
+                 for r in recs[10:20]), np.uint8))
+    mid = replay.encode_batch_frames(cols_mid)
+    tail = b"".join(frame(TYPE_CHANGE, encode_change(r))
+                    for r in recs[20:])
+    blob = frame(2, b"BLOB")
+    mixed = pr + blob + mid + tail
+    cols, frames = replay.replay_log(np.frombuffer(mixed, np.uint8))
+    assert [cols.row(i).to_dict() for i in range(30)] \
+        == _expected_dicts(recs)
+
+
+def test_canonical_payloads_match_per_record_encodings():
+    recs = _records(40)
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    for r in recs:
+        e.change(r)
+    e.finalize()
+    cols, _ = replay.replay_log(np.frombuffer(drain(e), np.uint8))
+    assert replay.canonical_change_payloads(cols) \
+        == [encode_change(r) for r in recs]
+
+
+def test_leaves_from_columns_falls_back_for_batch_logs():
+    from dat_replication_protocol_tpu.batch import feed
+
+    recs = _records(32)
+    pr_wire = b"".join(frame(TYPE_CHANGE, encode_change(r)) for r in recs)
+    cols_pr, frames_pr = replay.replay_log(np.frombuffer(pr_wire, np.uint8))
+    b_wire = replay.encode_batch_frames(cols_pr)
+    cols_b, frames_b = replay.replay_log(np.frombuffer(b_wire, np.uint8))
+    leaves_pr = feed.leaves_from_columns(cols_pr, frames_pr)
+    leaves_b = feed.leaves_from_columns(cols_b, frames_b)
+    assert np.array_equal(leaves_pr, leaves_b)
+
+
+def test_decode_batch_device_matches_host_columns():
+    from dat_replication_protocol_tpu.batch import feed
+
+    recs = _records(100)
+    payload = batch_codec.encode_rows(_rows(recs))
+    dev = feed.decode_batch_device(payload)
+    assert len(dev) == 100
+    cols = batch_codec.decode_change_batch(payload)
+    assert np.array_equal(np.asarray(dev.change), cols.change)
+    assert np.array_equal(np.asarray(dev.from_), cols.from_)
+    assert np.array_equal(np.asarray(dev.to), cols.to)
+    assert np.array_equal(np.asarray(dev.val_off), cols.val_off)
+    # the device-resident buffer serves value gathers directly
+    vo, vl = int(cols.val_off[1]), int(cols.val_len[1])
+    assert bytes(np.asarray(dev.buf[vo:vo + vl]).tobytes()) \
+        == bytes(recs[1].value)
+
+
+def test_wire_batch_counters_account_rows_and_savings(obs_enabled):
+    from dat_replication_protocol_tpu.obs.metrics import REGISTRY
+
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    recs = _records(200, keyspace=8)  # hot keys: the dictionary saves
+    for r in recs:
+        e.change(r)
+    e.finalize()
+    wire = drain(e)
+    d = protocol.decode()
+    d.change(lambda c, done: done())
+    d.write(wire)
+    d.end()
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["wire.batch.frames"] == 1
+    assert counters["wire.batch.rows"] == 200
+    per_record = sum(
+        len(frame(TYPE_CHANGE, encode_change(r))) for r in recs)
+    assert counters["wire.batch.bytes_saved"] == per_record - len(wire)
+    assert counters["decoder.batch.frames"] == 1
+    assert counters["decoder.changes"] == 200
+
+
+def test_python_fallback_decoder_paths(monkeypatch):
+    """The whole negotiated path with every native tier disabled: same
+    rows, same order (the vectorized-Python tier contract)."""
+    monkeypatch.setenv("DAT_NATIVE_DISABLE", "1")
+    monkeypatch.setenv("DAT_FASTPATH_DISABLE", "1")
+    wire, recs = _negotiated_session(120, BatchPolicy(max_rows=48))
+    d = protocol.decode()
+    got = []
+    d.change(lambda c, done: (got.append(c.to_dict()), done()))
+    for off in range(0, len(wire), 31):
+        d.write(wire[off:off + 31])
+    d.end()
+    assert d.finished and got == _expected_dicts(recs)

@@ -378,6 +378,44 @@ def test_wire_parity_single_site_constrains_nothing(tmp_path):
     assert _lint(tmp_path, ("only.py", "TYPE_CHANGE = 99\n")) == []
 
 
+# ChangeBatch extension constants: the frame id, the payload version
+# byte, and the capability-negotiation bit are all watched — a fork in
+# any of them ships a peer that silently stops understanding itself
+BATCH_PY = '''
+TYPE_CHANGE_BATCH = 3
+CAP_CHANGE_BATCH = 1
+BATCH_VERSION = 1
+'''
+
+BATCH_C_GOOD = '''
+// wire: TYPE_CHANGE_BATCH = 3
+constexpr int BATCH_VERSION = 1;
+'''
+
+
+def test_wire_parity_covers_change_batch_constants(tmp_path):
+    bad = BATCH_C_GOOD.replace("TYPE_CHANGE_BATCH = 3",
+                               "TYPE_CHANGE_BATCH = 4").replace(
+        "BATCH_VERSION = 1;", "BATCH_VERSION = 2;")
+    findings = _lint(tmp_path, ("consts.py", BATCH_PY),
+                     ("native.cpp", bad))
+    drift = [f for f in findings if f.rule == "wire-constant-parity"]
+    assert {m.split("wire constant ")[1].split(" ")[0] for m in
+            (f.message for f in drift)} == {"TYPE_CHANGE_BATCH",
+                                            "BATCH_VERSION"}
+
+
+def test_wire_parity_change_batch_clean_when_agreeing(tmp_path):
+    assert _lint(tmp_path, ("consts.py", BATCH_PY),
+                 ("native.cpp", BATCH_C_GOOD)) == []
+
+
+def test_wire_parity_cap_constant_python_python_drift(tmp_path):
+    findings = _lint(tmp_path, ("a.py", "CAP_CHANGE_BATCH = 1\n"),
+                     ("b.py", "CAP_CHANGE_BATCH = 2\n"))
+    assert _rules_fired(findings) == {"wire-constant-parity"}
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_line_suppression_silences_one_finding(tmp_path):

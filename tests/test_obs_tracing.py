@@ -171,6 +171,64 @@ def test_decoder_frame_tags_agree_with_encoder_on_every_parse_path(
         assert sum(f.get("frames", 1) for f in frames) == 312, size
 
 
+def _build_batch_session():
+    """The negotiated-session variant: ChangeBatch frames + blobs +
+    per-record parked tail, journaled for the wire."""
+    from dat_replication_protocol_tpu import BatchPolicy, CAP_CHANGE_BATCH
+
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH,
+                        batch_policy=BatchPolicy(max_rows=64))
+    j = WireJournal()
+    e.attach_journal(j)
+    for i in range(200):
+        e.change({"key": f"bulk-{i % 8}", "change": i, "from": i,
+                  "to": i + 1, "value": b"v" * (i % 24)})
+    b1 = e.blob(11)
+    b1.write(b"hello ")
+    e.change({"key": "parked", "change": 99, "from": 0, "to": 1})
+    b1.end(b"world")
+    for i in range(8):
+        e.change({"key": f"tail-{i}", "change": i, "from": i, "to": i + 1})
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    return j.read_from(0)
+
+
+def test_batch_frame_tags_tile_the_wire_on_both_peers(obs_enabled):
+    """ChangeBatch frames carry the same wire-offset causal key as
+    per-record frames: encoder tags at emission, decoder tags at
+    dispatch, and BOTH tag sets tile the wire on every parse path —
+    the timeline contract survives the columnar framing."""
+    wire = _build_batch_session()
+    enc_frames = [f for f in _frame_records() if f["name"] == "encoder.frame"]
+    _assert_tiles(enc_frames, len(wire))
+    batch_tags = [f for f in enc_frames if f["kind"] == "change_batch"]
+    # 200 rows in 64-row frames (blob flush at 200) + 1 parked per-record
+    # + 8 tail rows batched at finalize
+    assert len(batch_tags) == 5
+    assert sum(f["rows"] for f in batch_tags) == 208
+    enc_set = {(f["offset"], f["wire_len"]) for f in enc_frames}
+    for size in (7, 4096, len(wire)):
+        SPANS.clear()
+        dec = protocol.decode()
+        dec.change(lambda c, done: done())
+        dec.blob(lambda b, done: b.collect(lambda _d: done()))
+        for off in range(0, len(wire), size):
+            dec.write(wire[off:off + size])
+        dec.end()
+        assert dec.finished
+        frames = [f for f in _frame_records()
+                  if f["name"].startswith("decoder.frame")]
+        _assert_tiles(frames, len(wire))
+        dec_batch = [f for f in frames if f.get("kind") == "change_batch"]
+        assert len(dec_batch) == 5 and sum(
+            f["rows"] for f in dec_batch) == 208, size
+        for f in frames:
+            if f["name"] == "decoder.frame":
+                assert (f["offset"], f["wire_len"]) in enc_set, (size, f)
+
+
 def test_frame_offsets_stay_absolute_across_resume(obs_enabled):
     """A decoder that survives a mid-session fault keeps counting wire
     offsets absolutely — resumed frames tag where they truly live."""
