@@ -86,3 +86,20 @@ if [ ! -f "$OUT/.leg_reconcile_done" ]; then
     && touch "$OUT/.leg_reconcile_done"
   commit_out "r05 watch: 1M+1M reconcile TPU capture ($STAMP)"
 fi
+
+# 4) ISSUE 7: fused-route device capture — the fused1p extraction kernel
+#    on config 4 and config 8's device-group A/B (single-residency
+#    pipeline vs host-repack two-pass), so the next window records the
+#    single-pass device story without hand-holding.  BENCH_FUSED_DEVICE
+#    makes config 8 run its device leg (it initializes jax itself; this
+#    script only fires when the tunnel answers, and the bench deadline
+#    watchdog bounds a mid-run wedge).
+if [ ! -f "$OUT/.leg_fused_done" ]; then
+  BENCH_CONFIGS=4,8 BENCH_FUSED_DEVICE=1 DAT_CDC_ROUTE=fused1p \
+    BENCH_DEADLINE=1200 timeout 1400 \
+    python bench.py >"$OUT/fused_$STAMP.json" 2>"$OUT/fused_$STAMP.log"
+  tail -c 16384 "$OUT/fused_$STAMP.log" >"$OUT/fused_$STAMP.log.tail" \
+    && rm -f "$OUT/fused_$STAMP.log"
+  device_artifact "$OUT/fused_$STAMP.json" && touch "$OUT/.leg_fused_done"
+  commit_out "r06 watch: fused single-pass device capture ($STAMP)"
+fi

@@ -43,6 +43,13 @@ WATCHLIST = frozenset({
     # capability bit that gates emitting it (negotiation constants —
     # a fork here is a peer that silently stops understanding itself)
     "BATCH_VERSION", "CAP_CHANGE_BATCH",
+    # gear CDC scramble constants (ISSUE 7): written down independently
+    # in ops/rabin.py and in BOTH native scan loops (dat_gear_candidates
+    # and the fused dat_cdc_hash).  A fork here is not a wire fork but a
+    # ROUTE fork — two "equivalent" CDC engines silently cutting
+    # different chunks, the exact divergence the fused1p cross-checks
+    # exist to refuse
+    "GEAR_C1", "GEAR_C2",
 })
 
 _C_PATTERNS = (

@@ -230,6 +230,14 @@ class DigestPipeline:
         If more than ``max_inflight`` batches would be outstanding, the
         oldest is collected first — bounded in-flight work is the
         device-side analogue of the reference's pending counter.
+
+        Pipelined readback (ISSUE 7 part 3): the moment a NEWER batch is
+        dispatched, every older in-flight batch's digest D2H is STARTED
+        (``collect.start_d2h``, non-blocking) — so when the in-flight
+        bound forces ``_deliver_oldest`` below, the transfer has been
+        streaming under this batch's compute instead of starting cold
+        inside the deliver, and the next submit never waits on a full
+        link round-trip.
         """
         if not self._entries:
             return
@@ -243,9 +251,17 @@ class DigestPipeline:
         with _trace_span("device.dispatch", items=len(entries),
                          bytes=pending), span("digest.dispatch"):
             collect = self._hash_begin(payloads) if payloads else (lambda: [])
+        self._prefetch_inflight()  # older batches' D2H rides under this
+        # batch's compute (idempotent per closure)
         self._inflight.append((entries, collect))
         while len(self._inflight) > self._max_inflight:
             self._deliver_oldest()
+
+    def _prefetch_inflight(self) -> None:
+        for _, collect in self._inflight:
+            start = getattr(collect, "start_d2h", None)
+            if start is not None:
+                start()
 
     def _deliver_oldest(self) -> None:
         entries, collect = self._inflight.pop(0)
@@ -275,6 +291,8 @@ class DigestPipeline:
         """Dispatch anything queued and deliver ALL outstanding digests in
         submit order — the flush-before-finalize barrier."""
         self.dispatch()
+        self._prefetch_inflight()  # all readbacks stream concurrently;
+        # the in-order delivery loop below then waits on warm transfers
         while self._inflight:
             self._deliver_oldest()
 

@@ -41,6 +41,11 @@ BLOCK_BYTES = blake2b.BLOCK_BYTES
 # batch edge: one pair of counters tells the whole transfer story
 _M_H2D = _counter("device.h2d.bytes")
 _M_D2H = _counter("device.d2h.bytes")
+# bytes staged while earlier dispatches were still in flight: the
+# transfer/compute-overlap evidence of the double-buffered upload path
+# (ISSUE 7; OBSERVABILITY.md single-pass catalog).  overlap == h2d on a
+# saturated pipeline; 0 means every upload waited for an idle device.
+_M_H2D_OVERLAP = _counter("device.h2d.overlap")
 
 
 def pack_ragged(buf: np.ndarray, offs: np.ndarray, lens: np.ndarray,
@@ -184,15 +189,23 @@ def hash_extents_device(buf: np.ndarray, offs, lens,
             # still bounds how many ride in flight
             chunk_b = max(chunk_b, blake2b._PALLAS_MIN_ITEMS)
         chunk_b = blake2b._bucket_nblocks(min(chunk_b, max(1, B)))
-        if use_pallas and chunk_b >= blake2b._PALLAS_MIN_ITEMS:
-            from ..ops.blake2b_pallas import blake2b_packed_pallas as fn
+        donate = blake2b.donation_supported()
+        pallas_pick = use_pallas and chunk_b >= blake2b._PALLAS_MIN_ITEMS
+        if pallas_pick:
+            if donate:
+                from ..ops.blake2b_pallas import (
+                    blake2b_packed_pallas_donated as fn,
+                )
+            else:
+                from ..ops.blake2b_pallas import blake2b_packed_pallas as fn
         else:
-            fn = blake2b.blake2b_packed
+            fn = (blake2b.blake2b_packed_donated if donate
+                  else blake2b.blake2b_packed)
         if _OBS.on:
             # keyed per bucket, same rationale as the blake2b batch edge
             _note_engine(
                 "feed.hash_extents",
-                "pallas" if fn is not blake2b.blake2b_packed else "xla-scan",
+                "pallas" if pallas_pick else "xla-scan",
                 key=nb, items=B, nblocks=nb)
         for c0 in range(0, B, chunk_b):
             sub = idx[c0:c0 + chunk_b]
@@ -207,8 +220,13 @@ def hash_extents_device(buf: np.ndarray, offs, lens,
                     blens = np.pad(blens, (0, chunk_b - bs))
                 if _OBS.on:
                     _M_H2D.inc(mh.nbytes + ml.nbytes + blens.nbytes)
+                    if fences:
+                        # staged while older dispatches still compress:
+                        # this upload rides UNDER compute, not after it
+                        _M_H2D_OVERLAP.inc(mh.nbytes + ml.nbytes)
                 # stage the upload: the transfer streams while earlier
-                # chunks are still compressing
+                # chunks are still compressing, into HBM the donated
+                # dispatches below keep recycling (double-buffering)
                 mh_d = jax.device_put(mh)
                 ml_d = jax.device_put(ml)
                 hh, hl = fn(mh_d, ml_d, jnp.asarray(blens))

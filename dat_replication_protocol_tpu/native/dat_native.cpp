@@ -1176,6 +1176,8 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
   // instead of silently computing with a garbage mask
   if (avg_bits < 1 || avg_bits > 31 || thin_bits > 31 || cap < 0)
     return DAT_ERR_BAD_RECORD;
+  // wire: GEAR_C1 = 0x9E3779B1
+  // wire: GEAR_C2 = 0x85EBCA77
   const uint32_t c1 = 0x9E3779B1u, c2 = 0x85EBCA77u;
   uint64_t tab[256];
   for (uint32_t b = 0; b < 256; ++b) {
@@ -1240,6 +1242,715 @@ int64_t dat_gear_candidates(const uint8_t* buf, int64_t n, int64_t avg_bits,
     }
   }
   delete[] slab;
+  return m;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Single-pass content addressing: fused gear CDC + BLAKE2b (ISSUE 7).
+//
+// The two-pass host route streams every blob byte through DRAM twice —
+// once for the gear candidate scan, once for the BLAKE2b digest pass.
+// dat_cdc_hash collapses the pipeline into ONE sweep: the stream is
+// processed in cache-sized slabs, and while slab k+1 is being gear-
+// scanned, the chunks finalized in slab k (still cache-resident) are
+// hashed by a multi-lane BLAKE2b engine whose compressions are
+// interleaved INTO the scan loop's instruction stream.  The gear chain
+// is scalar and latency-bound (it leaves the vector ports idle); the
+// BLAKE2b rounds are vector-port-bound (they leave the scalar ALUs
+// idle) — interleaving the two lets one out-of-order core run both
+// concurrently, so the fused pass approaches max(gear, hash) instead of
+// gear + hash.  Candidates, thinning, greedy min/max selection, and
+// digests are all byte-identical to the two-pass route (same gear_seed,
+// same per-window thinning + seam merge, same dat_greedy_select
+// semantics, same RFC 7693 compression) — the fuzz suite pins this.
+//
+// The 8-lane engine below is AVX-512F (native 64-bit rotates via
+// vprorq, double the lane width of the AVX2 engine); b2b_many_avx2 and
+// its callers are untouched — the incumbent two-pass route keeps its
+// tested engine, and the A/B in bench.py config 8 is route vs route.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Resumable 4-chain gear scanner: gear_scan_range4's machinery hoisted
+// into a struct so the fused loop can advance the scan a few bytes per
+// BLAKE2b round.  Same quartering, same per-chain seeding from the
+// preceding WINDOW bytes, same per-window thinning with sticky
+// overflow poison; the caller's ordered merge resolves seam straddles
+// exactly like dat_gear_candidates' merge.
+struct GearQuad {
+  uint64_t h[4];
+  int64_t j[4], qhi[4], lw[4], m[4];
+  const uint8_t* buf = nullptr;
+  const uint64_t* tab = nullptr;
+  int64_t* dst = nullptr;  // 4 slabs of qcap each
+  int64_t qcap = 0, thin = -1;
+  uint32_t mask = 0;
+
+  void init(const uint8_t* b, int64_t lo, int64_t hi, const uint64_t* t,
+            uint32_t msk, int64_t thin_bits, int64_t* d, int64_t cap) {
+    buf = b;
+    tab = t;
+    mask = msk;
+    thin = thin_bits;
+    dst = d;
+    qcap = cap;
+    int64_t qlen = (hi - lo) / 4;
+    for (int c = 0; c < 4; ++c) {
+      int64_t qlo = lo + c * qlen;
+      qhi[c] = c == 3 ? hi : qlo + qlen;
+      h[c] = gear_seed(buf, qlo, tab);
+      j[c] = qlo;
+      lw[c] = -1;
+      m[c] = 0;
+    }
+  }
+
+  inline void emit(int c, int64_t pos) {
+    if (m[c] < 0) return;  // sticky overflow poison (see gear_scan_range4)
+    if (thin >= 0) {
+      int64_t win = pos >> thin;
+      if (win == lw[c]) return;
+      lw[c] = win;
+    }
+    if (m[c] >= qcap) {
+      m[c] = -1;
+      return;
+    }
+    dst[c * qcap + m[c]] = pos;
+    ++m[c];
+  }
+
+  // Advance every live chain by up to per_chain bytes; returns whether
+  // any chain still has bytes.  The lockstep fast path runs all four
+  // chains with no per-byte bounds checks (the checked variant measured
+  // ~2x slower — the branch per byte per chain defeats the 4-way ILP
+  // pipelining the interleave exists for); ragged tails finish in
+  // per-chain checked loops once the shortest chain drains.
+  inline bool advance(int64_t per_chain) {
+    int64_t steps = per_chain;
+    for (int c = 0; c < 4; ++c) {
+      int64_t rem = qhi[c] - j[c];
+      if (rem < steps) steps = rem;
+    }
+    if (steps > 0) {
+      // one 64-bit mask test per byte (vs shift+and+cmp): the top-word
+      // candidate check as hh & (mask << 32) — test+branch macro-fuse
+      const uint64_t mask64 = static_cast<uint64_t>(mask) << 32;
+      uint64_t h0 = h[0], h1 = h[1], h2 = h[2], h3 = h[3];
+      int64_t j0 = j[0], j1 = j[1], j2 = j[2], j3 = j[3];
+      for (int64_t s = 0; s < steps; ++s) {
+        h0 = (h0 << 1) + tab[buf[j0]];
+        h1 = (h1 << 1) + tab[buf[j1]];
+        h2 = (h2 << 1) + tab[buf[j2]];
+        h3 = (h3 << 1) + tab[buf[j3]];
+        if ((h0 & mask64) == 0) emit(0, j0);
+        if ((h1 & mask64) == 0) emit(1, j1);
+        if ((h2 & mask64) == 0) emit(2, j2);
+        if ((h3 & mask64) == 0) emit(3, j3);
+        ++j0;
+        ++j1;
+        ++j2;
+        ++j3;
+      }
+      h[0] = h0; h[1] = h1; h[2] = h2; h[3] = h3;
+      j[0] = j0; j[1] = j1; j[2] = j2; j[3] = j3;
+      per_chain -= steps;
+    }
+    if (per_chain > 0) {
+      for (int c = 0; c < 4; ++c) {
+        int64_t lim = j[c] + per_chain;
+        if (lim > qhi[c]) lim = qhi[c];
+        uint64_t hh = h[c];
+        for (int64_t p = j[c]; p < lim; ++p) {
+          hh = (hh << 1) + tab[buf[p]];
+          if (((static_cast<uint32_t>(hh >> 32)) & mask) == 0) emit(c, p);
+        }
+        h[c] = hh;
+        j[c] = lim;
+      }
+    }
+    return j[0] < qhi[0] || j[1] < qhi[1] || j[2] < qhi[2] || j[3] < qhi[3];
+  }
+};
+
+}  // namespace
+
+#if (defined(__x86_64__) || defined(_M_X64)) && \
+    (defined(__GNUC__) || defined(__clang__))
+
+namespace {
+
+inline bool have_avx512() {
+  static const bool ok = __builtin_cpu_supports("avx512f");
+  return ok;
+}
+
+// Resumable 4-lane AVX2 BLAKE2b engine over (ptr, len) jobs: the lane
+// machinery of b2b_many_avx2 restructured so one block-step runs per
+// call (jobs addressed by pointer, not base+offset — the entry the
+// hash_many_list path uses, ADVICE r5: offsets stay offsets).
+struct B2b4State {
+  B2bLane lanes[4];
+  __m256i h[8];
+  alignas(32) uint64_t hbuf[8][4];
+  alignas(32) uint8_t pad[4][128];
+  const uint8_t* const* jptr = nullptr;
+  const int64_t* jlen = nullptr;
+  uint8_t* outbase = nullptr;
+  int64_t njobs = 0, next = 0;
+};
+
+__attribute__((target("avx2")))
+inline bool b2b4_reset_lane(B2b4State& st, int L) {
+  if (st.next >= st.njobs) {
+    st.lanes[L].active = false;
+    return false;
+  }
+  st.lanes[L] = {st.jptr[st.next], st.jlen[st.next], 0,
+                 st.outbase + st.next * 32, true};
+  ++st.next;
+  const uint64_t param = 0x01010000ULL ^ 32ULL;
+  for (int w = 0; w < 8; ++w)
+    st.hbuf[w][L] = B2B_IV[w] ^ (w == 0 ? param : 0ULL);
+  return true;
+}
+
+__attribute__((target("avx2")))
+void b2b4_init(B2b4State& st, const uint8_t* const* jptr, const int64_t* jlen,
+               uint8_t* outbase, int64_t njobs) {
+  st.jptr = jptr;
+  st.jlen = jlen;
+  st.outbase = outbase;
+  st.njobs = njobs;
+  st.next = 0;
+  std::memset(st.hbuf, 0, sizeof(st.hbuf));
+  for (int L = 0; L < 4; ++L) b2b4_reset_lane(st, L);
+  for (int w = 0; w < 8; ++w)
+    st.h[w] = _mm256_load_si256(reinterpret_cast<const __m256i*>(st.hbuf[w]));
+}
+
+// One 4-lane block compression (with lane refill); false when all lanes
+// are idle.  Identical block staging + spill/extract discipline to
+// b2b_many_avx2.
+__attribute__((target("avx2")))
+bool b2b4_step(B2b4State& st) {
+  if (!(st.lanes[0].active || st.lanes[1].active || st.lanes[2].active ||
+        st.lanes[3].active))
+    return false;
+  const uint8_t* blk[4];
+  alignas(32) uint64_t tv[4];
+  alignas(32) uint64_t fv[4];
+  bool finishing[4];
+  bool anyfin = false;
+  for (int L = 0; L < 4; ++L) {
+    B2bLane& ln = st.lanes[L];
+    if (!ln.active) {
+      std::memset(st.pad[L], 0, 128);
+      blk[L] = st.pad[L];
+      tv[L] = 0;
+      fv[L] = 0;
+      finishing[L] = false;
+      continue;
+    }
+    int64_t rem = ln.len - ln.off;
+    if (rem > 128) {
+      blk[L] = ln.data + ln.off;
+      ln.off += 128;
+      tv[L] = static_cast<uint64_t>(ln.off);
+      fv[L] = 0;
+      finishing[L] = false;
+    } else {
+      std::memset(st.pad[L], 0, 128);
+      if (rem > 0) std::memcpy(st.pad[L], ln.data + ln.off, rem);
+      blk[L] = st.pad[L];
+      tv[L] = static_cast<uint64_t>(ln.len);
+      fv[L] = ~0ULL;
+      finishing[L] = true;
+      anyfin = true;
+    }
+  }
+  __m256i m[16];
+  for (int w = 0; w < 16; ++w)
+    m[w] = _mm256_set_epi64x(
+        static_cast<long long>(load64(blk[3] + 8 * w)),
+        static_cast<long long>(load64(blk[2] + 8 * w)),
+        static_cast<long long>(load64(blk[1] + 8 * w)),
+        static_cast<long long>(load64(blk[0] + 8 * w)));
+  b2b_compress4(st.h, m,
+                _mm256_load_si256(reinterpret_cast<const __m256i*>(tv)),
+                _mm256_load_si256(reinterpret_cast<const __m256i*>(fv)));
+  if (anyfin) {
+    for (int w = 0; w < 8; ++w)
+      _mm256_store_si256(reinterpret_cast<__m256i*>(st.hbuf[w]), st.h[w]);
+    for (int L = 0; L < 4; ++L) {
+      if (!finishing[L]) continue;
+      for (int w = 0; w < 4; ++w)
+        std::memcpy(st.lanes[L].out + 8 * w, &st.hbuf[w][L], 8);
+      b2b4_reset_lane(st, L);
+    }
+    for (int w = 0; w < 8; ++w)
+      st.h[w] =
+          _mm256_load_si256(reinterpret_cast<const __m256i*>(st.hbuf[w]));
+  }
+  return true;
+}
+
+// 8-lane AVX-512F engine: same lane-refill structure at twice the width,
+// with native 64-bit rotates (vprorq) replacing the AVX2 shuffle/shift
+// emulation.  The fused variant interleaves a few gear-scan bytes after
+// every round, so the scalar chain and the vector rounds share the core.
+struct B2b8State {
+  B2bLane lanes[8];
+  __m512i h[8];
+  alignas(64) uint64_t hbuf[8][8];
+  alignas(64) uint8_t pad[8][128];
+  const uint8_t* const* jptr = nullptr;
+  const int64_t* jlen = nullptr;
+  uint8_t* outbase = nullptr;
+  int64_t njobs = 0, next = 0;
+};
+
+__attribute__((target("avx512f")))
+inline bool b2b8_reset_lane(B2b8State& st, int L) {
+  if (st.next >= st.njobs) {
+    st.lanes[L].active = false;
+    return false;
+  }
+  st.lanes[L] = {st.jptr[st.next], st.jlen[st.next], 0,
+                 st.outbase + st.next * 32, true};
+  ++st.next;
+  const uint64_t param = 0x01010000ULL ^ 32ULL;
+  for (int w = 0; w < 8; ++w)
+    st.hbuf[w][L] = B2B_IV[w] ^ (w == 0 ? param : 0ULL);
+  return true;
+}
+
+__attribute__((target("avx512f")))
+void b2b8_init(B2b8State& st, const uint8_t* const* jptr, const int64_t* jlen,
+               uint8_t* outbase, int64_t njobs) {
+  st.jptr = jptr;
+  st.jlen = jlen;
+  st.outbase = outbase;
+  st.njobs = njobs;
+  st.next = 0;
+  std::memset(st.hbuf, 0, sizeof(st.hbuf));
+  for (int L = 0; L < 8; ++L) b2b8_reset_lane(st, L);
+  for (int w = 0; w < 8; ++w)
+    st.h[w] = _mm512_load_si512(reinterpret_cast<const void*>(st.hbuf[w]));
+}
+
+// 8x8 uint64 transpose: rows r0..r7 (lane L's 64 message bytes) ->
+// out[0..7] (message word w across all 8 lanes).  24 shuffle uops
+// replace the 64 scalar loads + 56 insert uops of a set_epi64 build —
+// message staging was ~60% of the 8-lane engine's cycles without it.
+#define DAT_T8(out, r0, r1, r2, r3, r4, r5, r6, r7)                   \
+  {                                                                   \
+    __m512i t0 = _mm512_unpacklo_epi64(r0, r1);                       \
+    __m512i t1 = _mm512_unpackhi_epi64(r0, r1);                       \
+    __m512i t2 = _mm512_unpacklo_epi64(r2, r3);                       \
+    __m512i t3 = _mm512_unpackhi_epi64(r2, r3);                       \
+    __m512i t4 = _mm512_unpacklo_epi64(r4, r5);                       \
+    __m512i t5 = _mm512_unpackhi_epi64(r4, r5);                       \
+    __m512i t6 = _mm512_unpacklo_epi64(r6, r7);                       \
+    __m512i t7 = _mm512_unpackhi_epi64(r6, r7);                       \
+    __m512i u0 = _mm512_shuffle_i64x2(t0, t2, 0x88);                  \
+    __m512i u1 = _mm512_shuffle_i64x2(t4, t6, 0x88);                  \
+    __m512i u2 = _mm512_shuffle_i64x2(t0, t2, 0xDD);                  \
+    __m512i u3 = _mm512_shuffle_i64x2(t4, t6, 0xDD);                  \
+    __m512i u4 = _mm512_shuffle_i64x2(t1, t3, 0x88);                  \
+    __m512i u5 = _mm512_shuffle_i64x2(t5, t7, 0x88);                  \
+    __m512i u6 = _mm512_shuffle_i64x2(t1, t3, 0xDD);                  \
+    __m512i u7 = _mm512_shuffle_i64x2(t5, t7, 0xDD);                  \
+    out[0] = _mm512_shuffle_i64x2(u0, u1, 0x88);                      \
+    out[4] = _mm512_shuffle_i64x2(u0, u1, 0xDD);                      \
+    out[2] = _mm512_shuffle_i64x2(u2, u3, 0x88);                      \
+    out[6] = _mm512_shuffle_i64x2(u2, u3, 0xDD);                      \
+    out[1] = _mm512_shuffle_i64x2(u4, u5, 0x88);                      \
+    out[5] = _mm512_shuffle_i64x2(u4, u5, 0xDD);                      \
+    out[3] = _mm512_shuffle_i64x2(u6, u7, 0x88);                      \
+    out[7] = _mm512_shuffle_i64x2(u6, u7, 0xDD);                      \
+  }
+
+// One 8-lane block compression (with lane refill); false when all
+// lanes are idle.
+__attribute__((target("avx512f")))
+bool b2b8_step(B2b8State& st) {
+  bool any = false;
+  for (int L = 0; L < 8; ++L) any = any || st.lanes[L].active;
+  if (!any) return false;
+  const uint8_t* blk[8];
+  alignas(64) uint64_t tv[8];
+  alignas(64) uint64_t fv[8];
+  bool finishing[8];
+  bool anyfin = false;
+  for (int L = 0; L < 8; ++L) {
+    B2bLane& ln = st.lanes[L];
+    if (!ln.active) {
+      std::memset(st.pad[L], 0, 128);
+      blk[L] = st.pad[L];
+      tv[L] = 0;
+      fv[L] = 0;
+      finishing[L] = false;
+      continue;
+    }
+    int64_t rem = ln.len - ln.off;
+    if (rem > 128) {
+      blk[L] = ln.data + ln.off;
+      ln.off += 128;
+      tv[L] = static_cast<uint64_t>(ln.off);
+      fv[L] = 0;
+      finishing[L] = false;
+    } else {
+      std::memset(st.pad[L], 0, 128);
+      if (rem > 0) std::memcpy(st.pad[L], ln.data + ln.off, rem);
+      blk[L] = st.pad[L];
+      tv[L] = static_cast<uint64_t>(ln.len);
+      fv[L] = ~0ULL;
+      finishing[L] = true;
+      anyfin = true;
+    }
+  }
+  __m512i m[16];
+  {
+    __m512i r[8];
+    for (int L = 0; L < 8; ++L)
+      r[L] = _mm512_loadu_si512(reinterpret_cast<const void*>(blk[L]));
+    DAT_T8(m, r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7]);
+    for (int L = 0; L < 8; ++L)
+      r[L] = _mm512_loadu_si512(reinterpret_cast<const void*>(blk[L] + 64));
+    DAT_T8((m + 8), r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7]);
+  }
+  __m512i v[16];
+  for (int i = 0; i < 8; ++i) v[i] = st.h[i];
+  for (int i = 0; i < 8; ++i)
+    v[8 + i] = _mm512_set1_epi64(static_cast<long long>(B2B_IV[i]));
+  v[12] = _mm512_xor_si512(
+      v[12], _mm512_load_si512(reinterpret_cast<const void*>(tv)));
+  v[14] = _mm512_xor_si512(
+      v[14], _mm512_load_si512(reinterpret_cast<const void*>(fv)));
+#define DAT_G8(a, b, c, d, x, y)                                    \
+  v[a] = _mm512_add_epi64(_mm512_add_epi64(v[a], v[b]), (x));       \
+  v[d] = _mm512_ror_epi64(_mm512_xor_si512(v[d], v[a]), 32);        \
+  v[c] = _mm512_add_epi64(v[c], v[d]);                              \
+  v[b] = _mm512_ror_epi64(_mm512_xor_si512(v[b], v[c]), 24);        \
+  v[a] = _mm512_add_epi64(_mm512_add_epi64(v[a], v[b]), (y));       \
+  v[d] = _mm512_ror_epi64(_mm512_xor_si512(v[d], v[a]), 16);        \
+  v[c] = _mm512_add_epi64(v[c], v[d]);                              \
+  v[b] = _mm512_ror_epi64(_mm512_xor_si512(v[b], v[c]), 63);
+  for (int r = 0; r < 12; ++r) {
+    const uint8_t* s = B2B_SIGMA[r];
+    DAT_G8(0, 4, 8, 12, m[s[0]], m[s[1]])
+    DAT_G8(1, 5, 9, 13, m[s[2]], m[s[3]])
+    DAT_G8(2, 6, 10, 14, m[s[4]], m[s[5]])
+    DAT_G8(3, 7, 11, 15, m[s[6]], m[s[7]])
+    DAT_G8(0, 5, 10, 15, m[s[8]], m[s[9]])
+    DAT_G8(1, 6, 11, 12, m[s[10]], m[s[11]])
+    DAT_G8(2, 7, 8, 13, m[s[12]], m[s[13]])
+    DAT_G8(3, 4, 9, 14, m[s[14]], m[s[15]])
+  }
+#undef DAT_G8
+  for (int i = 0; i < 8; ++i)
+    st.h[i] = _mm512_xor_si512(st.h[i], _mm512_xor_si512(v[i], v[8 + i]));
+  if (anyfin) {
+    for (int w = 0; w < 8; ++w)
+      _mm512_store_si512(reinterpret_cast<void*>(st.hbuf[w]), st.h[w]);
+    for (int L = 0; L < 8; ++L) {
+      if (!finishing[L]) continue;
+      for (int w = 0; w < 4; ++w)
+        std::memcpy(st.lanes[L].out + 8 * w, &st.hbuf[w][L], 8);
+      b2b8_reset_lane(st, L);
+    }
+    for (int w = 0; w < 8; ++w)
+      st.h[w] = _mm512_load_si512(reinterpret_cast<const void*>(st.hbuf[w]));
+  }
+  return true;
+}
+
+}  // namespace
+
+#else
+namespace {
+inline bool have_avx512() { return false; }
+struct B2b4State {};
+struct B2b8State {};
+inline void b2b4_init(B2b4State&, const uint8_t* const*, const int64_t*,
+                      uint8_t*, int64_t) {}
+inline bool b2b4_step(B2b4State&) { return false; }
+inline void b2b8_init(B2b8State&, const uint8_t* const*, const int64_t*,
+                      uint8_t*, int64_t) {}
+inline bool b2b8_step(B2b8State&) { return false; }
+}  // namespace
+#endif
+
+namespace {
+
+// One fused worker range: gear-scan [rlo, rhi) of the current slab and
+// hash this thread's share of the chunks finalized in the previous slab
+// (their bytes are one slab behind the scan — still cache-resident).
+// Engine pick mirrors dat_blake2b_many_ptrs: AVX-512F 8-lane, AVX2
+// 4-lane, scalar loop otherwise.
+//
+// ``hash_first`` anti-phases the two works across workers: even threads
+// scan then hash, odd threads hash then scan, so at any instant half
+// the threads run the scalar-port-bound gear chain while the other half
+// run the vector-port-bound BLAKE2b rounds.  On SMT siblings the two
+// engines then share one physical core's DISJOINT ports — measured on
+// the 2-hyperthread dev box, this is where the fused pass's win over
+// phase-lockstep execution comes from.  (A per-round instruction-level
+// interleave inside one thread measured 35% slower: the 32 live zmm of
+// state + message spill as soon as the scalar scan joins the loop.)
+void fused_range(const uint8_t* buf, int64_t rlo, int64_t rhi,
+                 const uint64_t* tab, uint32_t mask, int64_t thin,
+                 int64_t* qdst, int64_t qcap, int64_t* qcnt,
+                 const uint8_t* const* jptr, const int64_t* jlen,
+                 uint8_t* outb, int64_t njobs, bool hash_first) {
+  GearQuad gq;
+  gq.init(buf, rlo, rhi, tab, mask, thin, qdst, qcap);
+  auto scan = [&] {
+    while (gq.advance(1 << 14)) {
+    }
+  };
+  auto hash = [&] {
+    if (njobs <= 0) return;
+    if (have_avx512()) {
+      B2b8State st;
+      b2b8_init(st, jptr, jlen, outb, njobs);
+      while (b2b8_step(st)) {
+      }
+    } else if (have_avx2()) {
+      B2b4State st;
+      b2b4_init(st, jptr, jlen, outb, njobs);
+      while (b2b4_step(st)) {
+      }
+    } else {
+      for (int64_t r = 0; r < njobs; ++r)
+        b2b_hash256(jptr[r], jlen[r], outb + r * 32);
+    }
+  };
+  if (hash_first) {
+    hash();
+    scan();
+  } else {
+    scan();
+    hash();
+  }
+  for (int c = 0; c < 4; ++c) qcnt[c] = gq.m[c];
+}
+
+}  // namespace
+
+extern "C" {
+
+// BLAKE2b-256 of n (pointer, length) jobs -> out[r*32..]: the pointer-
+// array twin of dat_blake2b_many for payloads that are NOT extents of
+// one buffer (hash_many_list's zero-copy span path).  Offsets stay
+// offsets; addresses ride a dedicated parameter.  nthreads <= 0 = auto.
+int64_t dat_blake2b_many_ptrs(const uint8_t* const* ptrs,
+                              const int64_t* lens, int64_t n, uint8_t* out,
+                              int64_t nthreads) {
+  parallel_for(n, nthreads, 64, [&](int64_t lo, int64_t hi, int64_t) {
+    int64_t cnt = hi - lo;
+    if (have_avx512()) {
+      B2b8State st;
+      b2b8_init(st, ptrs + lo, lens + lo, out + lo * 32, cnt);
+      while (b2b8_step(st)) {
+      }
+      return;
+    }
+    if (have_avx2()) {
+      B2b4State st;
+      b2b4_init(st, ptrs + lo, lens + lo, out + lo * 32, cnt);
+      while (b2b4_step(st)) {
+      }
+      return;
+    }
+    for (int64_t r = lo; r < hi; ++r)
+      b2b_hash256(ptrs[r], lens[r], out + r * 32);
+  });
+  return 0;
+}
+
+// Fused single-pass content addressing: gear CDC candidates, greedy
+// min/max cut selection, and per-chunk BLAKE2b-256 in ONE sweep over
+// buf.  Emits chunk end-offsets (exclusive, last == n) into cuts[] and
+// 32-byte digests into digests[] (digest r covers [cuts[r-1], cuts[r])).
+// thin_bits must be in [5, 31] (the chunking thinning policy; callers
+// with smaller min sizes take the two-pass route).  Returns the chunk
+// count, DAT_ERR_CAPACITY if cap is too small, or DAT_ERR_BAD_RECORD
+// for out-of-range parameters.  Byte-identical cuts and digests to
+// dat_gear_candidates + dat_greedy_select + dat_blake2b_many.
+int64_t dat_cdc_hash(const uint8_t* buf, int64_t n, int64_t avg_bits,
+                     int64_t thin_bits, int64_t min_size, int64_t max_size,
+                     int64_t* cuts, uint8_t* digests, int64_t cap,
+                     int64_t nthreads) {
+  if (avg_bits < 1 || avg_bits > 31 || thin_bits < 5 || thin_bits > 31 ||
+      min_size < 1 || max_size < min_size || cap < 1)
+    return DAT_ERR_BAD_RECORD;
+  if (n <= 0) return 0;
+  // wire: GEAR_C1 = 0x9E3779B1
+  // wire: GEAR_C2 = 0x85EBCA77
+  const uint32_t c1 = 0x9E3779B1u, c2 = 0x85EBCA77u;
+  uint64_t tab[256];
+  for (uint32_t b = 0; b < 256; ++b) {
+    uint64_t lo = static_cast<uint32_t>((b + 1) * c1);
+    uint64_t hi = static_cast<uint32_t>((b + 1) * c2);
+    tab[b] = lo | (hi << 32);
+  }
+  const uint32_t mask = (1u << avg_bits) - 1u;
+  // slab size: big enough to amortize the per-slab thread fan-out and
+  // keep the anti-phase windows long, small enough that a slab plus the
+  // trailing chunks being hashed stay cache-resident (the single-DRAM-
+  // pass property).  Measured on the dev box (512 MiB stream, max of 5
+  // reps): 8 MiB 1.02 GiB/s, 16 MiB 1.27, 32 MiB 1.31 — the fan-out
+  // cost dominates below 16 MiB, cache effects are flat to 32 MiB.
+  const int64_t SLAB = 32 << 20;
+  std::vector<int64_t> cand;
+  cand.reserve((SLAB >> thin_bits) + 64);
+  size_t ci = 0;      // greedy's cursor into cand
+  int64_t start = 0;  // last emitted cut
+  int64_t m = 0;      // cuts emitted
+  int64_t hm = 0;     // cuts already hashed
+  int64_t last_win = -1;
+  std::vector<const uint8_t*> jptr;
+  std::vector<int64_t> jlen;
+  std::vector<int64_t> qslab;
+  std::vector<int64_t> qcnt;
+
+  for (int64_t slo = 0; slo < n; slo += SLAB) {
+    int64_t shi = slo + SLAB < n ? slo + SLAB : n;
+    // cuts decidable from the scanned prefix [0, slo): every candidate
+    // through start + max_size is known once the scan passed it
+    while (slo - start > max_size) {
+      int64_t lo2 = start + min_size;
+      int64_t hi2 = start + max_size;
+      while (ci < cand.size() && cand[ci] < lo2) ++ci;
+      int64_t cut = (ci < cand.size() && cand[ci] <= hi2) ? cand[ci++] : hi2;
+      if (m >= cap) return DAT_ERR_CAPACITY;
+      cuts[m++] = cut;
+      start = cut;
+    }
+    if (ci > 4096) {  // bound the candidate queue: drop consumed head
+      cand.erase(cand.begin(), cand.begin() + static_cast<int64_t>(ci));
+      ci = 0;
+    }
+    // this slab's hash jobs: the chunks finalized above (bytes one slab
+    // behind the scan frontier — cache-resident by construction)
+    jptr.clear();
+    jlen.clear();
+    for (int64_t c = hm; c < m; ++c) {
+      int64_t cs = c == 0 ? 0 : cuts[c - 1];
+      jptr.push_back(buf + cs);
+      jlen.push_back(cuts[c] - cs);
+    }
+    int64_t jo = hm;
+    hm = m;
+    int64_t njobs = static_cast<int64_t>(jptr.size());
+    int64_t span = shi - slo;
+    int nt = pick_threads(nthreads, span, 1 << 20);
+    // Anti-phase schedule: odd threads hash (all of it, split by bytes)
+    // then scan a SMALLER range; even threads only scan.  The skew makes
+    // both roles finish together, so the scalar-port gear chain and the
+    // vector-port BLAKE2b rounds overlap for the whole slab instead of
+    // colliding once the (faster) hash phase drains.  RS/RH is the
+    // measured scan:hash single-thread rate ratio; a mis-estimate only
+    // shifts work between roles, never correctness.
+    const double RS_OVER_RH = 0.55;
+    int nh = njobs > 0 ? nt / 2 : 0;  // hash-first thread count
+    if (njobs > 0 && nh == 0) nh = 1;
+    int ns = nt - nh;
+    int64_t hbytes = 0;
+    for (int64_t r = 0; r < njobs; ++r) hbytes += jlen[r];
+    // per-thread scan quotas: even threads x, odd threads y with
+    // x = y + (RS/RH) * hbytes/nh and ns*x + nh*y = span
+    int64_t y = nt > 0 && nh > 0
+        ? static_cast<int64_t>(
+              (span - ns * RS_OVER_RH * (static_cast<double>(hbytes) / nh)) /
+              nt)
+        : span / (nt > 0 ? nt : 1);
+    if (y < 0) y = 0;
+    std::vector<int64_t> slo_k(static_cast<size_t>(nt) + 1, 0);
+    {
+      int64_t acc = 0;
+      int64_t x = ns > 0 ? (span - nh * y) / ns : 0;
+      for (int k = 0; k < nt; ++k) {
+        slo_k[k] = acc;
+        acc += (nh > 0 && (k & 1) == 1) ? y : x;
+        if (acc > span) acc = span;
+      }
+      slo_k[nt] = span;
+      // rounding slack lands on the last thread's range
+    }
+    // hash jobs: byte-balanced contiguous shares across the odd threads
+    std::vector<int64_t> jsplit(static_cast<size_t>(nt) + 1, njobs);
+    jsplit[0] = 0;
+    if (njobs > 0) {
+      int64_t acc = 0;
+      int64_t r = 0;
+      int hk = 0;
+      for (int k = 1; k <= nt; ++k) {
+        if (nh > 0 && ((k - 1) & 1) == 1) {
+          ++hk;
+          int64_t want = hbytes * hk / nh;
+          while (r < njobs && acc < want) acc += jlen[r++];
+          jsplit[k] = hk == nh ? njobs : r;
+        } else {
+          jsplit[k] = jsplit[k - 1];  // scan-only threads take no jobs
+        }
+      }
+      if (nt == 1) jsplit[1] = njobs;
+    }
+    int64_t qcap = (span / 4 >> thin_bits) + 8;  // any thread may scan
+    // up to (nearly) the whole span under the skewed split
+    qslab.assign(static_cast<size_t>(nt) * 4 * qcap, 0);
+    qcnt.assign(static_cast<size_t>(nt) * 4, 0);
+    parallel_for(nt, nt, 1, [&](int64_t k0, int64_t, int64_t) {
+      int k = static_cast<int>(k0);
+      fused_range(buf, slo + slo_k[k], slo + slo_k[k + 1], tab, mask,
+                  thin_bits, qslab.data() + k * 4 * qcap, qcap,
+                  qcnt.data() + k * 4, jptr.data() + jsplit[k],
+                  jlen.data() + jsplit[k], digests + (jo + jsplit[k]) * 32,
+                  jsplit[k + 1] - jsplit[k], (k & 1) == 1);
+    });
+    // ordered merge of this slab's candidates (global window dedup at
+    // every seam, exactly like dat_gear_candidates' merge)
+    for (int64_t q = 0; q < nt * 4; ++q) {
+      if (qcnt[q] < 0) return DAT_ERR_CAPACITY;  // can't trip with thinning
+      for (int64_t i = 0; i < qcnt[q]; ++i) {
+        int64_t p = qslab[q * qcap + i];
+        int64_t win = p >> thin_bits;
+        if (win == last_win) continue;
+        last_win = win;
+        cand.push_back(p);
+      }
+    }
+  }
+  // drain: the exact dat_greedy_select tail over the remaining stream
+  while (n - start > max_size) {
+    int64_t lo2 = start + min_size;
+    int64_t hi2 = start + max_size;
+    while (ci < cand.size() && cand[ci] < lo2) ++ci;
+    int64_t cut = (ci < cand.size() && cand[ci] <= hi2) ? cand[ci++] : hi2;
+    if (m >= cap) return DAT_ERR_CAPACITY;
+    cuts[m++] = cut;
+    start = cut;
+  }
+  if (m >= cap) return DAT_ERR_CAPACITY;
+  cuts[m++] = n;
+  // hash the tail chunks (no scan left to interleave with)
+  jptr.clear();
+  jlen.clear();
+  for (int64_t c = hm; c < m; ++c) {
+    int64_t cs = c == 0 ? 0 : cuts[c - 1];
+    jptr.push_back(buf + cs);
+    jlen.push_back(cuts[c] - cs);
+  }
+  if (!jptr.empty())
+    dat_blake2b_many_ptrs(jptr.data(), jlen.data(),
+                          static_cast<int64_t>(jptr.size()),
+                          digests + hm * 32, nthreads);
   return m;
 }
 

@@ -303,8 +303,7 @@ def initial_state(batch: int, digest_size: int = DIGEST_SIZE):
     return hh, hl
 
 
-@functools.partial(jax.jit, static_argnames=("digest_size",))
-def blake2b_packed(mh, ml, lengths, digest_size: int = DIGEST_SIZE):
+def _blake2b_packed_impl(mh, ml, lengths, digest_size: int = DIGEST_SIZE):
     """Hash a padded batch: mh/ml (B, nblocks, 16) uint32, lengths (B,).
 
     Padding bytes in the final partial block MUST be zero (the host packer
@@ -344,9 +343,40 @@ def blake2b_packed(mh, ml, lengths, digest_size: int = DIGEST_SIZE):
     return jnp.stack(carry[:8], axis=1), jnp.stack(carry[8:], axis=1)
 
 
+blake2b_packed = functools.partial(jax.jit, static_argnames=("digest_size",))(
+    _blake2b_packed_impl
+)
+# donated twin: the staged mh/ml message buffers are throwaway (packed
+# on the host, consumed by exactly one dispatch), so donating them lets
+# the allocator hand their HBM straight to the NEXT batch's staging —
+# the "two donated input buffers" of the double-buffered upload path
+# (ISSUE 7): dispatch N+1's h2d streams into memory dispatch N just
+# released instead of growing the live set.  CPU jax ignores donation
+# (and warns), so callers route here only when the backend honors it.
+blake2b_packed_donated = functools.partial(
+    jax.jit, static_argnames=("digest_size",), donate_argnums=(0, 1)
+)(_blake2b_packed_impl)
+
 # recompile sentinel (obs.device): jit specializes per (B, nblocks) —
 # this is THE site the power-of-two bucketing below exists to protect
 blake2b_packed = _jit_site("ops.blake2b.packed", blake2b_packed)
+blake2b_packed_donated = _jit_site("ops.blake2b.packed_donated",
+                                   blake2b_packed_donated)
+
+
+def donation_supported() -> bool:
+    """Whether this backend honors buffer donation: the ONE owner of the
+    donated-vs-plain dispatch decision (CPU jax silently ignores
+    donation and logs a warning per call).  ``DAT_DONATE=1/0``
+    overrides, for tests and experiments."""
+    import os
+
+    force = os.environ.get("DAT_DONATE")
+    if force == "1":
+        return True
+    if force == "0":
+        return False
+    return jax.default_backend() in ("tpu", "gpu")
 
 
 @jax.jit
@@ -562,6 +592,7 @@ def blake2b_batch_begin(
     otherwise.
     """
     on_tpu = jax.default_backend() == "tpu"
+    donate = donation_supported()
     buckets: dict[int, list[int]] = {}
     for i, p in enumerate(payloads):
         nb = _bucket_nblocks(max(1, -(-len(p) // BLOCK_BYTES)))
@@ -574,9 +605,14 @@ def blake2b_batch_begin(
             else on_tpu and len(idxs) >= _PALLAS_MIN_ITEMS
         )
         if pallas_bucket:
-            from .blake2b_pallas import blake2b_packed_pallas as packed_fn
+            if donate:
+                from .blake2b_pallas import (
+                    blake2b_packed_pallas_donated as packed_fn,
+                )
+            else:
+                from .blake2b_pallas import blake2b_packed_pallas as packed_fn
         else:
-            packed_fn = blake2b_packed
+            packed_fn = blake2b_packed_donated if donate else blake2b_packed
         if _OBS.on:
             # keyed per bucket: the engine choice is per block-count
             # bucket, and the change-only memo must not flap when a
@@ -594,10 +630,29 @@ def blake2b_batch_begin(
         mh, ml, lengths = pack_payloads(batch, nblocks=nb)
         if _OBS.on:
             _M_H2D.inc(mh.nbytes + ml.nbytes + lengths.nbytes)
+        # stage explicitly (device_put returns immediately): the upload
+        # streams while earlier batches compress, and — when donation is
+        # supported — the staged buffers are DONATED to the dispatch, so
+        # successive batches double-buffer through recycled staging HBM
+        # instead of growing the live set
+        mh_d = jax.device_put(mh)
+        ml_d = jax.device_put(ml)
         hh, hl = packed_fn(
-            jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(lengths), digest_size
+            mh_d, ml_d, jnp.asarray(lengths), digest_size
         )
         handles.append((idxs, hh[: len(idxs)], hl[: len(idxs)]))
+
+    def start_d2h() -> None:
+        # begin the digest readback WITHOUT blocking: by collect() time
+        # the words are local (or in flight under newer batches'
+        # compute).  Idempotent; the DigestPipeline calls this when a
+        # NEWER batch is dispatched so deliver never serializes a cold
+        # D2H behind the next submit (ISSUE 7 part 3).
+        for _, hh, hl in handles:
+            for arr in (hh, hl):
+                copy_async = getattr(arr, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
 
     def collect() -> list[bytes]:
         out: list[bytes | None] = [None] * len(payloads)
@@ -609,6 +664,7 @@ def blake2b_batch_begin(
                 out[i] = d
         return out  # type: ignore[return-value]
 
+    collect.start_d2h = start_d2h  # type: ignore[attr-defined]
     return collect
 
 

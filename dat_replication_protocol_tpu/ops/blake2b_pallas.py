@@ -37,6 +37,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.jax_compat import COMPILER_PARAMS as _COMPILER_PARAMS
+
 from .blake2b import _IV_HI, _IV_LO, DIGEST_SIZE, compress_soa
 from ..obs.device import jit_site as _jit_site
 from .u64 import U32
@@ -312,7 +314,7 @@ def blake2b_native(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
             if vmem_state
             else []
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -363,3 +365,17 @@ def blake2b_packed_pallas(mh, ml, lengths, digest_size: int = DIGEST_SIZE,
         mh_n, ml_n, len_n, digest_size, block_items, interpret
     )
     return from_native(outh, outl, B)
+
+
+# donated twin (see blake2b.blake2b_packed_donated): one jit over the
+# whole layout-transpose + kernel chain so the staged (B, nblocks, 16)
+# message buffers are donated into the program and their HBM recycles
+# into the next batch's staging — the double-buffered upload discipline
+blake2b_packed_pallas_donated = functools.partial(
+    jax.jit,
+    static_argnames=("digest_size", "block_items", "interpret"),
+    donate_argnums=(0, 1),
+)(blake2b_packed_pallas)
+blake2b_packed_pallas_donated = _jit_site(
+    "ops.blake2b_pallas.packed_donated", blake2b_packed_pallas_donated
+)

@@ -273,15 +273,62 @@ def digests_to_device(digests: list[bytes]):
     return jnp.asarray(raw[:, 1::2].copy()), jnp.asarray(raw[:, 0::2].copy())
 
 
-def digests_from_device(hh, hl) -> list[bytes]:
-    """(N, 4) hi/lo word pairs -> list of 32-byte digests."""
+def digest_matrix(hh, hl) -> np.ndarray:
+    """(N, 4) hi/lo word pairs -> (N, 32) uint8 digest bytes — the ONE
+    owner of the little-endian lo/hi word interleave (word k's low half
+    at byte 8k, high half at 8k+4)."""
     hh = np.asarray(hh, dtype=np.uint32)
     hl = np.asarray(hl, dtype=np.uint32)
     out = np.empty((hh.shape[0], 8), dtype="<u4")
     out[:, 0::2] = hl
     out[:, 1::2] = hh
-    raw = out.view(np.uint8).reshape(hh.shape[0], 32)
-    return [raw[i].tobytes() for i in range(hh.shape[0])]
+    return out.view(np.uint8).reshape(hh.shape[0], 32)
+
+
+def digests_from_device(hh, hl) -> list[bytes]:
+    """(N, 4) hi/lo word pairs -> list of 32-byte digests."""
+    raw = digest_matrix(hh, hl)
+    return [raw[i].tobytes() for i in range(raw.shape[0])]
+
+
+def root_host(digests: np.ndarray) -> bytes:
+    """Merkle root of (N, 32) uint8 leaf digests on the HOST engine.
+
+    Byte-identical to ``digests_from_device(*root(*pad_leaves(...)))``
+    (same zero-digest padding, same pair convention — tested), but the
+    level fold runs through the native thread-parallel BLAKE2b engine
+    instead of an XLA program: on a CPU-backed jax the device fold's
+    scanned-rounds compression measured ~0.01 GiB/s end-to-end, turning
+    the single-pass :func:`..runtime.content.content_address` host route
+    back into a two-order-of-magnitude cliff.  "Batch or stay home"
+    applies to the tree fold too.
+    """
+    from ..runtime import native
+
+    n = len(digests)
+    if n == 0:
+        return b"\0" * DIGEST_SIZE
+    p = 1
+    while p < n:
+        p <<= 1
+    level = np.zeros((p, DIGEST_SIZE), dtype=np.uint8)
+    level[:n] = digests
+    while len(level) > 1:
+        pairs = np.ascontiguousarray(level).reshape(-1)
+        half = len(level) // 2
+        offs = np.arange(half, dtype=np.int64) * (2 * DIGEST_SIZE)
+        lens = np.full(half, 2 * DIGEST_SIZE, dtype=np.int64)
+        out = native.hash_many(pairs, offs, lens)
+        if out is None:  # no native library: hashlib loop
+            out = np.empty((half, DIGEST_SIZE), dtype=np.uint8)
+            for i in range(half):
+                out[i] = np.frombuffer(
+                    host_parent(level[2 * i].tobytes(),
+                                level[2 * i + 1].tobytes()),
+                    dtype=np.uint8,
+                )
+        level = out
+    return level[0].tobytes()
 
 
 def pad_leaves(hh, hl):

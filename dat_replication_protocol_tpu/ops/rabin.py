@@ -57,10 +57,20 @@ from .u64 import U32
 from ..obs.device import jit_site as _jit_site
 from ..obs.device import note_engine as _note_engine
 from ..obs.metrics import OBS as _OBS
+from ..obs.metrics import counter as _counter
+
+# fused1p extractions refused by the on-chip cross-check (each one
+# recomputes on the bitmask route; OBSERVABILITY.md single-pass catalog)
+_M_FUSED_REFUSED = _counter("cdc.fused.crosscheck.refused")
 
 WINDOW = 64  # bytes: contributions shift out of the 64-bit state after this
-_C1 = np.uint32(0x9E3779B1)  # golden-ratio odd constants
-_C2 = np.uint32(0x85EBCA77)
+# golden-ratio odd constants — datlint's wire-constant-parity rule
+# cross-checks these against both native scan loops (a fork silently
+# forks the cut sequence between routes)
+_GEAR_C1 = 0x9E3779B1
+_GEAR_C2 = 0x85EBCA77
+_C1 = np.uint32(_GEAR_C1)
+_C2 = np.uint32(_GEAR_C2)
 
 PACK = 32  # bit positions per packed uint32 output word
 GROUP = 256  # bytes per outer scan step: large enough that per-step scan
@@ -273,9 +283,17 @@ def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
     count (and the cap2-overflow check) from popcounting ``occ``.
     """
     rows = _build_rows(words_padded, pre_row, T, stride)
-    if route == "fused" and not use_pallas:
-        route = "bitmask"  # the fused kernel has no XLA formulation
-    if route == "fused":
+    if route in ("fused", "fused1p") and not use_pallas:
+        route = "bitmask"  # the fused kernels have no XLA formulation
+    viol = None
+    if route == "fused1p":
+        # single-pass route: the window-first kernel with the on-chip
+        # occupancy cross-check; ``viol`` rides out so candidates_begin
+        # can REFUSE divergent cuts and recompute on the bitmask route
+        from .fused_cdc_hash_pallas import gear_window_first_checked
+
+        first, viol = gear_window_first_checked(rows, avg_bits, thin_bits)
+    elif route == "fused":
         from .rabin_pallas import gear_window_first_pallas
 
         first = gear_window_first_pallas(rows, avg_bits, thin_bits)
@@ -315,6 +333,8 @@ def _extract_first_occ(words_padded, pre_row, T: int, stride: int,
     )
     (widx,) = jnp.nonzero(has, size=cap2, fill_value=0)
     offs = first[widx].astype(jnp.uint16)
+    if viol is not None:  # fused1p: the cross-check flag rides along
+        return occ, offs, viol
     return occ, offs
 
 
@@ -430,21 +450,24 @@ def pallas_active() -> bool:
 
 def effective_route(use_pallas: bool | None = None) -> str:
     """The ONE owner of extraction-route resolution: consult
-    ``DAT_CDC_ROUTE`` (values ``bitmask``/``first``/``fused``), fall back
-    to the legacy ``DAT_CDC_FIRST_KERNEL`` knob, and alias ``fused`` to
-    ``bitmask`` off-Pallas (the fused kernel has no XLA formulation).
-    Both the dispatch path and the bench artifact label use this, so the
-    recorded route is always the route that actually ran.
-    ``use_pallas=None`` consults :func:`pallas_active`."""
+    ``DAT_CDC_ROUTE`` (values ``bitmask``/``first``/``fused``/
+    ``fused1p``), fall back to the legacy ``DAT_CDC_FIRST_KERNEL`` knob,
+    and alias ``fused``/``fused1p`` to ``bitmask`` off-Pallas (neither
+    fused kernel has an XLA formulation; fused1p's HOST engine is routed
+    separately by :func:`..runtime.content.content_digests`, which
+    consults the raw env value).  Both the dispatch path and the bench
+    artifact label use this, so the recorded route is always the route
+    that actually ran.  ``use_pallas=None`` consults
+    :func:`pallas_active`."""
     import os
 
     route = os.environ.get("DAT_CDC_ROUTE")
-    if route not in ("bitmask", "first", "fused"):
+    if route not in ("bitmask", "first", "fused", "fused1p"):
         route = ("first" if os.environ.get("DAT_CDC_FIRST_KERNEL") == "1"
                  else "bitmask")
     if use_pallas is None:
         use_pallas = pallas_active()
-    if route == "fused" and not use_pallas:
+    if route in ("fused", "fused1p") and not use_pallas:
         route = "bitmask"
     return route
 
@@ -535,21 +558,40 @@ def candidates_begin(words, nbytes: int, avg_bits: int = 13,
             )
             _start_d2h(first)
 
+        def checked(ext, rt, cap):
+            """Refuse a fused1p extraction whose on-chip cross-check
+            tripped (the two independent in-kernel reductions disagree)
+            and recompute AT THE SAME CAP on the bitmask route — EVERY
+            extraction consults this, the cap-growth retries included
+            (each retry is a different compiled program instance, so a
+            clean first pass proves nothing about them)."""
+            if len(ext) == 3 and int(ext[2]) != 0:
+                if _OBS.on:
+                    _M_FUSED_REFUSED.inc()
+                rt = "bitmask"
+                ext = _extract_first_occ(
+                    words, pre, T, stride, avg_bits, cap, use_pallas,
+                    thin_bits, route=rt,
+                )
+            return ext, rt
+
         def collect() -> np.ndarray:
             with span("cdc.collect"):
                 from .merkle import unpack_mask
 
-                occ, offs = first
+                ext, rt = checked(first, route, cap0)
+                occ, offs = ext[0], ext[1]
                 winidx = np.nonzero(
                     unpack_mask(occ, T * stride >> thin_bits)
                 )[0]
                 cap = cap0
                 while len(winidx) > cap:
                     cap *= 4
-                    _, offs = _extract_first_occ(
+                    ext, rt = checked(_extract_first_occ(
                         words, pre, T, stride, avg_bits, cap, use_pallas,
-                        thin_bits, route=route,
-                    )
+                        thin_bits, route=rt,
+                    ), rt, cap)
+                    offs = ext[1]
                 offs_np = np.asarray(offs)
                 out = (winidx << thin_bits) + offs_np[: len(winidx)].astype(
                     np.int64

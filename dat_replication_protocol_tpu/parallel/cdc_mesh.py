@@ -30,7 +30,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..obs.device import jit_site as _jit_site

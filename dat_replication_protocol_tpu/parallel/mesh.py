@@ -33,7 +33,7 @@ from ..ops import merkle
 from ..ops.blake2b import blake2b_packed
 from ..ops.u64 import U32
 
-from jax import shard_map
+from ..utils.jax_compat import shard_map
 
 DATA_AXIS = "data"
 

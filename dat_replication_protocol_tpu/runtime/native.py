@@ -130,6 +130,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dat_blake2b_many.argtypes = [
         _U8P, _I64P, _I64P, ctypes.c_int64, _U8P, ctypes.c_int64,
     ]
+    # pointer-array twin: payload ADDRESSES ride a dedicated parameter
+    # (an int64 address array on the Python side) instead of being
+    # smuggled through the offset column (ADVICE r5 low)
+    lib.dat_blake2b_many_ptrs.restype = ctypes.c_int64
+    lib.dat_blake2b_many_ptrs.argtypes = [
+        _I64P, _I64P, ctypes.c_int64, _U8P, ctypes.c_int64,
+    ]
+    lib.dat_cdc_hash.restype = ctypes.c_int64
+    lib.dat_cdc_hash.argtypes = [
+        _U8P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, _I64P, _U8P, ctypes.c_int64,
+        ctypes.c_int64,
+    ]
     lib.dat_sketch.restype = ctypes.c_int64
     lib.dat_sketch.argtypes = [
         _U8P, _I64P, _I64P, _I64P, _I64P,
@@ -243,11 +256,13 @@ def hash_many_list(payloads: list) -> np.ndarray | None:
     ``None`` when unavailable (callers join + :func:`hash_many`).
 
     Zero-copy: the C engine reads each payload in place via
-    (address, length) spans filled by the dat_fastpath extension —
-    the ``b"".join`` it replaces was ~25% of the routed host-hash path
-    at digest-pipeline batch shapes.  The spans are passed to the
-    ctypes engine as offsets relative to a dummy base array, so the
-    existing ``dat_blake2b_many`` signature serves both layouts.
+    (address, length) spans filled by the dat_fastpath extension,
+    passed through ``dat_blake2b_many_ptrs``'s dedicated pointer-array
+    parameter (ADVICE r5: the earlier detour through the offset column
+    relative to a 1-byte dummy base was out-of-object pointer
+    arithmetic — UB, and brittle against any future bounds check in the
+    engine).  The ``b"".join`` this path replaces was ~25% of the
+    routed host-hash path at digest-pipeline batch shapes.
     """
     lib = get_lib()
     if lib is None or not payloads:
@@ -262,12 +277,10 @@ def hash_many_list(payloads: list) -> np.ndarray | None:
     lens = np.empty(n, dtype=np.int64)
     if not fp.bytes_spans(payloads, addrs, lens):
         return None  # non-bytes entries: caller falls back to the join
-    base = np.zeros(1, dtype=np.uint8)
-    offs = addrs - np.int64(base.ctypes.data)
     out = np.empty((n, 32), dtype=np.uint8)
     # `payloads` stays referenced (and its bytes pinned) for the call
-    rc = lib.dat_blake2b_many(base, offs, lens, n, out.reshape(-1),
-                              _nthreads())
+    rc = lib.dat_blake2b_many_ptrs(addrs, lens, n, out.reshape(-1),
+                                   _nthreads())
     if rc != 0:
         return None
     if _OBS.on:
@@ -318,6 +331,35 @@ def sketch(buf: np.ndarray, rec_offs, rec_lens, key_offs, key_lens,
     if rc != 0:
         return None
     return table, slots
+
+
+def cdc_hash(buf: np.ndarray, avg_bits: int, thin_bits: int,
+             min_size: int, max_size: int):
+    """Fused single-pass content addressing: chunk cuts AND per-chunk
+    BLAKE2b-256 digests in ONE sweep over ``buf`` (the ``fused1p``
+    route's host engine).  Returns ``(cuts, digests)`` — cuts as int64
+    end-offsets (exclusive, last == len), digests (nchunks, 32) uint8 —
+    or ``None`` when the native library is unavailable or the shape is
+    out of the fused kernel's range (``thin_bits`` outside [5, 31]):
+    callers fall back to the two-pass route, which is byte-identical.
+    """
+    if not 5 <= thin_bits <= 31:
+        return None
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    n = len(buf)
+    cap = n // max(min_size, 1) + 2
+    cuts = np.empty(cap, dtype=np.int64)
+    digests = np.empty((cap, 32), dtype=np.uint8)
+    rc = lib.dat_cdc_hash(buf, n, avg_bits, thin_bits, min_size, max_size,
+                          cuts, digests.reshape(-1), cap, _nthreads())
+    if rc < 0:
+        return None  # parameter out of range: two-pass route serves it
+    if _OBS.on:
+        _M_NATIVE_HASH_BYTES.inc(n)
+    return cuts[:rc], digests[:rc]
 
 
 def gear_candidates(buf: np.ndarray, avg_bits: int, thin_bits: int = -1,

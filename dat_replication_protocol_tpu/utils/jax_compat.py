@@ -1,0 +1,34 @@
+"""jax version-drift shims — the ONE owner of every rename adaptation.
+
+The repo must run on the jax the image ships AND the newer jax the TPU
+pods run; two renames currently differ between them:
+
+* ``pltpu.TPUCompilerParams`` -> ``pltpu.CompilerParams`` — bound here
+  as :data:`COMPILER_PARAMS` for every Pallas kernel module.
+* ``jax.experimental.shard_map.shard_map`` -> ``jax.shard_map``, whose
+  ``check_rep`` kwarg became ``check_vma`` — bound here as
+  :func:`shard_map` accepting the NEW spelling and translating for the
+  old function.
+
+A new drift gets its shim HERE, not a copy per consumer (five modules
+shared these verbatim before this file existed).
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as _pltpu
+
+COMPILER_PARAMS = getattr(_pltpu, "CompilerParams", None) or getattr(
+    _pltpu, "TPUCompilerParams"
+)
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax: experimental home, check_rep kwarg
+
+    from jax.experimental.shard_map import shard_map as _shard_map_compat
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_compat(*args, **kwargs)

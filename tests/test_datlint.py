@@ -416,6 +416,47 @@ def test_wire_parity_cap_constant_python_python_drift(tmp_path):
     assert _rules_fired(findings) == {"wire-constant-parity"}
 
 
+# Gear CDC scramble constants (ISSUE 7): ops/rabin.py and BOTH native
+# scan loops (dat_gear_candidates + the fused dat_cdc_hash) write them
+# down independently — a fork is a route fork: two "equivalent" engines
+# silently cutting different chunks.
+GEAR_PY = '''
+_GEAR_C1 = 0x9E3779B1
+_GEAR_C2 = 0x85EBCA77
+'''
+
+GEAR_C_GOOD = '''
+// wire: GEAR_C1 = 0x9E3779B1
+// wire: GEAR_C2 = 0x85EBCA77
+const uint32_t c1 = 0x9E3779B1u, c2 = 0x85EBCA77u;
+'''
+
+
+def test_wire_parity_covers_gear_constants(tmp_path):
+    bad = GEAR_C_GOOD.replace("GEAR_C1 = 0x9E3779B1",
+                              "GEAR_C1 = 0x9E3779B9")
+    findings = _lint(tmp_path, ("rabin.py", GEAR_PY), ("native.cpp", bad))
+    drift = [f for f in findings if f.rule == "wire-constant-parity"]
+    assert {m.split("wire constant ")[1].split(" ")[0] for m in
+            (f.message for f in drift)} == {"GEAR_C1"}
+
+
+def test_wire_parity_gear_constants_clean_when_agreeing(tmp_path):
+    assert _lint(tmp_path, ("rabin.py", GEAR_PY),
+                 ("native.cpp", GEAR_C_GOOD)) == []
+
+
+def test_obs_discipline_covers_fused_route_telemetry(tmp_path):
+    # the single-pass module's counters/engine notes carry the same
+    # literal-name contract as every other telemetry site
+    findings = _lint(tmp_path, ("fused.py", '''
+        def f(_counter, _note_engine, which):
+            _counter("cdc.fused." + which).inc()
+            _note_engine("cdc.hash", "fused1p-native", bytes=1)
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 1
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_line_suppression_silences_one_finding(tmp_path):

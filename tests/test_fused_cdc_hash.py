@@ -1,0 +1,394 @@
+"""Single-pass content addressing (ISSUE 7): route equivalence, the
+on-chip cross-check, donated/pipelined transfers, and the ptr-array
+native hash entry.
+
+The core contract: EVERY content-addressing route — the fused native
+single pass (``fused1p``), the two-pass native composition, the device
+single-residency pipeline, the pallas extraction kernels (interpret
+mode), and a plain hashlib reference — produces byte-identical cuts and
+digests for the same stream.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+from dat_replication_protocol_tpu.ops import rabin
+from dat_replication_protocol_tpu.runtime import native
+from dat_replication_protocol_tpu.runtime.content import (
+    content_digests,
+    resolve_cdc_route,
+)
+
+
+def _ref_digests(buf: np.ndarray, cuts) -> list[bytes]:
+    offs = [0] + list(cuts[:-1])
+    return [
+        hashlib.blake2b(buf[a:b].tobytes(), digest_size=32).digest()
+        for a, b in zip(offs, cuts)
+    ]
+
+
+# -- route equivalence fuzz ---------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_fused1p_matches_two_pass_and_hashlib(seed, monkeypatch):
+    """Random sizes/parameters: fused1p cuts+digests == two-pass ==
+    hashlib, including chunk-boundary edge shapes (sizes straddling
+    min/max chunk, block multiples, single-byte tails)."""
+    monkeypatch.delenv("DAT_CDC_ROUTE", raising=False)
+    rng = random.Random(seed)
+    sizes = [
+        rng.randrange(0, 200_000),
+        rng.choice([1, 127, 128, 129, 4096]),          # block edges
+        rng.choice([1 << 11, (1 << 15) + 1, 65_537]),  # min/max chunk edges
+    ]
+    for n in sizes:
+        buf = np.frombuffer(rng.randbytes(n), dtype=np.uint8)
+        avg = rng.choice([8, 10, 13])
+        mn = 1 << (avg - 2)
+        mx = 1 << (avg + 2)
+        cuts_f, digs_f = content_digests(buf, avg, mn, mx, route="fused1p")
+        cuts_2, digs_2 = content_digests(buf, avg, mn, mx, route="2p")
+        assert cuts_f == cuts_2, (n, avg)
+        assert np.array_equal(digs_f, digs_2), (n, avg)
+        ref = _ref_digests(buf, cuts_f)
+        assert [digs_f[i].tobytes() for i in range(len(ref))] == ref
+        if n:
+            assert cuts_f[-1] == n
+            assert cuts_f == rabin.chunk_stream(buf, avg, mn, mx)
+
+
+def test_edge_cases_empty_single_byte_and_forced_cuts():
+    # empty blob
+    cuts, digs = content_digests(b"")
+    assert cuts == [] and digs.shape == (0, 32)
+    # single byte
+    cuts, digs = content_digests(b"x")
+    assert cuts == [1]
+    assert digs[0].tobytes() == hashlib.blake2b(
+        b"x", digest_size=32).digest()
+    # all-zero data has NO gear candidates: every cut is a forced
+    # max_size cut, plus the sub-min tail
+    z = np.zeros(100_000, dtype=np.uint8)
+    cuts_f, digs_f = content_digests(z, 10, 256, 4096, route="fused1p")
+    cuts_2, digs_2 = content_digests(z, 10, 256, 4096, route="2p")
+    assert cuts_f == cuts_2
+    assert np.array_equal(digs_f, digs_2)
+    sizes = np.diff([0] + cuts_f)
+    assert (sizes[:-1] == 4096).all()
+    # min_size below the fused kernel's thinning range: transparently
+    # served by the two-pass route, still identical
+    b = np.frombuffer(random.Random(7).randbytes(5000), dtype=np.uint8)
+    cuts_s, digs_s = content_digests(b, 6, 16, 256)
+    cuts_s2, digs_s2 = content_digests(b, 6, 16, 256, route="2p")
+    assert cuts_s == cuts_s2 and np.array_equal(digs_s, digs_s2)
+
+
+def test_native_cdc_hash_parity_direct():
+    """The C entry against the composed native two-pass, incl. the
+    multi-slab path (the engine's slabs are 32 MiB: this buffer forces
+    the cross-slab greedy frontier, candidate-queue erase, seam-window
+    dedup, and the anti-phase job split to all run) and an explicit
+    multi-thread split."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(3)
+    buf = rng.integers(0, 256, (70 << 20) + 321, dtype=np.uint8)
+    out = native.cdc_hash(buf, 13, 10, 1 << 11, 1 << 15)
+    assert out is not None
+    cuts, digs = out
+    cands = native.gear_candidates(buf, 13, 10)
+    ref_cuts = rabin._greedy_select(cands, len(buf), 1 << 11, 1 << 15)
+    assert cuts.tolist() == ref_cuts
+    ends = np.asarray(ref_cuts, np.int64)
+    offs = np.concatenate([np.zeros(1, np.int64), ends[:-1]])
+    ref = native.hash_many(buf, offs, ends - offs)
+    assert np.array_equal(digs, ref)
+    # out-of-range thinning refuses (caller falls back)
+    assert native.cdc_hash(buf, 13, 4, 8, 64) is None
+
+
+def test_route_resolution_and_invalid_values(monkeypatch):
+    monkeypatch.delenv("DAT_CDC_ROUTE", raising=False)
+    monkeypatch.delenv("DAT_CDC_FIRST_KERNEL", raising=False)
+    assert resolve_cdc_route() == "fused1p"
+    monkeypatch.setenv("DAT_CDC_ROUTE", "bitmask")
+    assert resolve_cdc_route() == "2p"
+    # invalid values resolve to the DEFAULTS, never a crash or a lie
+    monkeypatch.setenv("DAT_CDC_ROUTE", "Fused1P")
+    assert resolve_cdc_route() == "fused1p"
+    assert rabin.effective_route(use_pallas=False) == "bitmask"
+    monkeypatch.setenv("DAT_CDC_ROUTE", "fused1p")
+    assert rabin.effective_route(use_pallas=True) == "fused1p"
+    # off-pallas the fused1p extraction aliases to bitmask
+    assert rabin.effective_route(use_pallas=False) == "bitmask"
+    # and the extraction path still yields the host-reference candidates
+    data = random.Random(13).randbytes(6 * 4096 + 321)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    ref = rabin.host_thin(rabin.host_candidates(data, 8), 8)
+    got = rabin._device_candidates(buf, 8, 1 << 12, 4, thin_bits=8)
+    assert got.tolist() == ref
+
+
+# -- the fused1p pallas extraction + on-chip cross-check ----------------------
+
+
+def test_checked_kernel_matches_fused_kernel_interpret():
+    import jax.numpy as jnp
+
+    from dat_replication_protocol_tpu.ops.fused_cdc_hash_pallas import (
+        gear_window_first_checked,
+    )
+    from dat_replication_protocol_tpu.ops.rabin_pallas import (
+        gear_window_first_pallas,
+    )
+
+    T, stride, thin = 2, 2048, 9
+    data = random.Random(17).randbytes(T * stride)
+    words = jnp.asarray(np.frombuffer(data, dtype=np.uint8).view("<u4"))
+    rows = rabin._build_rows(
+        words, jnp.zeros((rabin._PREFIX_WORDS,), jnp.uint32), T, stride
+    )
+    ref = np.asarray(gear_window_first_pallas(rows, 8, thin, interpret=True))
+    got, viol = gear_window_first_checked(rows, 8, thin, interpret=True)
+    assert np.array_equal(ref, np.asarray(got))
+    assert int(viol) == 0
+    assert (np.asarray(got) < (1 << 30)).any(), "weak fixture: no candidates"
+
+
+def test_crosscheck_refusal_falls_back_to_bitmask(monkeypatch, obs_enabled):
+    """A divergent checked-kernel output (viol != 0) must be REFUSED:
+    collect() recomputes on the bitmask route and the refusal counter
+    fires — the cuts that come back are still the host-reference ones."""
+    import jax.numpy as jnp
+
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+    from dat_replication_protocol_tpu.ops import fused_cdc_hash_pallas as fch
+    from dat_replication_protocol_tpu.ops import rabin_pallas
+
+    # force the pallas routing decision on a CPU host, with both pallas
+    # kernels redirected to their portable-XLA equivalents
+    monkeypatch.setattr(rabin, "pallas_active", lambda: True)
+    monkeypatch.setattr(
+        rabin_pallas, "gear_candidates_pallas",
+        lambda rows, avg_bits, **kw: rabin.gear_candidates_tiled(
+            rows, avg_bits),
+    )
+
+    def fake_checked(rows, avg_bits, thin_bits, **kw):
+        # the CORRECT window-first reduction, but claiming divergence
+        vw = rabin.gear_candidates_tiled(rows, avg_bits)[
+            :, rabin._PREFIX // rabin.PACK:]
+        wpw = (1 << thin_bits) // rabin.PACK
+        first = rabin._first_bit_per_window(vw.reshape(-1, wpw))
+        return first, jnp.int32(1)
+
+    monkeypatch.setattr(fch, "gear_window_first_checked", fake_checked)
+    monkeypatch.setenv("DAT_CDC_ROUTE", "fused1p")
+    data = random.Random(23).randbytes(2 << 12)
+    buf = np.zeros(-(-len(data) // 4) * 4, dtype=np.uint8)
+    buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    before = obs_metrics.snapshot()["counters"].get(
+        "cdc.fused.crosscheck.refused", 0)
+    got = rabin.candidates_words(buf.view("<u4"), len(data), avg_bits=8,
+                                 tile_bytes=1 << 12, thin_bits=8)
+    ref = rabin.host_thin(rabin.host_candidates(data, 8), 8)
+    assert got.tolist() == ref
+    after = obs_metrics.snapshot()["counters"].get(
+        "cdc.fused.crosscheck.refused", 0)
+    assert after == before + 1
+
+
+# -- device single-residency pipeline -----------------------------------------
+
+
+def test_device_pipeline_matches_host_routes(monkeypatch):
+    monkeypatch.setenv("DAT_DEVICE_CDC", "1")
+    monkeypatch.setenv("DAT_DEVICE_HASH", "1")
+    rng = np.random.default_rng(31)
+    buf = rng.integers(0, 256, 150_000, dtype=np.uint8)
+    cuts_d, digs_d = content_digests(buf, avg_bits=10)
+    monkeypatch.setenv("DAT_DEVICE_CDC", "0")
+    monkeypatch.setenv("DAT_DEVICE_HASH", "0")
+    cuts_h, digs_h = content_digests(buf, avg_bits=10)
+    assert cuts_d == cuts_h
+    assert np.array_equal(digs_d, digs_h)
+
+
+def test_pack_extents_device_matches_host_pack():
+    import jax.numpy as jnp
+
+    from dat_replication_protocol_tpu.batch.feed import pack_ragged
+    from dat_replication_protocol_tpu.ops.fused_cdc_hash_pallas import (
+        pack_extents_device,
+    )
+
+    rng = np.random.default_rng(41)
+    buf = rng.integers(0, 256, 5000, dtype=np.uint8)
+    offs = np.array([0, 130, 1024, 2049], dtype=np.int64)
+    lens = np.array([130, 894, 1025, 777], dtype=np.int64)
+    nb = 16
+    staged = np.zeros(-(-len(buf) // 4) * 4, dtype=np.uint8)
+    staged[: len(buf)] = buf
+    words = jnp.asarray(staged.view("<u4"))
+    mh_d, ml_d, lens_d = pack_extents_device(words, offs, lens, nb)
+    mh_h, ml_h, lens_h = pack_ragged(buf, offs, lens, nb)
+    assert np.array_equal(np.asarray(mh_d), mh_h)
+    assert np.array_equal(np.asarray(ml_d), ml_h)
+    assert np.array_equal(np.asarray(lens_d), lens_h)
+
+
+def test_merkle_root_host_matches_device_fold():
+    from dat_replication_protocol_tpu.ops import merkle
+
+    rng = np.random.default_rng(43)
+    for n in (1, 2, 3, 5, 8, 100):
+        digs = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+        leaves = [digs[i].tobytes() for i in range(n)]
+        p = 1
+        while p < n:
+            p <<= 1
+        padded = leaves + [b"\0" * 32] * (p - n)
+        assert merkle.root_host(digs) == merkle.host_tree(padded)[-1][0]
+    assert merkle.root_host(np.empty((0, 32), np.uint8)) == b"\0" * 32
+
+
+# -- ptr-array native hash entry (ADVICE r5 satellite) ------------------------
+
+
+def test_hash_many_list_ptr_entry_parity():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = random.Random(5)
+    payloads = [rng.randbytes(rng.randrange(0, 5000)) for _ in range(300)]
+    payloads += [b"", b"x", b"y" * 128, b"z" * 129, b"w" * 256]
+    out = native.hash_many_list(payloads)
+    if out is None:
+        pytest.skip("fastpath extension unavailable")
+    for i, p in enumerate(payloads):
+        assert out[i].tobytes() == hashlib.blake2b(
+            p, digest_size=32).digest(), i
+    # and against the extent-based engine over a joined buffer
+    lens = np.array([len(p) for p in payloads], dtype=np.int64)
+    offs = np.cumsum(lens) - lens
+    joined = np.frombuffer(b"".join(payloads), np.uint8)
+    assert np.array_equal(out, native.hash_many(joined, offs, lens))
+
+
+# -- donated dispatch + pipelined readback ------------------------------------
+
+
+def test_donated_batch_path_byte_exact(monkeypatch):
+    import warnings
+
+    from dat_replication_protocol_tpu.ops.blake2b import (
+        blake2b_batch,
+        donation_supported,
+    )
+
+    payloads = [random.Random(9).randbytes(n) for n in (0, 1, 128, 1000)]
+    ref = [hashlib.blake2b(p, digest_size=32).digest() for p in payloads]
+    monkeypatch.setenv("DAT_DONATE", "0")
+    assert not donation_supported()
+    assert blake2b_batch(payloads) == ref
+    monkeypatch.setenv("DAT_DONATE", "1")
+    assert donation_supported()
+    with warnings.catch_warnings():
+        # CPU jax ignores donation with a warning; the routed default
+        # (donation_supported) never takes this path on CPU — the
+        # override exists exactly so the donated program is testable
+        warnings.simplefilter("ignore")
+        assert blake2b_batch(payloads) == ref
+
+
+def test_pipeline_prefetches_d2h_before_deliver():
+    """Part 3 of the tentpole: dispatching batch N+1 starts batch N's
+    digest readback (start_d2h) BEFORE any deliver blocks on it."""
+    from dat_replication_protocol_tpu.backend.tpu_backend import (
+        DigestPipeline,
+    )
+
+    events = []
+    ids = iter(range(100))
+
+    def hash_begin(payloads):
+        batch_id = next(ids)
+        events.append(("dispatch", batch_id))
+
+        def collect():
+            events.append(("collect", batch_id))
+            return [hashlib.blake2b(p, digest_size=32).digest()
+                    for p in payloads]
+
+        def start_d2h():
+            if ("start_d2h", batch_id) not in events:
+                events.append(("start_d2h", batch_id))
+
+        collect.start_d2h = start_d2h
+        return collect
+
+    pipe = DigestPipeline(hash_begin=hash_begin, max_batch=1,
+                          max_inflight=2)
+    got = []
+    for i in range(3):
+        pipe.submit(b"payload-%d" % i, got.append)
+    pipe.flush()
+    assert len(got) == 3
+    # batch 0's readback started when batch 1 was dispatched — well
+    # before anything collected it
+    assert events.index(("start_d2h", 0)) < events.index(("collect", 0))
+    assert events.index(("start_d2h", 0)) > events.index(("dispatch", 1)) - 2
+    # every batch's readback was started before its collect
+    for b in range(3):
+        assert events.index(("start_d2h", b)) < events.index(("collect", b))
+
+
+def test_dispatch_span_opens_before_prior_deliver_closes(obs_enabled):
+    """The acceptance trace evidence: with the pipelined readback, the
+    device.dispatch span of batch N+1 OPENS before the device.deliver
+    span of batch N closes (h2d rides under compute, readback under the
+    next submit)."""
+    from dat_replication_protocol_tpu.backend.tpu_backend import (
+        DigestPipeline,
+    )
+    from dat_replication_protocol_tpu.obs.tracing import SPANS
+
+    pipe = DigestPipeline(max_batch=1, max_inflight=2)
+    got = []
+    for i in range(4):
+        pipe.submit(b"p%d" % i, got.append)
+    pipe.flush()
+    assert len(got) == 4
+    dispatches = SPANS.spans("device.dispatch")
+    delivers = SPANS.spans("device.deliver")
+    assert len(dispatches) == 4 and len(delivers) == 4
+    # deliver of batch 0 happens inside dispatch of batch 2 (inflight
+    # bound 2): dispatch[2] opened before deliver[0] closed
+    d2_open = dispatches[2]["ts"]
+    d0_close = delivers[0]["ts"] + delivers[0]["dur"]
+    assert d2_open <= d0_close
+
+
+def test_feed_h2d_overlap_counter(obs_enabled):
+    from dat_replication_protocol_tpu.batch.feed import hash_extents
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+
+    rng = np.random.default_rng(51)
+    buf = rng.integers(0, 256, 64 * 4096, dtype=np.uint8)
+    offs = np.arange(64, dtype=np.int64) * 4096
+    lens = np.full(64, 4096, dtype=np.int64)
+    # tiny pipeline budget: many chunks, uploads staged while earlier
+    # dispatches are still in flight
+    digs = hash_extents(buf, offs, lens, pipeline_bytes=1 << 14)
+    assert len(digs) == 64
+    snap = obs_metrics.snapshot()["counters"]
+    assert snap.get("device.h2d.overlap", 0) > 0
+    assert digs[0].tobytes() == hashlib.blake2b(
+        buf[:4096].tobytes(), digest_size=32).digest()

@@ -175,6 +175,69 @@ def test_sweep_tpu_backend_digest_state_survives_resume(seed):
     assert events == expected
 
 
+# -- fused + double-buffered digest pipeline under faults (ISSUE 7) ---------
+
+@pytest.mark.parametrize("seed", [2, 7, 13])
+def test_sweep_fused_pipeline_digests_exactly_once(seed, monkeypatch):
+    """Mid-blob/mid-run faults through the DONATED, double-buffered
+    digest pipeline: the decoder's pipeline runs the jitted batch engine
+    with donated input buffers and two batches in flight across the
+    fault.  Digests must arrive exactly once per (kind, seq) with values
+    identical to the unfaulted run — a donated buffer whose HBM was
+    recycled mid-resume must never leak a stale block into the next
+    dispatch's hashes."""
+    import warnings
+
+    from dat_replication_protocol_tpu.backend.tpu_backend import (
+        DigestPipeline,
+    )
+
+    monkeypatch.setenv("DAT_DEVICE_HASH", "1")  # the jitted batch engine
+    monkeypatch.setenv("DAT_DONATE", "1")       # donated staging buffers
+    warnings.simplefilter("ignore")  # CPU jax warns per ignored donation
+
+    def fresh():
+        # small batch + inflight bounds: several batches genuinely in
+        # flight while the fault machinery stalls/truncates/resumes
+        dec = protocol.decode(
+            backend="tpu",
+            pipeline=DigestPipeline(max_batch=4, max_inflight=2),
+        )
+        events: list = []
+        dec.change(lambda c, done: (
+            events.append(("change", c.key, c.value)), done()))
+        dec.blob(lambda b, done: b.collect(
+            lambda data: (events.append(("blob", data)), done())))
+        dec.on_digest(
+            lambda kind, s, d: events.append(("digest", kind, s, d)))
+        return dec, events
+
+    exp_dec, expected = fresh()
+    for off in range(0, len(_WIRE), 777):
+        exp_dec.write(_WIRE[off:off + 777])
+    exp_dec.end()
+    assert exp_dec.finished
+
+    dec, events = fresh()
+
+    def source(ckpt, failures):
+        remaining = len(_WIRE) - ckpt.wire_offset
+        plan = FaultPlan.for_sweep(seed, remaining, attempt=failures)
+        return FaultyReader(bytes_reader(_WIRE[ckpt.wire_offset:]), plan)
+
+    stats = _with_watchdog(lambda: run_resumable(
+        source, dec,
+        BackoffPolicy(base=0.0005, cap=0.005, max_retries=8, seed=seed),
+        chunk_size=1024, expected_total=len(_WIRE),
+        stall_timeout=HARD_TIMEOUT / 2,
+    ))
+    assert stats is not None
+    digests = [e for e in events if e[0] == "digest"]
+    keys = [(k, s) for _, k, s, _ in digests]
+    assert len(keys) == len(set(keys)), "duplicate digest delivery"
+    assert events == expected  # values byte-identical, order preserved
+
+
 # -- soak: 200 seeds (slow) -------------------------------------------------
 
 @pytest.mark.slow

@@ -263,6 +263,55 @@ def test_slow_upload_then_burst_is_not_torn_down_as_stalled():
     assert stats["digests"] == n
 
 
+def test_stall_teardown_with_inflight_digest_batches(monkeypatch):
+    """Reply stall while the PIPELINED digest engine (ISSUE 7: jitted
+    batch dispatches, prefetched readback) still holds in-flight work:
+    the drain teardown must stay bounded — the flush-before-finalize
+    barrier parked behind a stalled reply cannot deadlock the session
+    thread against its own outstanding batches."""
+    import time
+
+    monkeypatch.setenv("DAT_DEVICE_HASH", "1")  # the jitted batch engine
+
+    enc = protocol.encode()
+    n = 1200  # enough digest replies to cross the encoder high-water
+    for i in range(n):
+        enc.change({"key": f"k{i}", "change": i, "from": 0, "to": 1,
+                    "value": b"x" * 16})
+    enc.finalize()
+    wire = enc.read()
+
+    state = {"fed": False}
+
+    def read_bytes(_n):
+        if state["fed"]:
+            return b""
+        state["fed"] = True
+        return wire
+
+    released = threading.Event()
+    closed = threading.Event()
+
+    def write_bytes(data):
+        if closed.is_set():
+            raise OSError("EPIPE")
+        released.wait(30)  # the client never reads its reply
+        raise OSError("EPIPE")
+
+    def close_write():
+        closed.set()
+        released.set()
+
+    t0 = time.monotonic()
+    stats = sidecar.run_session(read_bytes, write_bytes,
+                                close_write=close_write,
+                                drain_timeout=1.0)
+    elapsed = time.monotonic() - t0
+    assert closed.is_set(), "stall teardown never fired close_write"
+    assert elapsed < 20, f"teardown took {elapsed:.1f}s with batches in flight"
+    assert stats["ok"] is False
+
+
 # -- telemetry (ISSUE 3): stall events + --stats-fd machinery ----------------
 
 
