@@ -18,6 +18,9 @@ The headline metric is config 3 (the 50 GiB/s north-star target);
   7 wire_batch    rows/s per-record vs columnar ChangeBatch framing A/B
   8 fused_e2e     GiB/s bytes->digests: fused single-pass route vs the
                   two-pass route (min-of-reps A/B; ISSUE 7)
+  9 hub_soak      N concurrent sessions on ONE shared ReplicationHub:
+                  aggregate GiB/s + per-session fairness (min/median
+                  session throughput ratio; ISSUE 8)
 
 Robustness (round-1 failure was a backend-init crash that cost the round
 its only perf artifact): device-backend init is retried with backoff and
@@ -28,8 +31,9 @@ on every backend (<30 s on CPU).
 Env knobs: BENCH_ITEMS / BENCH_ITEM_MIB / BENCH_CHUNK (config 3),
 BENCH_REPLAY_ROWS, BENCH_CDC_MIB / BENCH_CDC_REPS, BENCH_MERKLE_LOG2,
 BENCH_ROUNDTRIPS, BENCH_RESUME_ROWS / BENCH_RESUME_REPS (config 6),
-BENCH_CONFIGS (comma list, default "1,2,3,4,5,6,7,8"),
-BENCH_FUSED_MIB / BENCH_FUSED_REPS / BENCH_FUSED_DEVICE (config 8).
+BENCH_CONFIGS (comma list, default "1,2,3,4,5,6,7,8,9"),
+BENCH_FUSED_MIB / BENCH_FUSED_REPS / BENCH_FUSED_DEVICE (config 8),
+BENCH_HUB_SESSIONS / BENCH_HUB_ROWS / BENCH_HUB_BLOB_KIB (config 9).
 """
 
 from __future__ import annotations
@@ -1530,6 +1534,111 @@ def _bench_fused_e2e_device_leg(quick: bool, out: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# config 9: multi-session hub soak — N concurrent sessions multiplexed
+# onto ONE shared ReplicationHub/DigestPipeline (ISSUE 8).  Headline is
+# aggregate decode+digest GiB/s; fairness is min/median per-session
+# throughput (weighted-fair batching should hold it near 1.0 — a value
+# near 0 means one session starved, the regression the gate watches).
+# ---------------------------------------------------------------------------
+
+
+def bench_hub_soak(quick: bool, backend: str) -> dict:
+    import threading
+
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.hub import ReplicationHub
+
+    sessions = _env_int("BENCH_HUB_SESSIONS", 8 if quick else 16)
+    rows = _env_int("BENCH_HUB_ROWS", 2_048 if quick else 16_384)
+    blob_kib = _env_int("BENCH_HUB_BLOB_KIB", 256 if quick else 2_048)
+
+    # per-session wires built untimed: a bulk change run (the native
+    # bulk decode path) plus one blob, distinct keys per session
+    wires = []
+    for i in range(sessions):
+        e = protocol.encode()
+        e.change_many([
+            {"key": f"s{i}-{j:06d}", "change": j, "from": j, "to": j + 1,
+             "value": b"v" * 64}
+            for j in range(rows)
+        ])
+        b = e.blob(blob_kib << 10)
+        b.write(bytes(blob_kib << 10))
+        b.end()
+        e.finalize()
+        parts = []
+        while True:
+            d = e.read(1 << 20)
+            if d is None:
+                break
+            parts.append(d)
+        wires.append(b"".join(parts))
+    total_bytes = sum(len(w) for w in wires)
+
+    hub = ReplicationHub(linger_s=0.002, window_items=1 << 16,
+                         window_bytes=64 << 20, parked_budget=1 << 30,
+                         max_sessions=sessions + 1)
+    done = [None] * sessions
+    start_gate = threading.Event()
+
+    def run_one(i: int) -> None:
+        start_gate.wait(30)
+        t0 = time.perf_counter()
+        s = hub.register(f"s{i}")
+        dec = protocol.decode(backend="tpu", pipeline=s)
+        n = {"d": 0}
+        dec.on_digest(lambda kind, seq, d: n.__setitem__("d", n["d"] + 1))
+        wire = wires[i]
+        step = 1 << 18
+        for off in range(0, len(wire), step):
+            dec.write(wire[off:off + step])
+        dec.end()
+        assert dec.finished
+        s.close()
+        done[i] = (time.perf_counter() - t0, n["d"])
+
+    threads = [threading.Thread(target=run_one, args=(i,), daemon=True)
+               for i in range(sessions)]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    start_gate.set()
+    for t in threads:
+        t.join(600)
+    wall = time.perf_counter() - t0
+    hub.close()
+    assert all(d is not None for d in done), "hub soak session hung"
+    digests = sum(d[1] for d in done)
+    assert digests == sessions * (rows + 1)
+
+    per_tput = [len(wires[i]) / done[i][0] for i in range(sessions)]
+    ordered = sorted(per_tput)
+    median = ordered[sessions // 2]
+    fairness = (ordered[0] / median) if median > 0 else 0.0
+    agg = total_bytes / wall / (1 << 30)
+    log(f"bench[hub_soak]: {sessions} sessions x ({rows} rows + "
+        f"{blob_kib} KiB blob) — aggregate {agg:.3f} GiB/s, fairness "
+        f"min/median {fairness:.2f}, {digests} digests")
+    return {
+        "metric": "hub_soak_aggregate_throughput",
+        "value": round(agg, 3),
+        "unit": "GiB/s",
+        "vs_baseline": None,
+        "sessions": sessions,
+        "rows_per_session": rows,
+        "blob_kib": blob_kib,
+        "total_mib": round(total_bytes / (1 << 20), 1),
+        "digests": digests,
+        "fairness_min_median": round(fairness, 3),
+        "session_gib_s_min": round(ordered[0] / (1 << 30), 4),
+        "session_gib_s_median": round(median / (1 << 30), 4),
+        "reduced_config": sessions < 16 or rows < 16_384,
+        "full_config": "16 sessions x (16384 rows + 2 MiB blob) on one "
+                       "shared hub",
+    }
+
+
+# ---------------------------------------------------------------------------
 
 
 BENCHES = {
@@ -1541,6 +1650,7 @@ BENCHES = {
     "6": ("resume", bench_resume),
     "7": ("wire_batch", bench_wire_batch),
     "8": ("fused_e2e", bench_fused_e2e),
+    "9": ("hub_soak", bench_hub_soak),
 }
 
 
@@ -1681,7 +1791,7 @@ def main() -> None:
         obs_flight.arm(flight_dir)
     which = [
         k.strip()
-        for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6,7,8").split(",")
+        for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9").split(",")
         if k.strip() in BENCHES
     ]
 
@@ -1724,7 +1834,7 @@ def main() -> None:
     # (config 8's opt-in device leg initializes jax itself — it is for
     # the TPU watch script, which only fires when the tunnel answers)
     for key in which:
-        if key in ("1", "2", "6", "7", "8"):
+        if key in ("1", "2", "6", "7", "8", "9"):
             run_config(key, "host")
 
     # priority order for the device leg: the headline hash config first,
@@ -1732,7 +1842,7 @@ def main() -> None:
     # that appears late in the budget must still yield config 3
     priority = {"3": 0, "5": 1, "4": 2}
     device_keys = sorted(
-        (k for k in which if k not in ("1", "2", "6", "7", "8")),
+        (k for k in which if k not in ("1", "2", "6", "7", "8", "9")),
         key=lambda k: priority.get(k, 9)
     )
     if device_keys:

@@ -11,6 +11,7 @@ from __future__ import annotations
 from .bounded_wait import BoundedWait
 from .cursor_coherence import CursorCoherence
 from .env_cache import EnvCachePolicy
+from .hub_isolation import HubIsolation
 from .jit_purity import JitPurity
 from .obs_discipline import ObsDiscipline
 from .unbounded_join import UnboundedJoin
@@ -24,6 +25,7 @@ ALL_RULES = (
     JitPurity(),
     WireConstantParity(),
     ObsDiscipline(),
+    HubIsolation(),
 )
 
 

@@ -381,9 +381,17 @@ class TpuDecoder(Decoder):
     def _note_change_payloads(self, payloads, count: int) -> None:
         # the bulk loop's tap: payloads arrive in delivery order for the
         # whole run; per-seq submit order (and therefore digest delivery
-        # order) matches the per-frame path exactly
+        # order) matches the per-frame path exactly.  A pipeline with a
+        # bulk surface (the hub's session facade: one window check and
+        # one lock round-trip per run instead of per payload) gets the
+        # whole run at once — identical tags/ordering either way.
         seq = self._change_seq
         if payloads:
+            submit_many = getattr(self._pipeline, "submit_many", None)
+            if submit_many is not None:
+                submit_many(payloads, self._emit_change_digest, seq)
+                self._change_seq = seq + len(payloads)
+                return
             submit = self._pipeline.submit
             emit = self._emit_change_digest
             for p in payloads:

@@ -1,0 +1,31 @@
+"""Multi-session replication hub: one shared device engine, many sessions.
+
+ISSUE 8 / ROADMAP item 1: the sidecar used to build one
+:class:`~..backend.tpu_backend.DigestPipeline` per connection — per-peer
+ownership of the device path, where one misbehaving peer is
+indistinguishable from engine failure.  The hub inverts that: sessions
+register with a key, their digest work is coalesced *across sessions*
+into single XLA dispatches on ONE shared pipeline, and completions route
+back by session key.  Per-session state (queues, windows, stats) lives
+at the edge; the shared hot path carries none of it — the shape the
+SmartNIC reliable-replication work (PAPERS.md) argues for.
+
+See ROBUSTNESS.md §"Overload behavior" for the admission / shedding /
+isolation contract and OBSERVABILITY.md for the ``hub.*`` catalog.
+"""
+
+from .engine import (
+    HubBusy,
+    HubError,
+    HubSession,
+    ReplicationHub,
+    SessionShed,
+)
+
+__all__ = [
+    "ReplicationHub",
+    "HubSession",
+    "HubBusy",
+    "HubError",
+    "SessionShed",
+]

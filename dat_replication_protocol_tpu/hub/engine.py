@@ -1,0 +1,818 @@
+"""The shared replication engine: admission, QoS, fault isolation.
+
+One :class:`ReplicationHub` owns one
+:class:`~..backend.tpu_backend.DigestPipeline` (or a mesh-sharded hash
+engine over it) and multiplexes every registered session onto it:
+
+* **Edge state, shared engine.**  Each session keeps its own queues,
+  window accounting, and stats in a :class:`_SessionState`; the device
+  path sees only coalesced batches.  Completions carry the session's
+  state in their tag and route back without any shared-path lookup.
+* **Admission control.**  ``register()`` rejects with a structured
+  :class:`HubBusy` (never unbounded queue growth) once the session
+  count or the global parked-bytes budget is exhausted.
+* **Per-session backpressure windows.**  ``submit()`` blocks the
+  *calling session's* thread while that session's parked work (queued +
+  in-pipeline + undelivered completions) exceeds its window — a slow
+  consumer stalls only its own window; the dispatcher never runs user
+  callbacks, so it can never be parked by one.
+* **Weighted-fair batching.**  Each cross-session batch is composed
+  round-robin with per-session quotas proportional to ``weight``, then
+  greedily filled (work-conserving): a heavy session cannot monopolize
+  a dispatch, an idle one costs nothing.
+* **Load shedding.**  When global parked bytes exceed the budget — or
+  the recent ``hub.dispatch.latency`` p99 crosses ``latency_shed_s``
+  while parked bytes are past half budget — the heaviest offender (max
+  per-session parked bytes) is shed: its queued work is dropped, its
+  in-flight completions are discarded on arrival, its waiters wake
+  into :class:`SessionShed`, and a ``hub.shed`` event names it.  The
+  other sessions never notice.
+
+Locking discipline (enforced by the ``hub-isolation`` datlint rule):
+**no lock is ever held across a device dispatch** — batches are
+composed under ``self._lock``, dispatched outside it — and per-session
+state is only ever reached through the session-keyed accessor
+(:meth:`ReplicationHub._session_state`) or a handle captured from it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..obs.events import emit as _emit
+from ..obs.metrics import (
+    OBS as _OBS,
+    REGISTRY as _REGISTRY,
+    counter as _counter,
+    gauge as _gauge,
+    histogram as _histogram,
+)
+
+__all__ = [
+    "ReplicationHub",
+    "HubSession",
+    "HubBusy",
+    "HubError",
+    "SessionShed",
+]
+
+# hub telemetry (OBSERVABILITY.md `hub.*` catalog)
+_M_SESSIONS = _gauge("hub.sessions")
+_M_PARKED = _gauge("hub.parked.bytes")
+_M_ADMITTED = _counter("hub.admitted")
+_M_REJECTED = _counter("hub.rejected")
+_M_SHED = _counter("hub.shed")
+_M_BATCHES = _counter("hub.dispatch.batches")
+_M_ITEMS = _counter("hub.dispatch.items")
+_M_BYTES = _counter("hub.dispatch.bytes")
+_M_DROPPED = _counter("hub.completions.dropped")
+_H_LATENCY = _histogram("hub.dispatch.latency")
+
+# dispatcher/waiter guarded-fallback period: wakeups are event-driven
+# (condition notifies); the bound only matters if one is ever lost
+_WAKE_FALLBACK = 0.05
+
+
+class HubBusy(RuntimeError):
+    """Structured admission rejection: the hub is at capacity.
+
+    Carries the decision's inputs so a caller (the sidecar's accept
+    loop, a future RPC layer) can answer with a meaningful retry hint
+    instead of letting queues grow: ``sessions``/``max_sessions`` and
+    ``parked_bytes``/``parked_budget`` at rejection time.
+    """
+
+    def __init__(self, message: str, *, sessions: int, max_sessions: int,
+                 parked_bytes: int, parked_budget: int):
+        super().__init__(message)
+        self.sessions = sessions
+        self.max_sessions = max_sessions
+        self.parked_bytes = parked_bytes
+        self.parked_budget = parked_budget
+
+
+class SessionShed(RuntimeError):
+    """This session was shed by the hub's overload policy.  ``reason``
+    is the policy arm (``parked-budget`` / ``dispatch-latency``);
+    ``parked_bytes`` is what the session held when shed."""
+
+    def __init__(self, key: str, reason: str, parked_bytes: int):
+        super().__init__(
+            f"session {key!r} shed by hub ({reason}, "
+            f"{parked_bytes} parked bytes)")
+        self.key = key
+        self.reason = reason
+        self.parked_bytes = parked_bytes
+
+
+class HubError(RuntimeError):
+    """The shared engine itself failed (dispatcher died / hub closed);
+    every session observes the same structured error."""
+
+
+class _SessionState:
+    """Per-session edge state.  Mutated ONLY under the hub lock, reached
+    ONLY through the hub's session-keyed accessor or a handle captured
+    from it (the hub-isolation contract)."""
+
+    __slots__ = (
+        "key", "weight", "cv", "q", "q_items", "q_bytes",
+        "out_items", "out_bytes", "comp", "comp_items", "comp_bytes",
+        "submitted", "submitted_bytes", "delivered", "delivered_bytes",
+        "dispatches", "shed", "shed_parked", "gone", "flush_goal",
+    )
+
+    def __init__(self, key: str, weight: float, lock: threading.Lock):
+        self.key = key
+        self.weight = weight
+        self.cv = threading.Condition(lock)
+        self.q: deque = deque()   # (kind, item, cb, tag, nbytes)
+        self.q_items = 0
+        self.q_bytes = 0
+        self.out_items = 0        # in the shared pipeline
+        self.out_bytes = 0
+        self.comp: deque = deque()  # (cb, tag, digest, nbytes)
+        self.comp_items = 0
+        self.comp_bytes = 0
+        self.submitted = 0
+        self.submitted_bytes = 0
+        self.delivered = 0
+        self.delivered_bytes = 0
+        self.dispatches = 0       # batches this session contributed to
+        self.shed: Optional[str] = None
+        self.shed_parked = 0      # parked bytes at shed time (the verdict)
+        self.gone = False
+        self.flush_goal: Optional[int] = None
+
+    @property
+    def parked_bytes(self) -> int:
+        return self.q_bytes + self.out_bytes + self.comp_bytes
+
+    @property
+    def parked_items(self) -> int:
+        return self.q_items + self.out_items + self.comp_items
+
+
+class HubSession:
+    """A session's handle on the hub — and a drop-in ``pipeline`` for
+    :class:`~..backend.tpu_backend.TpuDecoder` / ``TpuEncoder``: the
+    same ``submit`` / ``submit_stream`` / ``flush`` surface as
+    :class:`~..backend.tpu_backend.DigestPipeline`, with the work
+    coalesced across sessions behind it.  Completions are delivered on
+    the session's OWN thread (inside ``submit``/``flush``), in submit
+    order, so a callback that blocks — the sidecar's reply backpressure
+    — parks only this session."""
+
+    def __init__(self, hub: "ReplicationHub", state: _SessionState):
+        self._hub = hub
+        self._state = state
+
+    @property
+    def key(self) -> str:
+        return self._state.key
+
+    @property
+    def shed_reason(self) -> Optional[str]:
+        return self._state.shed
+
+    def submit(self, payload, on_digest: Callable, tag=None) -> None:
+        self._hub._submit_run(
+            self._state,
+            (("payload", payload, on_digest, tag, len(payload)),),
+            len(payload))
+
+    def submit_many(self, payloads, on_digest: Callable,
+                    tag_base: int = 0) -> None:
+        """Bulk submit: one window check and ONE lock round-trip for a
+        whole run (tags ``tag_base..tag_base+n-1``) — the bulk decoder
+        feeds thousands of change payloads per wire chunk, and a lock
+        acquisition per payload was ~3x the whole submit cost.  The
+        window is enforced at run granularity (a run is admitted whole
+        once there is any room — same policy as the oversized single
+        item)."""
+        entries = []
+        total = 0
+        for k, p in enumerate(payloads):
+            n = len(p)
+            entries.append(("payload", p, on_digest, tag_base + k, n))
+            total += n
+        if entries:
+            self._hub._submit_run(self._state, entries, total)
+
+    def submit_stream(self, stream, on_digest: Callable, tag=None) -> None:
+        nbytes = int(getattr(stream, "length", 0))
+        self._hub._submit_run(
+            self._state, (("stream", stream, on_digest, tag, nbytes),),
+            nbytes)
+
+    def flush(self) -> None:
+        self._hub._flush_session(self._state)
+
+    def close(self) -> None:
+        """Unregister; queued work is dropped, in-flight completions are
+        discarded on arrival.  Idempotent."""
+        self._hub._unregister(self._state)
+
+    def stats(self) -> dict:
+        return self._hub._session_stats(self._state)
+
+    def __enter__(self) -> "HubSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _mesh_hash_begin_factory(n_devices: Optional[int] = None):
+    """The cross-session mesh engine: shard the coalesced hash batch
+    over the device mesh with batch-dim ``NamedSharding`` (SNIPPETS.md
+    idiom; 8 devices in MULTICHIP_r05.json).  Returns None — fall back
+    to the pipeline's default engine — on host-routed or single-device
+    backends."""
+    from ..utils.routing import prefer_host
+
+    if prefer_host("DAT_DEVICE_HASH"):
+        return None
+    try:
+        import jax  # noqa: PLC0415
+
+        from ..parallel import mesh as pmesh  # noqa: PLC0415
+
+        n_avail = len(jax.devices())
+        n = n_devices if n_devices is not None else n_avail
+        while n & (n - 1):
+            n -= 1  # largest power of two the mesh layer accepts
+        if n < 2:
+            return None
+        m = pmesh.make_mesh(n)
+        if _OBS.on:
+            from ..obs.device import note_engine as _note_engine
+
+            _note_engine("digest.hash", "mesh-sharded", devices=n)
+        return lambda payloads: pmesh.sharded_hash_begin(m, payloads)
+    except Exception:
+        return None
+
+
+class ReplicationHub:
+    """See module docstring.  One hub per process/daemon; sessions come
+    and go via :meth:`register` / :meth:`HubSession.close`.
+
+    ``mesh="auto"`` shards cross-session batches over every local device
+    (falling back to the pipeline's default engine on host/single-chip
+    backends); an int pins the device count; ``None`` (default) keeps
+    the single-device engine.
+    """
+
+    def __init__(
+        self,
+        pipeline=None,
+        *,
+        hash_batch: Optional[Callable] = None,
+        mesh=None,
+        max_sessions: int = 1024,
+        parked_budget: int = 256 << 20,
+        window_items: int = 4096,
+        window_bytes: int = 32 << 20,
+        max_batch: int = 1024,
+        max_batch_bytes: int = 1 << 30,
+        linger_s: float = 0.002,
+        latency_shed_s: Optional[float] = None,
+    ):
+        if pipeline is None:
+            from ..backend.tpu_backend import DigestPipeline
+
+            hash_begin = None
+            if mesh is not None and hash_batch is None:
+                hash_begin = _mesh_hash_begin_factory(
+                    None if mesh == "auto" else int(mesh))
+            # the hub owns batching: the inner pipeline's item cap is
+            # effectively ours (we dispatch explicitly per composed
+            # batch), its inflight bound stays the readback pipeline
+            pipeline = DigestPipeline(
+                hash_batch=hash_batch, hash_begin=hash_begin,
+                max_batch=max_batch, max_batch_bytes=max_batch_bytes)
+        self._pipeline = pipeline
+        self.max_sessions = int(max_sessions)
+        self.parked_budget = int(parked_budget)
+        self.window_items = int(window_items)
+        self.window_bytes = int(window_bytes)
+        self._max_batch = int(max_batch)
+        self._max_batch_bytes = int(max_batch_bytes)
+        self._linger_s = float(linger_s)
+        self.latency_shed_s = latency_shed_s
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._sessions: dict[str, _SessionState] = {}
+        self._next_id = 0
+        self._rr = 0
+        self._q_items = 0            # global queued (not yet in pipeline)
+        self._q_bytes = 0
+        self._parked_bytes = 0       # global queued+outstanding+undelivered
+        self._oldest_ts: Optional[float] = None
+        self._routed: list = []     # dispatcher-thread-local (see _route)
+        # recent dispatch-turn latencies (dispatcher-thread-local ring):
+        # the latency shed arm triggers on this window's p99, not on one
+        # isolated slow turn (a first-bucket compile must not shed)
+        self._lat_ring: deque = deque(maxlen=64)
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._failed: Optional[BaseException] = None
+        # bind the collector ONCE: close() unregisters owner-checked by
+        # identity, so an old hub draining past a successor's startup
+        # cannot delete the successor's live collector
+        self._collector_fn = self._collect
+        _REGISTRY.register_collector("hub", self._collector_fn)
+
+    # -- registration / admission -------------------------------------------
+
+    def register(self, key: Optional[str] = None,
+                 weight: float = 1.0) -> HubSession:
+        """Admit one session.  Raises :class:`HubBusy` (structured) when
+        the session count or parked-bytes budget is exhausted — bounded
+        state instead of queue growth is the overload contract."""
+        if weight <= 0:
+            raise ValueError("session weight must be > 0")
+        if key is not None and (not key or any(
+                c in key for c in "{},=\"\n\r")):
+            # keys ride telemetry label sets ({session=KEY}) and JSON
+            # stats breakdowns: structural characters would corrupt the
+            # exposition for EVERY session, so refuse at the boundary
+            raise ValueError(
+                f"session key {key!r} must be non-empty and contain "
+                'none of {},=" or newlines')
+        with self._lock:
+            self._check_alive_locked()
+            if key is None:
+                key = f"s{self._next_id}"
+            self._next_id += 1
+            if key in self._sessions:
+                raise ValueError(f"session key {key!r} already registered")
+            # admission closes at HALF the shed budget: new sessions
+            # are refused while the hub still has headroom to serve the
+            # ones it already admitted — rejecting a newcomer is cheap,
+            # shedding a live session is not, so the former guards the
+            # latter (ROBUSTNESS.md overload behavior)
+            if len(self._sessions) >= self.max_sessions or \
+                    self._parked_bytes >= self.parked_budget // 2:
+                if _OBS.on:
+                    _M_REJECTED.inc()
+                    _emit("hub.reject", key=key,
+                          sessions=len(self._sessions),
+                          max_sessions=self.max_sessions,
+                          parked_bytes=self._parked_bytes,
+                          parked_budget=self.parked_budget)
+                raise HubBusy(
+                    f"hub at capacity ({len(self._sessions)}/"
+                    f"{self.max_sessions} sessions, "
+                    f"{self._parked_bytes}/{self.parked_budget} parked "
+                    f"bytes)",
+                    sessions=len(self._sessions),
+                    max_sessions=self.max_sessions,
+                    parked_bytes=self._parked_bytes,
+                    parked_budget=self.parked_budget,
+                )
+            st = _SessionState(key, float(weight), self._lock)
+            self._sessions[key] = st
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._dispatch_loop, name="hub-dispatch",
+                    daemon=True)
+                self._thread.start()
+            if _OBS.on:
+                _M_ADMITTED.inc()
+                _M_SESSIONS.set(len(self._sessions))
+                _emit("hub.admit", key=key, weight=float(weight),
+                      sessions=len(self._sessions))
+        return HubSession(self, st)
+
+    def _session_state(self, key: str) -> _SessionState:
+        """THE session-keyed accessor (hub-isolation contract): every
+        key-addressed reach into per-session state goes through here."""
+        return self._sessions[key]
+
+    def _unregister(self, st: _SessionState) -> None:
+        done_stats = None
+        with self._lock:
+            if st.gone:
+                return
+            st.gone = True
+            # queued + undelivered completions leave the parked set now;
+            # in-pipeline bytes leave as their completions route back
+            self._q_items -= st.q_items
+            self._q_bytes -= st.q_bytes
+            self._parked_bytes -= st.q_bytes + st.comp_bytes
+            st.q.clear()
+            st.q_items = st.q_bytes = 0
+            st.comp.clear()
+            st.comp_items = st.comp_bytes = 0
+            if self._sessions.get(st.key) is st:
+                del self._sessions[st.key]
+            st.cv.notify_all()
+            self._work.notify_all()
+            if _OBS.on:
+                _M_SESSIONS.set(len(self._sessions))
+                _M_PARKED.set(self._parked_bytes)
+                done_stats = self._session_stats_locked(st)
+        if done_stats is not None:
+            _emit("hub.session.done", key=st.key, shed=st.shed,
+                  **{k: v for k, v in done_stats.items()
+                     if k in ("submitted", "delivered", "submitted_bytes",
+                              "dispatches")})
+
+    # -- session-side paths (run on the session's own thread) ---------------
+
+    def _submit_run(self, st: _SessionState, entries, run_bytes: int) -> None:
+        """Admit a run of entries (possibly one) into the session's
+        queue — ONE lock round-trip per run, window-checked at run
+        granularity.  Blocks (delivering ready completions meanwhile)
+        while the session's window is full."""
+        n = len(entries)
+        while True:
+            with self._lock:
+                self._check_session_alive_locked(st)
+                ready = self._pop_completions_locked(st)
+                if not ready:
+                    # window: parked work (queued + in-pipeline +
+                    # undelivered) bounds this session; a run (or an
+                    # oversized single item) is admitted whole once
+                    # there is any room, rather than deadlocking an
+                    # empty window
+                    if st.parked_items < self.window_items and (
+                            st.parked_bytes < self.window_bytes
+                            or st.parked_items == 0):
+                        st.q.extend(entries)
+                        st.q_items += n
+                        st.q_bytes += run_bytes
+                        st.submitted += n
+                        st.submitted_bytes += run_bytes
+                        was_idle = self._q_items == 0
+                        self._q_items += n
+                        self._q_bytes += run_bytes
+                        self._parked_bytes += run_bytes
+                        if self._oldest_ts is None:
+                            self._oldest_ts = time.monotonic()
+                        if _OBS.on:
+                            _M_PARKED.set(self._parked_bytes)
+                        self._maybe_shed_locked()
+                        self._check_session_alive_locked(st)
+                        # wake the dispatcher only on the transitions it
+                        # acts on (first work after idle, batch full) —
+                        # a notify per submit was pure GIL churn
+                        if was_idle or self._q_items >= self._max_batch:
+                            self._work.notify_all()
+                        return
+                    st.cv.wait(_WAKE_FALLBACK)
+                    continue
+            self._deliver(st, ready)
+
+    def _flush_session(self, st: _SessionState) -> None:
+        """Block until every item this session submitted *before this
+        call* has had its digest delivered — the per-session
+        flush-before-finalize barrier on the shared engine."""
+        with self._lock:
+            self._check_session_alive_locked(st)
+            st.flush_goal = st.submitted
+            self._work.notify_all()
+        try:
+            while True:
+                with self._lock:
+                    ready = self._pop_completions_locked(st)
+                    if not ready:
+                        self._check_session_alive_locked(st)
+                        if st.delivered >= (st.flush_goal or 0):
+                            return
+                        st.cv.wait(_WAKE_FALLBACK)
+                        continue
+                self._deliver(st, ready)
+        finally:
+            with self._lock:
+                st.flush_goal = None
+
+    def _pop_completions_locked(self, st: _SessionState) -> list:
+        if not st.comp:
+            return []
+        ready = list(st.comp)
+        st.comp.clear()
+        st.comp_items = 0
+        freed = st.comp_bytes
+        st.comp_bytes = 0
+        # delivery accounting happens at pop time, in bulk: the popping
+        # thread IS the delivering thread (the session's own), so the
+        # counter can never run ahead of an observable delivery by more
+        # than that thread's own call stack
+        st.delivered += len(ready)
+        st.delivered_bytes += freed
+        self._parked_bytes -= freed
+        if _OBS.on:
+            _M_PARKED.set(self._parked_bytes)
+        return ready
+
+    @staticmethod
+    def _deliver(st: _SessionState, ready: list) -> None:
+        # user callbacks run here, on the session's own thread, with no
+        # hub lock held: a blocking consumer parks only itself
+        for cb, tag, digest, nbytes in ready:
+            if tag is None:
+                cb(digest)
+            else:
+                cb(tag, digest)
+
+    def _check_alive_locked(self) -> None:
+        if self._failed is not None:
+            raise HubError(
+                f"hub dispatcher failed: {self._failed!r}") from self._failed
+        if self._closed:
+            raise HubError("hub is closed")
+
+    def _check_session_alive_locked(self, st: _SessionState) -> None:
+        self._check_alive_locked()
+        if st.shed is not None:
+            raise SessionShed(st.key, st.shed, st.shed_parked)
+        if st.gone:
+            raise HubError(f"session {st.key!r} is closed")
+
+    # -- the dispatcher (the only thread that touches the pipeline) ---------
+
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    while not (self._closed or self._failed
+                               or self._turn_ready_locked()):
+                        self._work.wait(self._wait_s_locked())
+                    if self._closed or self._failed:
+                        return
+                    batch = self._compose_locked()
+                    engine_flush = self._flush_needed_locked()
+                t0 = time.monotonic()
+                turn_bytes = 0
+                for entry_st, kind, item, cb, tag, nbytes in batch:
+                    routed = (entry_st, cb, tag, nbytes)
+                    if kind == "payload":
+                        self._pipeline.submit(item, self._route, routed)
+                    else:
+                        self._pipeline.submit_stream(item, self._route,
+                                                     routed)
+                    turn_bytes += nbytes
+                if batch:
+                    self._pipeline.dispatch()
+                with self._lock:
+                    drain_idle = (self._q_items == 0
+                                  and self._pipeline.inflight > 0)
+                if engine_flush or drain_idle:
+                    # queue is dry (or a session is at its finalize
+                    # barrier): drain the readback pipeline so windows
+                    # free and flush barriers release promptly
+                    self._pipeline.flush()
+                self._distribute_routed()
+                if batch or engine_flush:
+                    latency = time.monotonic() - t0
+                    self._lat_ring.append(latency)
+                    if _OBS.on:
+                        _H_LATENCY.observe(latency)
+                        if batch:
+                            _M_BATCHES.inc()
+                            _M_ITEMS.inc(len(batch))
+                            _M_BYTES.inc(turn_bytes)
+                    ordered = sorted(self._lat_ring)
+                    p99 = ordered[min(len(ordered) - 1,
+                                      int(0.99 * len(ordered)))]
+                    with self._lock:
+                        self._maybe_shed_locked(latency_p99=p99)
+        except BaseException as exc:  # noqa: BLE001 — fanned out below
+            with self._lock:
+                self._failed = exc
+                _emit("hub.error", error=f"{type(exc).__name__}: {exc}")
+                for key in list(self._sessions):
+                    self._session_state(key).cv.notify_all()
+                self._work.notify_all()
+
+    def _turn_ready_locked(self) -> bool:
+        if self._flush_needed_locked():
+            return True
+        if self._q_items == 0:
+            return self._pipeline.inflight > 0
+        if self._q_items >= self._max_batch or \
+                self._q_bytes >= self._max_batch_bytes:
+            return True
+        return (self._oldest_ts is not None
+                and time.monotonic() - self._oldest_ts >= self._linger_s)
+
+    def _wait_s_locked(self) -> float:
+        if self._oldest_ts is not None:
+            remaining = self._linger_s - (time.monotonic() - self._oldest_ts)
+            if remaining > 0:
+                return min(_WAKE_FALLBACK, remaining)
+        return _WAKE_FALLBACK
+
+    def _flush_needed_locked(self) -> bool:
+        for st in self._sessions.values():
+            # a shed session's goal can never be met (its queue was
+            # dropped); its own thread is about to observe SessionShed
+            # and clear the goal — don't spin the engine on it
+            if st.flush_goal is not None and st.shed is None and \
+                    st.delivered + st.comp_items < st.flush_goal:
+                return True
+        return False
+
+    def _compose_locked(self) -> list:
+        """Weighted-fair cross-session batch: one quota pass
+        proportional to session weight, then a greedy work-conserving
+        fill.  Moves accounting queued -> outstanding; the caller
+        dispatches OUTSIDE the lock."""
+        order = [st for st in self._sessions.values()
+                 if st.q_items and st.shed is None]
+        if not order:
+            return []
+        start = self._rr % len(order)
+        order = order[start:] + order[:start]
+        self._rr += 1
+        total_w = sum(st.weight for st in order)
+        items_left = self._max_batch
+        bytes_left = self._max_batch_bytes
+        batch: list = []
+
+        def take(st: _SessionState, limit: int) -> int:
+            nonlocal items_left, bytes_left
+            n = 0
+            while n < limit and items_left and st.q:
+                nbytes = st.q[0][4]
+                if st.q[0][0] == "payload" and nbytes > bytes_left \
+                        and batch:
+                    break  # oversized item waits for its own batch
+                kind, item, cb, tag, nbytes = st.q.popleft()
+                st.q_items -= 1
+                st.q_bytes -= nbytes
+                st.out_items += 1
+                st.out_bytes += nbytes
+                self._q_items -= 1
+                self._q_bytes -= nbytes
+                batch.append((st, kind, item, cb, tag, nbytes))
+                items_left -= 1
+                if kind == "payload":
+                    bytes_left -= nbytes
+                n += 1
+            return n
+
+        for st in order:  # quota pass: weight-proportional shares
+            quota = max(1, int(self._max_batch * st.weight / total_w))
+            if take(st, quota):
+                st.dispatches += 1
+        for st in order:  # greedy fill: unused budget is not wasted
+            if items_left <= 0 or bytes_left <= 0:
+                break
+            take(st, items_left)
+        self._oldest_ts = time.monotonic() if self._q_items else None
+        return batch
+
+    def _route(self, routed, digest: bytes) -> None:
+        """Pipeline completion -> the dispatcher-local buffer.  ONLY the
+        dispatcher thread runs pipeline calls, so this append needs no
+        lock; :meth:`_distribute_routed` moves the buffer into the
+        per-session completion queues in one locked pass per turn —
+        one lock round-trip for a whole batch instead of one per item."""
+        self._routed.append((routed, digest))
+
+    def _distribute_routed(self) -> None:
+        routed, self._routed = self._routed, []
+        if not routed:
+            return
+        dropped = 0
+        with self._lock:
+            touched = set()
+            for (st, cb, tag, nbytes), digest in routed:
+                st.out_items -= 1
+                st.out_bytes -= nbytes
+                if st.gone or st.shed is not None:
+                    # the session is no longer listening: its bytes
+                    # leave the parked set here (queued/comp already did)
+                    self._parked_bytes -= nbytes
+                    dropped += 1
+                else:
+                    st.comp.append((cb, tag, digest, nbytes))
+                    st.comp_items += 1
+                    st.comp_bytes += nbytes
+                touched.add(st)
+            for st in touched:
+                st.cv.notify_all()
+            if dropped and _OBS.on:
+                _M_DROPPED.inc(dropped)
+                _M_PARKED.set(self._parked_bytes)
+
+    # -- overload policy ----------------------------------------------------
+
+    def _maybe_shed_locked(self,
+                           latency_p99: Optional[float] = None) -> None:
+        over_budget = self._parked_bytes > self.parked_budget
+        slow = (latency_p99 is not None
+                and self.latency_shed_s is not None
+                and latency_p99 > self.latency_shed_s
+                and self._parked_bytes > self.parked_budget // 2)
+        if not (over_budget or slow):
+            return
+        reason = "parked-budget" if over_budget else "dispatch-latency"
+        live = [st for st in self._sessions.values() if st.shed is None]
+        if not live:
+            return
+        victim = max(live, key=lambda st: st.parked_bytes)
+        self._shed_locked(victim, reason)
+
+    def _shed_locked(self, st: _SessionState, reason: str) -> None:
+        held = st.parked_bytes
+        st.shed = reason
+        st.shed_parked = held
+        # queued + undelivered leave the parked set now; in-pipeline
+        # bytes leave as their (discarded) completions route back
+        self._q_items -= st.q_items
+        self._q_bytes -= st.q_bytes
+        self._parked_bytes -= st.q_bytes + st.comp_bytes
+        st.q.clear()
+        st.q_items = st.q_bytes = 0
+        st.comp.clear()
+        st.comp_items = st.comp_bytes = 0
+        st.cv.notify_all()
+        if _OBS.on:
+            _M_SHED.inc()
+            _M_PARKED.set(self._parked_bytes)
+        _emit("hub.shed", key=st.key, reason=reason, parked_bytes=held,
+              sessions=len(self._sessions))
+
+    # -- snapshots / lifecycle ----------------------------------------------
+
+    def _session_stats_locked(self, st: _SessionState) -> dict:
+        return {
+            "parked_bytes": st.parked_bytes,
+            "submitted": st.submitted,
+            "submitted_bytes": st.submitted_bytes,
+            "delivered": st.delivered,
+            "dispatches": st.dispatches,
+            "shed": st.shed,
+        }
+
+    def _session_stats(self, st: _SessionState) -> dict:
+        with self._lock:
+            return self._session_stats_locked(st)
+
+    def sessions_snapshot(self) -> dict:
+        """{key: per-session stats} for every live session — the
+        ``sessions`` breakdown the sidecar's ``--stats-fd`` lines carry
+        in hub mode (and the chaos oracle cross-checks)."""
+        with self._lock:
+            return {key: self._session_stats_locked(self._session_state(key))
+                    for key in self._sessions}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "sessions": len(self._sessions),
+                "parked_bytes": self._parked_bytes,
+                "queued_items": self._q_items,
+                "failed": (None if self._failed is None
+                           else f"{type(self._failed).__name__}: "
+                                f"{self._failed}"),
+            }
+
+    def _collect(self) -> dict:
+        """Registry snapshot collector: labeled per-session entries for
+        sessions currently alive (bounded cardinality by construction —
+        dead sessions simply stop appearing)."""
+        counters: dict = {}
+        gauges: dict = {}
+        with self._lock:
+            gauges["hub.sessions"] = float(len(self._sessions))
+            for key in self._sessions:
+                st = self._session_state(key)
+                label = f"{{session={key}}}"
+                gauges["hub.session.parked_bytes" + label] = \
+                    float(st.parked_bytes)
+                counters["hub.session.submitted" + label] = st.submitted
+                counters["hub.session.delivered" + label] = st.delivered
+                counters["hub.session.dispatches" + label] = st.dispatches
+        return {"counters": counters, "gauges": gauges}
+
+    def close(self) -> None:
+        """Stop the dispatcher and release the collector.  Sessions
+        still registered observe :class:`HubError` on their next call;
+        callers should drain/close sessions first."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for key in list(self._sessions):
+                self._session_state(key).cv.notify_all()
+            self._work.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+        _REGISTRY.unregister_collector("hub", self._collector_fn)
+
+    def __enter__(self) -> "ReplicationHub":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -253,11 +253,24 @@ class Registry:
     """Name -> metric, process-global.  Get-or-create is idempotent so
     any module can hoist a handle at import without ordering concerns;
     a name registered twice with a different TYPE is a programming
-    error and raises."""
+    error and raises.
+
+    **Collectors** are the bounded-cardinality answer to per-entity
+    metrics (ISSUE 8: per-session hub telemetry): registering one
+    counter/gauge per session key would grow the registry forever —
+    sessions come and go, metric registrations never do.  A collector
+    is a callable the owner registers ONCE; at ``snapshot()`` time it
+    returns ``{"counters": {...}, "gauges": {...}}`` for the entities
+    *currently alive*, and those entries are merged into the snapshot
+    (labeled names — ``hub.session.parked_bytes{session=k}`` — keep
+    them distinguishable from registered metrics).  Dead entities
+    simply stop appearing; nothing leaks.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}
+        self._collectors: dict[str, object] = {}
 
     def _get(self, name: str, cls, *args, **kwargs):
         with self._lock:
@@ -291,10 +304,28 @@ class Registry:
                 f"buckets/ring")
         return h
 
+    def register_collector(self, name: str, fn) -> None:
+        """Attach a snapshot-time collector (see class docstring).
+        ``fn()`` must return a dict with optional ``counters`` /
+        ``gauges`` sections; re-registering a name replaces the old
+        collector (the hub re-registers on restart)."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    def unregister_collector(self, name: str, fn=None) -> None:
+        """Remove a collector.  Pass the registered ``fn`` to make the
+        removal owner-checked: a replaced collector's OLD owner closing
+        late must not delete the NEW owner's live entry (the hub
+        rolling-restart pattern)."""
+        with self._lock:
+            if fn is None or self._collectors.get(name) is fn:
+                self._collectors.pop(name, None)
+
     def snapshot(self) -> dict:
         """Plain-dict view of every registered metric (JSON-able)."""
         with self._lock:
             metrics = list(self._metrics.values())
+            collectors = list(self._collectors.values())
         out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
         for m in metrics:
             if isinstance(m, Counter):
@@ -303,14 +334,28 @@ class Registry:
                 out["gauges"][m.name] = m.value
             elif isinstance(m, Histogram):
                 out["histograms"][m.name] = m._snapshot()
+        for fn in collectors:
+            try:
+                contributed = fn()
+            except Exception:
+                # a dying collector (hub mid-close) must not take the
+                # whole snapshot down — the registered metrics are the
+                # contract, collector entries are best-effort extras
+                continue
+            for section in ("counters", "gauges"):
+                out[section].update(contributed.get(section, {}))
         return out
 
     def reset(self) -> None:
         """Zero every metric's VALUE, keeping registrations (and the
         handles instrumentation sites hoisted) intact — per-test and
-        per-bench-config isolation."""
+        per-bench-config isolation.  Collectors ARE dropped: they hold
+        references into live owner state (a hub), and a collector
+        surviving its test/config would leak that state into the next
+        snapshot."""
         with self._lock:
             metrics = list(self._metrics.values())
+            self._collectors.clear()
         for m in metrics:
             m._reset()
 
@@ -348,6 +393,26 @@ def _prom_name(name: str) -> str:
     return "dat_" + _PROM_SANITIZE.sub("_", name)
 
 
+def _prom_series(name: str) -> str:
+    """Full series name for one snapshot entry.  Labeled collector
+    entries (``hub.session.parked_bytes{session=k1}``) become proper
+    Prometheus label sets (``dat_hub_session_parked_bytes{session="k1"}``);
+    plain names pass through :func:`_prom_name`."""
+    if "{" not in name or not name.endswith("}"):
+        return _prom_name(name)
+    base, _, labels = name[:-1].partition("{")
+    pairs = []
+    for part in labels.split(","):
+        k, _, v = part.partition("=")
+        # exposition-format escaping for label values: backslash,
+        # double-quote, and (defensively — producers reject them at
+        # their boundary) literal newlines
+        v = v.replace("\\", "\\\\").replace('"', '\\"') \
+             .replace("\n", "\\n")
+        pairs.append(f'{_PROM_SANITIZE.sub("_", k.strip())}="{v}"')
+    return _prom_name(base) + "{" + ",".join(pairs) + "}"
+
+
 def _prom_num(v) -> str:
     if isinstance(v, float):
         if v != v:  # NaN
@@ -368,14 +433,22 @@ def to_prom_text(snap: Optional[dict] = None) -> str:
     if snap is None:
         snap = REGISTRY.snapshot()
     lines: list[str] = []
-    for name, v in sorted(snap.get("counters", {}).items()):
-        n = _prom_name(name)
-        lines.append(f"# TYPE {n} counter")
-        lines.append(f"{n} {_prom_num(v)}")
-    for name, v in sorted(snap.get("gauges", {}).items()):
-        n = _prom_name(name)
-        lines.append(f"# TYPE {n} gauge")
-        lines.append(f"{n} {_prom_num(v)}")
+
+    def emit_section(section: str, kind: str) -> None:
+        # one TYPE line per metric NAME, however many label sets the
+        # collectors contribute — a second TYPE line for the same name
+        # makes the whole scrape invalid exposition
+        typed: set = set()
+        for name, v in sorted(snap.get(section, {}).items()):
+            n = _prom_series(name)
+            base = n.partition("{")[0]
+            if base not in typed:
+                typed.add(base)
+                lines.append(f"# TYPE {base} {kind}")
+            lines.append(f"{n} {_prom_num(v)}")
+
+    emit_section("counters", "counter")
+    emit_section("gauges", "gauge")
     for name, h in sorted(snap.get("histograms", {}).items()):
         n = _prom_name(name)
         lines.append(f"# TYPE {n} histogram")

@@ -172,6 +172,87 @@ def digest_root_step(mesh: Mesh, mh, ml, lengths):
 
 
 @functools.lru_cache(maxsize=None)
+def _sharded_hash_program(mesh: Mesh):
+    """Jitted hash-only sharded step, cached per mesh: the cross-session
+    digest batch (ISSUE 8) needs no Merkle fold or collectives at all —
+    every chip hashes its shard of the batch axis and the results stay
+    sharded, so the whole program is communication-free."""
+
+    def step(mh, ml, lengths):
+        return blake2b_packed(mh, ml, lengths)
+
+    sharded = P(DATA_AXIS)
+    return _jit_site(
+        "parallel.mesh.sharded_hash",
+        jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(sharded, sharded, sharded),
+                out_specs=(sharded, sharded),
+                check_vma=False,
+            )
+        ),
+    )
+
+
+def sharded_hash_begin(mesh: Mesh, payloads, digest_size: int = 32):
+    """Dispatch one cross-session payload batch sharded over the mesh;
+    returns a zero-arg ``collect()`` closure (``.start_d2h`` attached) —
+    the same async contract as :func:`..ops.blake2b.blake2b_batch_begin`,
+    so the hub's shared :class:`~..backend.tpu_backend.DigestPipeline`
+    can use either engine interchangeably.
+
+    Items are bucketed by power-of-two block count (bounded compile
+    count, same policy as the single-device engine); each bucket's batch
+    axis is padded to ``n_devices * 2**k`` and uploaded with a batch-dim
+    :class:`~jax.sharding.NamedSharding` so every chip receives only its
+    shard over the interconnect and hashes it locally — the multiplexed
+    sessions' combined digest work is what finally fills an 8-chip mesh
+    (MULTICHIP_r05.json) that any single session's batch rarely could.
+    """
+    from ..utils.num import next_pow2
+
+    from ..ops.blake2b import BLOCK_BYTES, digests_to_bytes, pack_payloads
+
+    n = mesh.devices.size
+    spec = batch_sharding(mesh)
+    buckets: dict[int, list[int]] = {}
+    for i, p in enumerate(payloads):
+        nb = next_pow2(max(1, -(-len(p) // BLOCK_BYTES)))
+        buckets.setdefault(nb, []).append(i)
+    fn = _sharded_hash_program(mesh)
+    handles = []
+    for nb, idxs in buckets.items():
+        batch = [payloads[i] for i in idxs]
+        Bp = n * next_pow2(-(-len(batch) // n))
+        batch += [b""] * (Bp - len(batch))
+        mh, ml, lengths = pack_payloads(batch, nblocks=nb)
+        mh_d = jax.device_put(mh, spec)
+        ml_d = jax.device_put(ml, spec)
+        len_d = jax.device_put(lengths, spec)
+        hh, hl = fn(mh_d, ml_d, len_d)
+        handles.append((idxs, hh[: len(idxs)], hl[: len(idxs)]))
+
+    def start_d2h() -> None:
+        for _, hh, hl in handles:
+            for arr in (hh, hl):
+                copy_async = getattr(arr, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+
+    def collect() -> list[bytes]:
+        out: list[bytes | None] = [None] * len(payloads)
+        for idxs, hh, hl in handles:
+            for i, d in zip(idxs, digests_to_bytes(hh, hl, digest_size)):
+                out[i] = d
+        return out  # type: ignore[return-value]
+
+    collect.start_d2h = start_d2h  # type: ignore[attr-defined]
+    return collect
+
+
+@functools.lru_cache(maxsize=None)
 def _sharded_diff_program(mesh: Mesh):
     """Jitted sharded diff, cached per mesh (see _digest_root_program)."""
 

@@ -101,9 +101,24 @@ class FaultPlan:
     # absorb without changing the decoded session (corruption is a
     # different class — it must ERROR, and gets targeted tests)
     SWEEP_SCENARIOS = ("drop", "truncate", "stall", "reseg")
+    # the multi-session (hub) scenario axis: what the ONE faulty
+    # co-resident session does while its neighbors stay healthy.  Flip
+    # joins here — isolation must hold even when the faulty session's
+    # wire is corrupt (it errors or delivers corrupt content; the
+    # neighbors must not care either way), which the 1:1 resume sweep
+    # deliberately excludes (flip is not resumable by design).
+    SESSION_SCENARIOS = ("stall", "truncate", "flip")
 
     @classmethod
-    def for_sweep(cls, seed: int, wire_len: int, attempt: int = 0) -> "FaultPlan":
+    def faulty_session(cls, seed: int, n_sessions: int) -> int:
+        """Which session index carries the fault for this seed —
+        deterministic, so the chaos oracle can predict ground truth."""
+        return random.Random(seed * 7_368_787 + n_sessions).randrange(
+            max(1, n_sessions))
+
+    @classmethod
+    def for_sweep(cls, seed: int, wire_len: int, attempt: int = 0,
+                  session: int = 0, n_sessions: int = 1) -> "FaultPlan":
         """The conformance-sweep scenario for ``(seed, attempt)``.
 
         Attempt 0 carries the seed's primary fault, attempt 1 has a 50%
@@ -112,7 +127,21 @@ class FaultPlan:
         seed converges within a bounded number of reconnects while still
         exercising double faults.  Deterministic: same (seed, attempt,
         wire_len) -> same plan.
+
+        **Per-session axis** (ISSUE 8): with ``n_sessions > 1`` this is
+        the shared generator for N concurrent plans, one keyed per
+        ``session`` index.  Exactly one session — :meth:`faulty_session`
+        — draws its primary fault from :data:`SESSION_SCENARIOS`
+        (stall / truncate / flip); every other session gets a benign
+        plan (re-segmentation and small latency only), so hub chaos
+        tests and future fan-out tests can assert the isolation
+        contract against known ground truth.  The default
+        ``(session=0, n_sessions=1)`` path is byte-identical to the
+        pre-axis generator — existing sweeps reproduce unchanged.
         """
+        if n_sessions > 1:
+            return cls._for_session_sweep(seed, wire_len, attempt,
+                                          session, n_sessions)
         rng = random.Random(seed * 1_000_003 + attempt)
         span = max(1, wire_len)
         plan = cls(
@@ -135,6 +164,40 @@ class FaultPlan:
         # "reseg": byte-at-a-time delivery IS the fault
         if scenario == "reseg":
             plan.max_segment = 1
+        return plan
+
+    @classmethod
+    def session_scenario(cls, seed: int, n_sessions: int) -> str:
+        """The faulty session's scenario for this (seed, n_sessions) —
+        exposed so the oracle can check telemetry against ground truth."""
+        rng = random.Random(seed * 2_246_822_519 + n_sessions)
+        return rng.choice(cls.SESSION_SCENARIOS)
+
+    @classmethod
+    def _for_session_sweep(cls, seed: int, wire_len: int, attempt: int,
+                           session: int, n_sessions: int) -> "FaultPlan":
+        rng = random.Random((seed * 1_000_003 + attempt) * 1_789 + session)
+        span = max(1, wire_len)
+        plan = cls(
+            seed=rng.randrange(1 << 30),
+            max_segment=rng.choice([3, 7, 64, 1024, None]),
+            latency_prob=rng.choice([0.0, 0.0, 0.05]),
+            latency_s=0.0005,
+        )
+        if session != cls.faulty_session(seed, n_sessions):
+            return plan  # healthy co-resident: benign delivery jitter only
+        if attempt >= 1:
+            return plan  # the faulty session's reconnect runs clean
+        scenario = cls.session_scenario(seed, n_sessions)
+        at = rng.randrange(span)
+        if scenario == "truncate":
+            plan.truncate_at = at
+        elif scenario == "stall":
+            plan.stall_at = at
+            plan.stall_s = 0.05
+        elif scenario == "flip":
+            plan.flip_at = at
+            plan.flip_mask = rng.choice([0x01, 0x40, 0x80])
         return plan
 
 

@@ -62,9 +62,22 @@ DEFAULT_STATS_INTERVAL = 5.0
 _M_SESSIONS = _counter("sidecar.sessions")
 _M_STALLS = _counter("sidecar.stalls")
 
+# hub mode (ISSUE 8): ONE shared ReplicationHub across every accepted
+# connection; snapshot_stats() carries its per-session breakdown so
+# --stats-fd lines attribute traffic per peer
+_ACTIVE_HUB = None
+
+
+def set_active_hub(hub) -> None:
+    """Install the hub whose per-session breakdown ``--stats-fd``
+    snapshots carry (None detaches)."""
+    global _ACTIVE_HUB
+    _ACTIVE_HUB = hub
+
 
 def run_session(read_bytes, write_bytes, close_write=None,
-                drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
+                drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT,
+                hub=None, session_key: str | None = None) -> dict:
     """Serve one wire session over a blocking byte pair.
 
     ``read_bytes(n)`` / ``write_bytes(data)`` follow the
@@ -78,7 +91,20 @@ def run_session(read_bytes, write_bytes, close_write=None,
     the digest-flush backpressure wait — the encoder is destroyed and
     ``close_write`` invoked (best-effort) so the connection tears down
     instead of leaking a parked thread per stalled client; ``None``
-    waits forever (the pre-round-6 behavior).
+    waits forever (the pre-round-6 behavior).  In hub mode the deadline
+    is PER SESSION by construction: each connection's thread owns its
+    own progress clock, so one draining session's deadline neither
+    extends nor cuts short another's.
+
+    ``hub`` (a :class:`~.hub.ReplicationHub`) switches this session
+    onto the shared device engine: the decoder's digest work registers
+    under ``session_key`` and coalesces with every co-resident
+    session's into single XLA dispatches, completions routing back
+    here by key.  Admission rejection (:class:`~.hub.HubBusy`) returns
+    a structured ``{"ok": False, "rejected": True, ...}`` record
+    without consuming any wire bytes; a mid-session shed
+    (:class:`~.hub.SessionShed`) tears this session down like any
+    other session-fatal error — co-residents never notice either.
 
     The decoder is ALWAYS the digest-capable ``backend='tpu'`` one —
     the plain host :class:`Decoder` has no digest surface and would
@@ -90,8 +116,32 @@ def run_session(read_bytes, write_bytes, close_write=None,
     """
     from . import decode, encode
 
+    hub_session = None
+    if hub is not None:
+        from .hub import HubBusy
+
+        try:
+            hub_session = hub.register(session_key)
+        except HubBusy as e:
+            # structured rejection, bounded state: no decoder, no reply
+            # thread, no queue growth — the client observes EOF
+            out = {"changes": 0, "blobs": 0, "bytes": 0, "digests": 0,
+                   "ok": False, "rejected": True,
+                   "sessions": e.sessions, "parked_bytes": e.parked_bytes}
+            if close_write is not None:
+                try:
+                    close_write()
+                except OSError:
+                    pass
+            if _OBS.on:
+                _emit("sidecar.session", **out)
+            return out
+
     enc = encode()  # reply stream: plain host encoder (digest payloads)
-    dec = decode(backend="tpu")
+    if hub_session is not None:
+        dec = decode(backend="tpu", pipeline=hub_session)
+    else:
+        dec = decode(backend="tpu")
     stats = {"digests": 0}
 
     # reply write progress, shared by every stall check: refreshed each
@@ -183,7 +233,10 @@ def run_session(read_bytes, write_bytes, close_write=None,
         # wire-offset instants the decoder records nest under it
         with _trace_span("sidecar.session.recv"):
             recv_over(dec, read_bytes)
-    except Exception as e:  # ECONNRESET etc.: transport died mid-read
+    except Exception as e:  # ECONNRESET etc.: transport died mid-read —
+        # or, in hub mode, SessionShed/HubError surfacing from the
+        # decoder's digest submits: session-fatal either way, and the
+        # destroy cascade below keeps it THIS session's problem
         if not dec.destroyed:
             dec.destroy(e)
         if not enc.destroyed:
@@ -218,6 +271,13 @@ def run_session(read_bytes, write_bytes, close_write=None,
         "ok": (dec.finished and not dec.destroyed and not enc.destroyed
                and not sender.is_alive()),
     }
+    if hub_session is not None:
+        out["session"] = hub_session.key
+        out["shed"] = hub_session.shed_reason
+        # release the hub slot LAST: queued work is dropped, in-flight
+        # completions discard on arrival — a torn-down session cannot
+        # park bytes against the shared budget
+        hub_session.close()
     if _OBS.on:
         _M_SESSIONS.inc()
         _emit("sidecar.session", **out)
@@ -268,11 +328,16 @@ def serve_tcp(host: str, port: int,
               max_sessions: int | None = None,
               ready_cb=None,
               drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT,
-              retry_policy=None) -> None:
+              retry_policy=None, hub=None) -> None:
     """Accept loop: one concurrent session per connection.
 
     ``max_sessions`` bounds the loop for tests; ``ready_cb(port)`` fires
     once the socket is bound+listening (the test/race-free handshake).
+
+    ``hub`` (ISSUE 8): a shared :class:`~.hub.ReplicationHub` every
+    accepted session registers with — one device pipeline multiplexed
+    across all concurrent connections, admission-controlled, with
+    per-session keys ``c<n>:<peer>`` in the stats breakdown.
 
     ``retry_policy`` (a :class:`~.session.reconnect.BackoffPolicy`, CLI
     flags ``--max-retries`` / ``--backoff-base``) governs the daemon's
@@ -315,13 +380,15 @@ def serve_tcp(host: str, port: int,
                                   describe="accept")
             served += 1
 
-            def _one(conn=conn, peer=peer):
+            def _one(conn=conn, peer=peer, n=served):
                 try:
                     stats = run_session(
                         read_bytes=conn.recv,
                         write_bytes=conn.sendall,
                         close_write=lambda: conn.shutdown(socket.SHUT_WR),
                         drain_timeout=drain_timeout,
+                        hub=hub,
+                        session_key=f"c{n}:{peer[0]}:{peer[1]}",
                     )
                     print(f"sidecar: {peer} {stats}", file=sys.stderr,
                           flush=True)
@@ -432,14 +499,21 @@ def snapshot_stats() -> dict:
     snapshot plus event-ring health and per-site jit-cache traffic
     (the recompile sentinel: a long-lived sidecar recompiling per
     request is the device-path pathology --stats-fd exists to catch).
+    In hub mode the record also carries the per-session ``sessions``
+    breakdown and the hub's aggregate state, keyed by session — the
+    supervisor-visible answer to "which peer is parking bytes".
     JSON-able as-is."""
-    return {
+    out = {
         "ts": time.time(),
         "monotonic": time.monotonic(),
         "metrics": obs_metrics.snapshot(),
         "events_dropped": obs_events.EVENTS.dropped,
         "jit_sites": obs_device.SENTINEL.snapshot(),
     }
+    if _ACTIVE_HUB is not None:
+        out["hub"] = _ACTIVE_HUB.snapshot()
+        out["sessions"] = _ACTIVE_HUB.sessions_snapshot()
+    return out
 
 
 def snapshot_stats_prom() -> str:
@@ -490,6 +564,27 @@ def main(argv=None) -> int:
                         "no progress for this long (a client that stops "
                         "reading); <= 0 waits forever "
                         f"(default: {DEFAULT_DRAIN_TIMEOUT:.0f})")
+    p.add_argument("--hub", action="store_true",
+                   help="multiplex every accepted session onto ONE shared "
+                        "device engine (hub mode, --tcp only): cross-"
+                        "session digest batching, admission control, "
+                        "per-session QoS windows, load shedding (see "
+                        "ROBUSTNESS.md overload behavior)")
+    p.add_argument("--hub-max-sessions", type=int, default=1024,
+                   metavar="N",
+                   help="hub admission bound: concurrent session count "
+                        "past which new connections get a structured "
+                        "rejection (default: 1024)")
+    p.add_argument("--hub-parked-budget", type=int, default=256 << 20,
+                   metavar="BYTES",
+                   help="hub admission + shedding bound on global parked "
+                        "bytes (queued + in-flight + undelivered work; "
+                        "default: 256 MiB)")
+    p.add_argument("--hub-mesh", default=None, metavar="N|auto",
+                   help="shard the hub's cross-session hash batch over "
+                        "the device mesh: 'auto' uses every local "
+                        "device, an integer pins the count (default: "
+                        "single-device engine)")
     p.add_argument("--max-retries", type=int, default=5, metavar="N",
                    help="transient-failure budget: bind/accept errors are "
                         "retried with backoff at most N times before the "
@@ -547,15 +642,31 @@ def main(argv=None) -> int:
     if args.backend == "host":
         os.environ["DAT_DEVICE_HASH"] = "0"  # routing-layer override:
         # force the host digest engine for this daemon's lifetime
+    hub = None
+    if args.hub:
+        if args.stdio:
+            p.error("--hub multiplexes many connections; it needs --tcp")
+        from .hub import ReplicationHub
+
+        mesh = args.hub_mesh
+        if mesh is not None and mesh != "auto":
+            mesh = int(mesh)
+        hub = ReplicationHub(mesh=mesh,
+                             max_sessions=args.hub_max_sessions,
+                             parked_budget=args.hub_parked_budget)
+        set_active_hub(hub)
     try:
         if args.stdio:
             stats = serve_stdio(drain_timeout=drain)
             return 0 if stats["ok"] else 1
         host, _, port = args.tcp.rpartition(":")
         serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain,
-                  retry_policy=policy)
+                  retry_policy=policy, hub=hub)
         return 0
     finally:
+        if hub is not None:
+            set_active_hub(None)
+            hub.close()
         if emitter is not None and emitter.stop():
             # final snapshot — ONLY once the periodic thread really
             # exited: two concurrent writers on one fd can interleave

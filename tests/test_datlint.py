@@ -723,3 +723,104 @@ def test_obs_discipline_ignores_unrelated_emit_and_histogram_apis(tmp_path):
             np.histogram(data, bins)
     '''))
     assert "obs-discipline" not in _rules_fired(findings)
+
+
+# -- hub-isolation (ISSUE 8: the shared-engine structural invariants) -------
+
+# the pre-discipline shape: a device dispatch while the hub lock is
+# held — every co-resident session's submit convoys behind the device
+HUB_LOCK_BAD = '''
+class Hub:
+    def turn(self):
+        with self._lock:
+            batch = self._compose()
+            self._pipeline.dispatch()
+            self._pipeline.flush()
+'''
+
+HUB_LOCK_GOOD = '''
+class Hub:
+    def turn(self):
+        with self._lock:
+            batch = self._compose()
+        self._pipeline.dispatch()
+        self._pipeline.flush()
+'''
+
+# per-session state reached around the session-keyed accessor
+HUB_ACCESSOR_BAD = '''
+class Hub:
+    def shed(self, key):
+        self._sessions[key].shed = "parked-budget"
+'''
+
+HUB_ACCESSOR_GOOD = '''
+class Hub:
+    def _session_state(self, key):
+        return self._sessions[key]
+
+    def shed(self, key):
+        self._session_state(key).shed = "parked-budget"
+'''
+
+
+def _lint_hub(tmp_path, name, source):
+    hub_dir = tmp_path / "hub"
+    hub_dir.mkdir(exist_ok=True)
+    (hub_dir / name).write_text(textwrap.dedent(source))
+    return run_paths([tmp_path])
+
+
+def test_hub_isolation_fires_on_dispatch_under_lock(tmp_path):
+    findings = _lint_hub(tmp_path, "locked.py", HUB_LOCK_BAD)
+    hub = [f for f in findings if f.rule == "hub-isolation"]
+    assert len(hub) == 2  # dispatch AND flush under the lock
+    assert all("with-lock" in f.message for f in hub)
+
+
+def test_hub_isolation_clean_on_compose_then_dispatch(tmp_path):
+    findings = _lint_hub(tmp_path, "clean.py", HUB_LOCK_GOOD)
+    assert "hub-isolation" not in _rules_fired(findings)
+
+
+def test_hub_isolation_covers_engine_closures_and_device_put(tmp_path):
+    # hash_begin()/collect() closures and raw device_put are dispatches
+    # too, whatever object they hang off
+    findings = _lint_hub(tmp_path, "closures.py", '''
+        class Hub:
+            def turn(self, jax, engine):
+                with self.hub_lock:
+                    collect = engine.hash_begin(self.payloads)
+                    jax.device_put(self.batch)
+                    collect()
+    ''')
+    hub = [f for f in findings if f.rule == "hub-isolation"]
+    assert len(hub) == 3  # hash_begin + device_put + the collect() call
+
+
+def test_hub_isolation_fires_on_raw_sessions_subscript(tmp_path):
+    findings = _lint_hub(tmp_path, "subs.py", HUB_ACCESSOR_BAD)
+    hub = [f for f in findings if f.rule == "hub-isolation"]
+    assert len(hub) == 1 and "session-keyed accessor" in hub[0].message
+
+
+def test_hub_isolation_clean_via_accessor(tmp_path):
+    findings = _lint_hub(tmp_path, "acc.py", HUB_ACCESSOR_GOOD)
+    assert "hub-isolation" not in _rules_fired(findings)
+
+
+def test_hub_isolation_scoped_to_hub_directories(tmp_path):
+    # the same shapes OUTSIDE hub/ are other modules' business
+    findings = _lint(tmp_path, ("elsewhere.py", HUB_LOCK_BAD))
+    assert "hub-isolation" not in _rules_fired(findings)
+
+
+def test_hub_isolation_suppression(tmp_path):
+    findings = _lint_hub(tmp_path, "sup.py", '''
+        class Hub:
+            def turn(self):
+                with self._lock:
+                    # datlint: disable=hub-isolation
+                    self._pipeline.flush()
+    ''')
+    assert "hub-isolation" not in _rules_fired(findings)

@@ -1,0 +1,402 @@
+"""Chaos isolation proof (ISSUE 8 acceptance): one misbehaving session
+cannot hurt its co-residents on the shared hub.
+
+The sweep runs >= 8 concurrent sessions per seed on ONE ReplicationHub;
+exactly one session — :meth:`FaultPlan.faulty_session` — runs the
+seed's stall / truncate / flip plan (the per-session scenario axis of
+``FaultPlan.for_sweep``), the rest run benign plans.  The contract:
+
+* every healthy session completes with BYTE-EXACT digests (values
+  pinned against an unfaulted reference run of the same wire);
+* the faulted session is shed, resumed (truncate reconnects via the
+  resume layer), or torn down with ONE structured error — never a hang;
+* the oracle cross-checks hub/per-session telemetry against the
+  injector's ground-truth ``fault.*`` events: the predicted scenario
+  actually fired, any ``hub.shed`` names only the faulty session, and
+  per-session stats show the healthy sessions clean.
+
+Tier-1 sweeps seeds 0..19 (the acceptance shape); the ``slow`` soak
+covers 100 more.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.hub import ReplicationHub, SessionShed
+from dat_replication_protocol_tpu.session.faults import (
+    FaultPlan,
+    FaultyReader,
+    bytes_reader,
+)
+from dat_replication_protocol_tpu.session.reconnect import (
+    BackoffPolicy,
+    run_resumable,
+)
+from dat_replication_protocol_tpu.wire.framing import ProtocolError
+
+N_SESSIONS = 8
+HARD_TIMEOUT = 25.0
+
+
+def _build_wire(i: int) -> bytes:
+    """One small per-session wire, distinct per index so cross-session
+    routing errors surface as digest mismatches: a bulk change run (the
+    native-indexed path), a KiB-scale blob (mid-blob fault territory),
+    a parked change, and a tail."""
+    e = protocol.encode()
+    for j in range(24):
+        e.change({"key": f"s{i}-b{j}", "change": j, "from": j, "to": j + 1,
+                  "value": b"v%02d-%03d" % (i, j)})
+    big = e.blob(1100)
+    big.write(bytes([(i * 7 + k) % 251 for k in range(600)]))
+    e.change({"key": f"s{i}-parked", "change": 99, "from": 0, "to": 1,
+              "value": b"after-blob-%d" % i})
+    big.end(bytes([(i * 13 + k) % 241 for k in range(500)]))
+    for j in range(6):
+        e.change({"key": f"s{i}-t{j}", "change": j, "from": j, "to": j + 1})
+    e.finalize()
+    out = []
+    while True:
+        d = e.read(4096)
+        if d is None:
+            break
+        out.append(d)
+    return b"".join(out)
+
+
+_WIRES = [_build_wire(i) for i in range(N_SESSIONS)]
+
+
+def _reference_digests(i: int) -> list:
+    dec = protocol.decode(backend="tpu")
+    digs: list = []
+    dec.on_digest(lambda kind, seq, d: digs.append((kind, seq, d)))
+    dec.blob(lambda b, done: b.collect(lambda _data: done()))
+    for off in range(0, len(_WIRES[i]), 777):
+        dec.write(_WIRES[i][off:off + 777])
+    dec.end()
+    assert dec.finished
+    return digs
+
+
+_EXPECTED = [_reference_digests(i) for i in range(N_SESSIONS)]
+
+
+def _fresh_hub_decoder(hub_session):
+    dec = protocol.decode(backend="tpu", pipeline=hub_session)
+    digs: list = []
+    dec.on_digest(lambda kind, seq, d: digs.append((kind, seq, d)))
+    dec.blob(lambda b, done: b.collect(lambda _data: done()))
+    return dec, digs
+
+
+def _run_hub_seed(seed: int, hub: ReplicationHub):
+    """All N sessions for one seed; returns {i: (outcome, payload)} with
+    outcome in done/error/shed and the faulty index."""
+    faulty = FaultPlan.faulty_session(seed, N_SESSIONS)
+    results: dict = {}
+    stats: dict = {}
+
+    def healthy_run(i: int) -> None:
+        wire = _WIRES[i]
+        s = hub.register(f"seed{seed}-s{i}")
+        try:
+            dec, digs = _fresh_hub_decoder(s)
+            plan = FaultPlan.for_sweep(seed, len(wire), attempt=0,
+                                       session=i, n_sessions=N_SESSIONS)
+            reader = FaultyReader(bytes_reader(wire), plan)
+            while True:
+                data = reader.read(1024)
+                if not data:
+                    break
+                dec.write(data)
+            dec.end()
+            assert dec.finished, f"healthy session {i} did not finish"
+            stats[i] = s.stats()
+            results[i] = ("done", digs)
+        finally:
+            s.close()
+
+    def faulty_run(i: int) -> None:
+        wire = _WIRES[i]
+        s = hub.register(f"seed{seed}-s{i}")
+        try:
+            dec, digs = _fresh_hub_decoder(s)
+
+            def source(ckpt, failures):
+                remaining = len(wire) - ckpt.wire_offset
+                plan = FaultPlan.for_sweep(seed, remaining,
+                                           attempt=failures, session=i,
+                                           n_sessions=N_SESSIONS)
+                return FaultyReader(
+                    bytes_reader(wire[ckpt.wire_offset:]), plan)
+
+            try:
+                run_resumable(
+                    source, dec,
+                    BackoffPolicy(base=0.0005, cap=0.005, max_retries=8,
+                                  seed=seed),
+                    chunk_size=512, expected_total=len(wire),
+                    stall_timeout=HARD_TIMEOUT / 2)
+            except ProtocolError as e:
+                assert e.offset is not None, f"unstructured error: {e}"
+                results[i] = ("error", e)
+                return
+            except SessionShed as e:
+                results[i] = ("shed", e)
+                return
+            stats[i] = s.stats()
+            results[i] = ("done", digs)
+        finally:
+            s.close()
+
+    threads = []
+    for i in range(N_SESSIONS):
+        fn = faulty_run if i == faulty else healthy_run
+        threads.append(threading.Thread(target=fn, args=(i,), daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(HARD_TIMEOUT)
+    assert all(not t.is_alive() for t in threads), \
+        f"HANG: seed {seed} sessions still running after {HARD_TIMEOUT}s"
+    return results, stats, faulty
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_sweep_one_faulty_session_cannot_hurt_neighbors(seed, obs_enabled):
+    """The acceptance sweep: 8 concurrent sessions, one faulted, with
+    the telemetry oracle cross-checked against injector ground truth."""
+    from dat_replication_protocol_tpu.obs.events import EVENTS
+
+    hub = ReplicationHub(linger_s=0.002)
+    try:
+        results, stats, faulty = _run_hub_seed(seed, hub)
+    finally:
+        hub.close()
+
+    # every healthy co-resident: completed, byte-exact digest stream
+    for i in range(N_SESSIONS):
+        if i == faulty:
+            continue
+        outcome, digs = results[i]
+        assert outcome == "done", f"healthy session {i}: {results[i]}"
+        assert digs == _EXPECTED[i], f"healthy session {i} digests diverged"
+        assert stats[i]["shed"] is None
+        assert stats[i]["delivered"] == len(_EXPECTED[i])
+
+    # the faulted session: shed, resumed-to-completion, or ONE
+    # structured error — never a hang (the join above IS that check)
+    outcome, payload = results[faulty]
+    assert outcome in ("done", "error", "shed"), results[faulty]
+    scenario = FaultPlan.session_scenario(seed, N_SESSIONS)
+    if outcome == "done" and scenario != "flip":
+        # stall absorbs in place, truncate resumes: byte-exact either way
+        assert payload == _EXPECTED[faulty]
+
+    # oracle: the injector's ground-truth events say the predicted
+    # scenario actually fired (fault.* events are emitted by the
+    # injector itself, not the session layer under test)
+    fault_events = {
+        "stall": EVENTS.events("fault.stall"),
+        "truncate": EVENTS.events("fault.truncate"),
+        "flip": EVENTS.events("fault.flip"),
+    }
+    assert fault_events[scenario], \
+        f"predicted scenario {scenario!r} never fired (seed {seed})"
+    # ... and any shed names ONLY the faulty session
+    for ev in EVENTS.events("hub.shed"):
+        assert ev["fields"]["key"] == f"seed{seed}-s{faulty}"
+
+
+@pytest.mark.slow
+def test_sweep_soak_100_seeds():
+    for seed in range(20, 120):
+        hub = ReplicationHub(linger_s=0.002)
+        try:
+            results, stats, faulty = _run_hub_seed(seed, hub)
+        finally:
+            hub.close()
+        for i in range(N_SESSIONS):
+            if i == faulty:
+                continue
+            outcome, digs = results[i]
+            assert outcome == "done", f"seed {seed} session {i} {outcome}"
+            assert digs == _EXPECTED[i], f"seed {seed} session {i} diverged"
+
+
+# -- targeted isolation arms --------------------------------------------------
+
+
+def test_long_stall_does_not_stall_neighbors():
+    """A session stalled for seconds mid-wire: the 7 healthy sessions
+    must finish long before the stall ends — the cross-session-stall
+    exclusion measured, not assumed."""
+    hub = ReplicationHub(linger_s=0.002)
+    done_at: dict = {}
+    t0 = time.monotonic()
+
+    def healthy_run(i: int) -> None:
+        s = hub.register(f"h{i}")
+        try:
+            dec, digs = _fresh_hub_decoder(s)
+            for off in range(0, len(_WIRES[i]), 777):
+                dec.write(_WIRES[i][off:off + 777])
+            dec.end()
+            assert dec.finished and digs == _EXPECTED[i]
+            done_at[i] = time.monotonic() - t0
+        finally:
+            s.close()
+
+    def stalled_run() -> None:
+        s = hub.register("staller")
+        try:
+            dec, digs = _fresh_hub_decoder(s)
+            plan = FaultPlan(seed=1, stall_at=len(_WIRES[0]) // 2,
+                             stall_s=3.0)
+            reader = FaultyReader(bytes_reader(_WIRES[0]), plan)
+            while True:
+                data = reader.read(512)
+                if not data:
+                    break
+                dec.write(data)
+            dec.end()
+            assert dec.finished and digs == _EXPECTED[0]
+            done_at["staller"] = time.monotonic() - t0
+        finally:
+            s.close()
+
+    threads = [threading.Thread(target=stalled_run, daemon=True)]
+    threads += [threading.Thread(target=healthy_run, args=(i,), daemon=True)
+                for i in range(1, N_SESSIONS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(HARD_TIMEOUT)
+    assert all(not t.is_alive() for t in threads), "HANG"
+    hub.close()
+    healthy_times = [done_at[i] for i in range(1, N_SESSIONS)]
+    assert max(healthy_times) < 2.5, \
+        f"neighbors waited on the stalled session: {healthy_times}"
+    assert done_at["staller"] >= 3.0  # it really did stall
+
+
+def test_mid_blob_truncation_resumes_while_neighbors_run():
+    """Truncation INSIDE the faulty session's blob payload: the resume
+    layer reconnects it to a byte-exact finish; co-residents sharing
+    the engine stay byte-exact throughout."""
+    hub = ReplicationHub(linger_s=0.002)
+    results: dict = {}
+
+    def healthy_run(i: int) -> None:
+        s = hub.register(f"h{i}")
+        try:
+            dec, digs = _fresh_hub_decoder(s)
+            for off in range(0, len(_WIRES[i]), 513):
+                dec.write(_WIRES[i][off:off + 513])
+            dec.end()
+            results[i] = (dec.finished, digs)
+        finally:
+            s.close()
+
+    def truncated_run() -> None:
+        wire = _WIRES[0]
+        s = hub.register("trunc")
+        try:
+            dec, digs = _fresh_hub_decoder(s)
+            cut = int(len(wire) * 0.55)  # inside the 1.1 KiB blob
+
+            def source(ckpt, failures):
+                plan = FaultPlan(seed=3,
+                                 truncate_at=(cut - ckpt.wire_offset)
+                                 if failures == 0 else None)
+                return FaultyReader(
+                    bytes_reader(wire[ckpt.wire_offset:]), plan)
+
+            stats = run_resumable(
+                source, dec,
+                BackoffPolicy(base=0.0001, max_retries=2, seed=0),
+                expected_total=len(wire), stall_timeout=5)
+            results["trunc"] = (stats["reconnects"], digs)
+        finally:
+            s.close()
+
+    threads = [threading.Thread(target=truncated_run, daemon=True)]
+    threads += [threading.Thread(target=healthy_run, args=(i,), daemon=True)
+                for i in range(1, N_SESSIONS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(HARD_TIMEOUT)
+    assert all(not t.is_alive() for t in threads), "HANG"
+    hub.close()
+    reconnects, digs = results["trunc"]
+    assert reconnects == 1
+    assert digs == _EXPECTED[0]  # exactly-once digests across the resume
+    for i in range(1, N_SESSIONS):
+        finished, digs = results[i]
+        assert finished and digs == _EXPECTED[i]
+
+
+def test_byzantine_garbage_session_torn_down_alone(obs_enabled):
+    """A session speaking garbage (hostile length varint) dies with ONE
+    structured ProtocolError and releases its hub slot; co-residents
+    complete byte-exact and the hub admits a replacement."""
+    hub = ReplicationHub(max_sessions=N_SESSIONS, linger_s=0.002)
+    results: dict = {}
+
+    def healthy_run(i: int) -> None:
+        s = hub.register(f"h{i}")
+        try:
+            dec, digs = _fresh_hub_decoder(s)
+            for off in range(0, len(_WIRES[i]), 777):
+                dec.write(_WIRES[i][off:off + 777])
+            dec.end()
+            results[i] = (dec.finished, digs)
+        finally:
+            s.close()
+
+    def byzantine_run() -> None:
+        from dat_replication_protocol_tpu.session.decoder import (
+            DecoderDestroyedError,
+        )
+
+        s = hub.register("byz")
+        try:
+            dec, _digs = _fresh_hub_decoder(s)
+            errs: list = []
+            dec.on_error(errs.append)
+            try:
+                dec.write(b"\xff" * 64)
+                dec.end()
+            except (ProtocolError, DecoderDestroyedError):
+                pass  # the destroy cascade may surface either way
+            if errs and isinstance(errs[0], ProtocolError):
+                results["byz"] = ("error", errs[0])
+            else:
+                results["byz"] = ("no-error", errs)
+        finally:
+            s.close()
+
+    threads = [threading.Thread(target=byzantine_run, daemon=True)]
+    threads += [threading.Thread(target=healthy_run, args=(i,), daemon=True)
+                for i in range(1, N_SESSIONS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(HARD_TIMEOUT)
+    assert all(not t.is_alive() for t in threads), "HANG"
+    outcome, err = results["byz"]
+    assert outcome == "error" and err.offset is not None
+    for i in range(1, N_SESSIONS):
+        finished, digs = results[i]
+        assert finished and digs == _EXPECTED[i]
+    # the slot was released: a full-capacity hub admits a replacement
+    replacement = hub.register("fresh")
+    replacement.close()
+    hub.close()

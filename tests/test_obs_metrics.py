@@ -345,3 +345,77 @@ def test_registry_histogram_param_mismatch_raises():
         reg.histogram("h.par", buckets=(1.0, 3.0), ring=8)
     with pytest.raises(ValueError):
         reg.histogram("h.par", buckets=(1.0, 2.0), ring=16)
+
+
+# -- snapshot collectors (ISSUE 8: bounded per-entity telemetry) -------------
+
+
+def test_collector_entries_merge_into_snapshot():
+    reg = Registry()
+    reg.counter("plain.counter").inc(3)
+    reg.register_collector("owner", lambda: {
+        "counters": {"owner.item.count{session=a}": 7},
+        "gauges": {"owner.item.bytes{session=a}": 42.0},
+    })
+    snap = reg.snapshot()
+    assert snap["counters"]["plain.counter"] == 3
+    assert snap["counters"]["owner.item.count{session=a}"] == 7
+    assert snap["gauges"]["owner.item.bytes{session=a}"] == 42.0
+    # unregistering removes the contribution (bounded cardinality)
+    reg.unregister_collector("owner")
+    snap2 = reg.snapshot()
+    assert "owner.item.count{session=a}" not in snap2["counters"]
+
+
+def test_collector_failure_never_breaks_snapshot():
+    reg = Registry()
+    reg.counter("survives").inc()
+
+    def dying():
+        raise RuntimeError("collector mid-close")
+
+    reg.register_collector("dying", dying)
+    snap = reg.snapshot()  # must not raise
+    assert snap["counters"]["survives"] == 1
+
+
+def test_registry_reset_drops_collectors():
+    reg = Registry()
+    reg.register_collector("stale", lambda: {
+        "counters": {"stale.x{session=z}": 1}})
+    reg.reset()
+    assert "stale.x{session=z}" not in reg.snapshot()["counters"]
+
+
+def test_labeled_names_render_as_prom_label_sets():
+    snap = {"counters": {"hub.session.submitted{session=k1}": 5},
+            "gauges": {'hub.session.parked_bytes{session=we"ird}': 2.0},
+            "histograms": {}}
+    text = obs_metrics.to_prom_text(snap)
+    assert 'dat_hub_session_submitted{session="k1"} 5' in text
+    # label values are escaped, names sanitized
+    assert 'dat_hub_session_parked_bytes{session="we\\"ird"} 2.0' in text
+
+
+def test_prom_text_emits_one_type_line_per_labeled_metric():
+    # two label sets of one base name: exactly ONE '# TYPE' line — a
+    # duplicate makes the whole scrape invalid exposition
+    snap = {"counters": {"hub.session.submitted{session=a}": 5,
+                         "hub.session.submitted{session=b}": 7},
+            "gauges": {}, "histograms": {}}
+    text = obs_metrics.to_prom_text(snap)
+    assert text.count("# TYPE dat_hub_session_submitted counter") == 1
+    assert 'dat_hub_session_submitted{session="a"} 5' in text
+    assert 'dat_hub_session_submitted{session="b"} 7' in text
+
+
+def test_unregister_collector_is_owner_checked():
+    reg = Registry()
+    old = lambda: {"counters": {"x{session=old}": 1}}  # noqa: E731
+    new = lambda: {"counters": {"x{session=new}": 2}}  # noqa: E731
+    reg.register_collector("hub", old)
+    reg.register_collector("hub", new)  # restart: replaces old
+    reg.unregister_collector("hub", old)  # old owner closing LATE
+    assert "x{session=new}" in reg.snapshot()["counters"]
+    reg.unregister_collector("hub", new)
+    assert "x{session=new}" not in reg.snapshot()["counters"]

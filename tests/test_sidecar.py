@@ -512,3 +512,202 @@ def test_stdio_sidecar_flight_dir_and_trace_jsonl(tmp_path):
                for ln in open(trace_log).read().splitlines() if ln]
     frames = [r for r in records if r.get("span") == "decoder.frame"]
     assert frames and frames[0]["fields"]["offset"] == 0
+
+
+# -- hub mode (ISSUE 8): shared engine, per-session drain + stats ------------
+
+
+def test_hub_mode_drain_timeout_is_per_session():
+    """Satellite of ISSUE 8: in hub mode --drain-timeout applies PER
+    SESSION.  Session A stalls its reply and must be torn down at ~its
+    own deadline (not extended by B's liveness); session B uploads
+    slowly past A's teardown and must complete ok (not cut short by A's
+    deadline firing)."""
+    import time
+
+    from dat_replication_protocol_tpu.hub import ReplicationHub
+
+    hub = ReplicationHub(linger_s=0.002)
+    results = {}
+
+    def session_a():
+        fed = {"done": False}
+
+        def read_bytes(n):
+            if fed["done"]:
+                return b""
+            fed["done"] = True
+            return SESSION_1
+
+        released = threading.Event()
+        closed = threading.Event()
+
+        def write_bytes(data):
+            if closed.is_set():
+                raise OSError("EPIPE")
+            released.wait(30)  # never reads its reply
+            raise OSError("EPIPE")
+
+        def close_write():
+            closed.set()
+            released.set()
+
+        t0 = time.monotonic()
+        stats = sidecar.run_session(read_bytes, write_bytes,
+                                    close_write=close_write,
+                                    drain_timeout=1.0,
+                                    hub=hub, session_key="staller")
+        results["a"] = (stats, time.monotonic() - t0)
+
+    def session_b():
+        state = {"i": 0}
+        chunks = [SESSION_4[i:i + 8] for i in range(0, len(SESSION_4), 8)]
+
+        def read_bytes(n):
+            # a healthy-but-slow upload: ~2.5s total, well past A's
+            # 1s deadline — B's own clock must not be contaminated
+            if state["i"] >= len(chunks):
+                return b""
+            time.sleep(2.5 / len(chunks))
+            chunk = chunks[state["i"]]
+            state["i"] += 1
+            return chunk
+
+        reply = []
+        t0 = time.monotonic()
+        stats = sidecar.run_session(read_bytes, reply.append,
+                                    close_write=lambda: None,
+                                    drain_timeout=1.0,
+                                    hub=hub, session_key="slowpoke")
+        results["b"] = (stats, time.monotonic() - t0)
+
+    ta = threading.Thread(target=session_a, daemon=True)
+    tb = threading.Thread(target=session_b, daemon=True)
+    ta.start()
+    tb.start()
+    ta.join(20)
+    tb.join(20)
+    assert not ta.is_alive() and not tb.is_alive(), "HANG"
+    hub.close()
+    stats_a, elapsed_a = results["a"]
+    stats_b, elapsed_b = results["b"]
+    # A: torn down on ITS deadline — not extended while B kept running
+    assert stats_a["ok"] is False and stats_a["session"] == "staller"
+    assert elapsed_a < 2.4, f"A's teardown waited on B: {elapsed_a:.1f}s"
+    # B: completed past A's teardown — not cut short by A's deadline
+    assert stats_b["ok"] is True, f"B torn down by A's deadline: {stats_b}"
+    assert stats_b["session"] == "slowpoke"
+    assert stats_b["digests"] == 2
+    assert elapsed_b > 2.0
+
+
+def test_hub_mode_stats_fd_lines_carry_sessions_breakdown(obs_enabled):
+    """Satellite of ISSUE 8: --stats-fd snapshots in hub mode carry a
+    per-session `sessions` breakdown that cross-checks against the
+    hub's own per-session stats (the oracle contract)."""
+    import json
+    import os
+
+    from dat_replication_protocol_tpu.hub import ReplicationHub
+
+    hub = ReplicationHub(hash_batch=lambda ps: [
+        hashlib.blake2b(p, digest_size=32).digest() for p in ps])
+    sidecar.set_active_hub(hub)
+    try:
+        a = hub.register("peer-a")
+        b = hub.register("peer-b")
+        got = []
+        for i in range(9):
+            a.submit(b"payload-%d" % i, lambda d: got.append(d))
+        a.flush()
+        r, w = os.pipe()
+        emitter = sidecar.StatsEmitter(w, interval=60.0).start()
+        try:
+            emitter.kick()
+            line = b""
+            while not line.endswith(b"\n"):
+                line += os.read(r, 65536)
+            rec = json.loads(line.decode())
+        finally:
+            emitter.stop()
+            os.close(r)
+            os.close(w)
+        # the line's breakdown == the hub's live per-session stats
+        assert rec["hub"]["sessions"] == 2
+        per = rec["sessions"]
+        assert set(per) == {"peer-a", "peer-b"}
+        assert per["peer-a"]["submitted"] == 9
+        assert per["peer-a"]["delivered"] == 9
+        assert per["peer-b"]["submitted"] == 0
+        assert per["peer-a"] == hub.sessions_snapshot()["peer-a"]
+        # the registry snapshot in the SAME line carries the labeled
+        # per-session collector entries (hub.session.* family)
+        counters = rec["metrics"]["counters"]
+        assert counters["hub.session.submitted{session=peer-a}"] == 9
+        assert rec["metrics"]["gauges"]["hub.sessions"] == 2.0
+        a.close()
+        b.close()
+    finally:
+        sidecar.set_active_hub(None)
+        hub.close()
+
+
+def test_hub_mode_session_record_cross_checks_driver_stats(obs_enabled):
+    """The conformance-oracle arm: run_session's returned driver stats,
+    the sidecar.session event, and the hub's dispatch counters must all
+    tell the same story for a keyed hub session."""
+    from dat_replication_protocol_tpu.hub import ReplicationHub
+    from dat_replication_protocol_tpu.obs.events import EVENTS
+
+    hub = ReplicationHub(linger_s=0.002)
+    try:
+        fed = {"done": False}
+
+        def read_bytes(n):
+            if fed["done"]:
+                return b""
+            fed["done"] = True
+            return SESSION_4
+
+        reply = []
+        stats = sidecar.run_session(read_bytes, reply.append,
+                                    close_write=lambda: None,
+                                    hub=hub, session_key="oracle-k")
+        assert stats["ok"] is True
+        assert stats["session"] == "oracle-k" and stats["shed"] is None
+        assert stats["digests"] == 2  # blob-0 + change-0
+        ev = EVENTS.events("sidecar.session")[-1]["fields"]
+        assert ev["session"] == "oracle-k"
+        assert ev["digests"] == stats["digests"]
+        reg = obs_enabled.REGISTRY
+        assert reg.counter("hub.dispatch.items").value == stats["digests"]
+        assert reg.counter("hub.admitted").value == 1
+        # the slot was released at session end (bounded cardinality)
+        assert hub.sessions_snapshot() == {}
+    finally:
+        hub.close()
+
+
+def test_hub_mode_admission_rejection_is_structured(obs_enabled):
+    """A connection past the admission bound gets a structured
+    rejection record and EOF — no decoder, no queue growth."""
+    from dat_replication_protocol_tpu.hub import ReplicationHub
+    from dat_replication_protocol_tpu.obs.events import EVENTS
+
+    hub = ReplicationHub(max_sessions=1)
+    try:
+        held = hub.register("occupant")
+        closed = []
+        stats = sidecar.run_session(
+            lambda n: SESSION_1, lambda d: None,
+            close_write=lambda: closed.append(True),
+            hub=hub, session_key="refused")
+        assert stats == {"changes": 0, "blobs": 0, "bytes": 0,
+                         "digests": 0, "ok": False, "rejected": True,
+                         "sessions": 1, "parked_bytes": 0}
+        assert closed, "rejected connection was not closed"
+        rejects = EVENTS.events("hub.reject")
+        assert rejects and rejects[-1]["fields"]["key"] == "refused"
+        held.close()
+    finally:
+        hub.close()
