@@ -103,3 +103,41 @@ if [ ! -f "$OUT/.leg_fused_done" ]; then
   device_artifact "$OUT/fused_$STAMP.json" && touch "$OUT/.leg_fused_done"
   commit_out "r06 watch: fused single-pass device capture ($STAMP)"
 fi
+
+# 5) ISSUE 8 / ROADMAP item 1 device legs: hub_soak on a real device
+#    backend AND the mesh-sharded cross-session hash (the bench-side
+#    twin of sidecar --hub-mesh auto).  Config 3 rides along so the
+#    artifact records backend=tpu (configs 9/10 are host-group and do
+#    not probe the backend themselves); CPU-host hub numbers
+#    (~0.01 GiB/s, GIL-bound per-item path) say nothing about
+#    device-batch scaling — these two captures are the open question.
+if [ ! -f "$OUT/.leg_hub_done" ]; then
+  BENCH_CONFIGS=3,9 BENCH_DEADLINE=900 timeout 1000 \
+    python bench.py >"$OUT/hub_$STAMP.json" 2>"$OUT/hub_$STAMP.log"
+  tail -c 16384 "$OUT/hub_$STAMP.log" >"$OUT/hub_$STAMP.log.tail" \
+    && rm -f "$OUT/hub_$STAMP.log"
+  device_artifact "$OUT/hub_$STAMP.json" && touch "$OUT/.leg_hub_done"
+  commit_out "r06 watch: hub_soak device capture ($STAMP)"
+fi
+if [ ! -f "$OUT/.leg_hub_mesh_done" ]; then
+  BENCH_CONFIGS=3,9 BENCH_HUB_MESH=auto BENCH_DEADLINE=900 timeout 1000 \
+    python bench.py >"$OUT/hub_mesh_$STAMP.json" 2>"$OUT/hub_mesh_$STAMP.log"
+  tail -c 16384 "$OUT/hub_mesh_$STAMP.log" >"$OUT/hub_mesh_$STAMP.log.tail" \
+    && rm -f "$OUT/hub_mesh_$STAMP.log"
+  device_artifact "$OUT/hub_mesh_$STAMP.json" \
+    && touch "$OUT/.leg_hub_mesh_done"
+  commit_out "r06 watch: mesh-sharded cross-session hash capture ($STAMP)"
+fi
+
+# 6) ISSUE 9 fan-out device leg: the hash-once matrix with the source
+#    decode's digest work on the device engine (device.h2d.bytes /
+#    device.submit.bytes must stay constant as peers grow, same as the
+#    host counters do).  Config 3 rides along for the backend label.
+if [ ! -f "$OUT/.leg_fanout_done" ]; then
+  BENCH_CONFIGS=3,10 BENCH_DEADLINE=900 timeout 1000 \
+    python bench.py >"$OUT/fanout_$STAMP.json" 2>"$OUT/fanout_$STAMP.log"
+  tail -c 16384 "$OUT/fanout_$STAMP.log" >"$OUT/fanout_$STAMP.log.tail" \
+    && rm -f "$OUT/fanout_$STAMP.log"
+  device_artifact "$OUT/fanout_$STAMP.json" && touch "$OUT/.leg_fanout_done"
+  commit_out "r06 watch: fan-out hash-once device capture ($STAMP)"
+fi

@@ -21,6 +21,9 @@ The headline metric is config 3 (the 50 GiB/s north-star target);
   9 hub_soak      N concurrent sessions on ONE shared ReplicationHub:
                   aggregate GiB/s + per-session fairness (min/median
                   session throughput ratio; ISSUE 8)
+  10 fanout       one-to-many broadcast: peers x delivered-MiB/s matrix
+                  with hash-once counter proof + stalled-peer p99
+                  isolation (ISSUE 9)
 
 Robustness (round-1 failure was a backend-init crash that cost the round
 its only perf artifact): device-backend init is retried with backoff and
@@ -31,9 +34,11 @@ on every backend (<30 s on CPU).
 Env knobs: BENCH_ITEMS / BENCH_ITEM_MIB / BENCH_CHUNK (config 3),
 BENCH_REPLAY_ROWS, BENCH_CDC_MIB / BENCH_CDC_REPS, BENCH_MERKLE_LOG2,
 BENCH_ROUNDTRIPS, BENCH_RESUME_ROWS / BENCH_RESUME_REPS (config 6),
-BENCH_CONFIGS (comma list, default "1,2,3,4,5,6,7,8,9"),
+BENCH_CONFIGS (comma list, default "1,2,3,4,5,6,7,8,9,10"),
 BENCH_FUSED_MIB / BENCH_FUSED_REPS / BENCH_FUSED_DEVICE (config 8),
-BENCH_HUB_SESSIONS / BENCH_HUB_ROWS / BENCH_HUB_BLOB_KIB (config 9).
+BENCH_HUB_SESSIONS / BENCH_HUB_ROWS / BENCH_HUB_BLOB_KIB /
+BENCH_HUB_MESH (config 9), BENCH_FANOUT_ROWS / BENCH_FANOUT_BLOB_KIB /
+BENCH_FANOUT_PEERS / BENCH_FANOUT_STALL_S (config 10).
 """
 
 from __future__ import annotations
@@ -1551,6 +1556,13 @@ def bench_hub_soak(quick: bool, backend: str) -> dict:
     sessions = _env_int("BENCH_HUB_SESSIONS", 8 if quick else 16)
     rows = _env_int("BENCH_HUB_ROWS", 2_048 if quick else 16_384)
     blob_kib = _env_int("BENCH_HUB_BLOB_KIB", 256 if quick else 2_048)
+    # BENCH_HUB_MESH=auto|N (ROADMAP item 1 device leg): shard the
+    # cross-session hash batch over the device mesh — the
+    # `--hub-mesh auto` capture _when_tpu_returns.sh arms; on a host
+    # backend the factory falls back to the single-engine path
+    mesh = os.environ.get("BENCH_HUB_MESH") or None
+    if mesh is not None and mesh != "auto":
+        mesh = int(mesh)
 
     # per-session wires built untimed: a bulk change run (the native
     # bulk decode path) plus one blob, distinct keys per session
@@ -1575,7 +1587,7 @@ def bench_hub_soak(quick: bool, backend: str) -> dict:
         wires.append(b"".join(parts))
     total_bytes = sum(len(w) for w in wires)
 
-    hub = ReplicationHub(linger_s=0.002, window_items=1 << 16,
+    hub = ReplicationHub(mesh=mesh, linger_s=0.002, window_items=1 << 16,
                          window_bytes=64 << 20, parked_budget=1 << 30,
                          max_sessions=sessions + 1)
     done = [None] * sessions
@@ -1632,9 +1644,198 @@ def bench_hub_soak(quick: bool, backend: str) -> dict:
         "fairness_min_median": round(fairness, 3),
         "session_gib_s_min": round(ordered[0] / (1 << 30), 4),
         "session_gib_s_median": round(median / (1 << 30), 4),
+        "mesh": mesh,
         "reduced_config": sessions < 16 or rows < 16_384,
         "full_config": "16 sessions x (16384 rows + 2 MiB blob) on one "
                        "shared hub",
+    }
+
+
+def bench_fanout(quick: bool, backend: str) -> dict:
+    """Config 10 (ISSUE 9): one-to-many fan-out — hash once, serve N.
+
+    One wire session is decoded (digested) EXACTLY ONCE while N
+    downstream peers receive its bytes through the BroadcastLog /
+    FanoutServer windowed scatter-gather path.  Three proofs in one
+    artifact:
+
+    * **peers x MiB/s matrix** — aggregate delivered throughput must
+      SCALE with peer count (per-peer marginal cost is a windowed
+      writev of already-framed bytes, not a re-hash + re-copy);
+    * **hash-once** — the digest-work byte counters
+      (device.native.hash.bytes / device.submit.bytes / device.h2d.
+      bytes) stay CONSTANT as peers grow (``hash_ratio`` ~ 1.0);
+    * **stall isolation** — one peer stalled for ``stall_s`` seconds
+      mid-wire leaves the other peers' p99 append->delivery frame
+      latency flat (``stalled_arm_p99_ms``), budget-gated.
+
+    Peers are accounting-only sinks (accept-everything, zero copies) —
+    the library-level fan-out capacity; the fd/writev kernel path is
+    exercised by the unit/chaos suites and the sidecar.
+    """
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+
+    rows = _env_int("BENCH_FANOUT_ROWS", 2_048 if quick else 16_384)
+    blob_kib = _env_int("BENCH_FANOUT_BLOB_KIB", 256 if quick else 2_048)
+    peer_counts = [
+        int(x) for x in os.environ.get(
+            "BENCH_FANOUT_PEERS",
+            "1,8,32" if quick else "1,8,64,256").split(",") if x.strip()
+    ]
+    stall_s = float(os.environ.get("BENCH_FANOUT_STALL_S",
+                                   "0.5" if quick else "3.0"))
+
+    # the source wire, built untimed
+    e = protocol.encode()
+    e.change_many([
+        {"key": f"f-{j:06d}", "change": j, "from": j, "to": j + 1,
+         "value": b"v" * 64}
+        for j in range(rows)
+    ])
+    b = e.blob(blob_kib << 10)
+    b.write(bytes(blob_kib << 10))
+    b.end()
+    e.finalize()
+    parts = []
+    while True:
+        d = e.read(1 << 20)
+        if d is None:
+            break
+        parts.append(d)
+    wire = b"".join(parts)
+    step = 1 << 18
+
+    # the hash-once proof reads obs counters: enable telemetry for this
+    # config (conftest-style save/restore; the overhead rides both
+    # sides of the matrix equally)
+    _DIGEST_COUNTERS = ("device.native.hash.bytes", "device.submit.bytes",
+                        "device.h2d.bytes")
+
+    def _digest_work() -> int:
+        snap = obs_metrics.snapshot()["counters"]
+        return sum(int(snap.get(k, 0)) for k in _DIGEST_COUNTERS)
+
+    def _count_sink():
+        # accounting-only consumer: accepts every view, copies nothing
+        return lambda views: sum(len(v) for v in views)
+
+    was_on = obs_metrics.OBS.on
+    obs_metrics.enable()
+    try:
+        matrix: dict = {}
+        p99_by_n: dict = {}
+        hash_by_n: dict = {}
+        for n in peer_counts:
+            srv = FanoutServer(retention_budget=len(wire) + (1 << 20),
+                               stall_timeout=60.0)
+            try:
+                peers = [srv.attach_peer(f"p{i}", sink=_count_sink())
+                         for i in range(n)]
+                dec = protocol.decode(backend="tpu")
+                ndig = {"d": 0}
+                dec.on_digest(
+                    lambda kind, seq, d: ndig.__setitem__("d",
+                                                          ndig["d"] + 1))
+                h0 = _digest_work()
+                t0 = time.perf_counter()
+                for off in range(0, len(wire), step):
+                    chunk = wire[off:off + step]
+                    srv.publish(chunk)   # fan-out: bytes only
+                    dec.write(chunk)     # digest work: exactly once
+                dec.end()
+                srv.seal()
+                assert srv.drain(120), "fan-out drain hung"
+                wall = time.perf_counter() - t0
+                hash_by_n[str(n)] = _digest_work() - h0
+                assert dec.finished and ndig["d"] == rows + 1
+                stats = [p.stats() for p in peers]
+                assert all(st["done"] and st["sent_bytes"] == len(wire)
+                           for st in stats)
+                matrix[str(n)] = round(
+                    n * len(wire) / wall / (1 << 20), 1)
+                p99s = [st["lat_p99_ms"] for st in stats
+                        if st["lat_p99_ms"] is not None]
+                p99_by_n[str(n)] = max(p99s) if p99s else None
+            finally:
+                srv.close()
+            log(f"bench[fanout]: {n} peers — {matrix[str(n)]} MiB/s "
+                f"aggregate, p99 {p99_by_n[str(n)]} ms, digest-work "
+                f"{hash_by_n[str(n)]} bytes")
+
+        hash_vals = [v for v in hash_by_n.values() if v > 0]
+        hash_ratio = (round(max(hash_vals) / min(hash_vals), 4)
+                      if hash_vals else None)
+
+        # stalled-peer arm: one of 8 peers stops accepting for stall_s
+        # seconds at the half-way byte (below the shed timeout — it
+        # lags, bounded by its window, and must not move the others'
+        # p99)
+        n_stall = 8
+        srv = FanoutServer(retention_budget=len(wire) + (1 << 20),
+                           stall_timeout=max(60.0, stall_s * 4))
+        try:
+            gate = {"t": None}
+            stalled_got = {"n": 0}
+
+            def stall_sink(views):
+                if gate["t"] is None:
+                    gate["t"] = time.perf_counter() + stall_s
+                if time.perf_counter() < gate["t"]:
+                    budget = len(wire) // 2 - stalled_got["n"]
+                    if budget <= 0:
+                        return 0
+                else:
+                    budget = 1 << 60
+                take = 0
+                for v in views:
+                    take += min(len(v), budget - take)
+                    if take >= budget:
+                        break
+                stalled_got["n"] += take
+                return take
+
+            staller = srv.attach_peer("staller", sink=stall_sink)
+            healthy = [srv.attach_peer(f"h{i}", sink=_count_sink())
+                       for i in range(n_stall - 1)]
+            for off in range(0, len(wire), step):
+                srv.publish(wire[off:off + step])
+            srv.seal()
+            assert srv.drain(120 + stall_s), "stalled arm drain hung"
+            h_stats = [p.stats() for p in healthy]
+            assert all(st["done"] and st["sent_bytes"] == len(wire)
+                       for st in h_stats)
+            st_stall = staller.stats()
+            assert st_stall["done"] and st_stall["shed"] is None
+            stalled_p99 = max(st["lat_p99_ms"] for st in h_stats
+                              if st["lat_p99_ms"] is not None)
+        finally:
+            srv.close()
+        log(f"bench[fanout]: stalled arm ({stall_s}s) — healthy p99 "
+            f"{stalled_p99} ms")
+    finally:
+        obs_metrics.OBS.on = was_on
+
+    top = str(max(peer_counts))
+    return {
+        "metric": "fanout_aggregate_delivered_throughput",
+        "value": matrix[top],
+        "unit": "MiB/s",
+        "vs_baseline": None,
+        "peers": int(top),
+        "wire_mib": round(len(wire) / (1 << 20), 2),
+        "rows": rows,
+        "blob_kib": blob_kib,
+        "peers_mib_s": matrix,
+        "p99_ms": p99_by_n,
+        "digest_work_bytes": hash_by_n,
+        "hash_ratio": hash_ratio,
+        "stall_s": stall_s,
+        "stalled_arm_p99_ms": stalled_p99,
+        "reduced_config": rows < 16_384 or int(top) < 256,
+        "full_config": "1/8/64/256 peers x (16384 rows + 2 MiB blob), "
+                       "3 s stalled-peer arm",
     }
 
 
@@ -1651,6 +1852,7 @@ BENCHES = {
     "7": ("wire_batch", bench_wire_batch),
     "8": ("fused_e2e", bench_fused_e2e),
     "9": ("hub_soak", bench_hub_soak),
+    "10": ("fanout", bench_fanout),
 }
 
 
@@ -1791,7 +1993,8 @@ def main() -> None:
         obs_flight.arm(flight_dir)
     which = [
         k.strip()
-        for k in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9").split(",")
+        for k in os.environ.get(
+            "BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10").split(",")
         if k.strip() in BENCHES
     ]
 
@@ -1834,7 +2037,7 @@ def main() -> None:
     # (config 8's opt-in device leg initializes jax itself — it is for
     # the TPU watch script, which only fires when the tunnel answers)
     for key in which:
-        if key in ("1", "2", "6", "7", "8", "9"):
+        if key in ("1", "2", "6", "7", "8", "9", "10"):
             run_config(key, "host")
 
     # priority order for the device leg: the headline hash config first,
@@ -1842,7 +2045,8 @@ def main() -> None:
     # that appears late in the budget must still yield config 3
     priority = {"3": 0, "5": 1, "4": 2}
     device_keys = sorted(
-        (k for k in which if k not in ("1", "2", "6", "7", "8", "9")),
+        (k for k in which
+         if k not in ("1", "2", "6", "7", "8", "9", "10")),
         key=lambda k: priority.get(k, 9)
     )
     if device_keys:

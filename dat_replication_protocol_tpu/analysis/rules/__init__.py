@@ -11,6 +11,7 @@ from __future__ import annotations
 from .bounded_wait import BoundedWait
 from .cursor_coherence import CursorCoherence
 from .env_cache import EnvCachePolicy
+from .fanout_hot_path import FanoutHotPath
 from .hub_isolation import HubIsolation
 from .jit_purity import JitPurity
 from .obs_discipline import ObsDiscipline
@@ -26,6 +27,7 @@ ALL_RULES = (
     WireConstantParity(),
     ObsDiscipline(),
     HubIsolation(),
+    FanoutHotPath(),
 )
 
 

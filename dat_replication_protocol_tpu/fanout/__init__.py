@@ -1,0 +1,24 @@
+"""Broadcast replication: one shared log, many independent cursors.
+
+One encoder's wire journal becomes an offset-addressed
+:class:`BroadcastLog` that thousands of downstream peers stream from at
+independent offsets — merkle/hash work done ONCE (wherever the source
+session decodes), frames fanned out by a zero-copy scatter-gather
+:class:`FanoutServer` with per-peer flow-control windows and the
+three-stage overload contract (admission → window stall →
+heaviest-offender shed).  See DESIGN.md §fan-out and ROBUSTNESS.md
+peer-shed contract.
+"""
+
+from .log import BroadcastCursor, BroadcastLog, SnapshotNeeded
+from .server import FanoutBusy, FanoutPeer, FanoutServer, PeerShed
+
+__all__ = [
+    "BroadcastLog",
+    "BroadcastCursor",
+    "SnapshotNeeded",
+    "FanoutServer",
+    "FanoutPeer",
+    "FanoutBusy",
+    "PeerShed",
+]

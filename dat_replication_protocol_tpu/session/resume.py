@@ -101,6 +101,11 @@ class WireJournal:
     def __init__(self):
         self._buf = bytearray()
         self._start = 0  # wire offset of _buf[0]
+        # multi-reader acks (the fan-out precursor): with readers
+        # attached, ack() trims only past the MINIMUM acked offset
+        # across them — the single-reader assumption the original trim
+        # baked in silently dropped a second reader's unread window
+        self._readers: dict[str, int] = {}
 
     @property
     def start(self) -> int:
@@ -126,13 +131,65 @@ class WireJournal:
             raise ValueError("seek on a non-empty journal")
         self._start = offset
 
-    def ack(self, offset: int) -> None:
-        """The receiver confirmed bytes below ``offset``: trim them."""
-        if offset <= self._start:
-            return
+    def attach_reader(self, key: str, offset: int | None = None) -> str:
+        """Register a named reader cursor at ``offset`` (default: the
+        journal's retained start).  With any readers attached,
+        :meth:`ack` becomes min-offset-aware: bytes trim only once
+        EVERY reader has acked past them — the multi-reader contract
+        the broadcast log builds on.
+
+        Attaching below the retained window raises a structured
+        :class:`ResumeError` naming the retained range — never a
+        silent short read from the wrong place."""
+        off = self._start if offset is None else int(offset)
+        if off < self._start:
+            if _OBS.on:
+                _emit("journal.replay_miss", offset=off,
+                      start=self._start)
+            raise ResumeError(
+                f"reader {key!r} asked for byte {off} below the "
+                f"retained range [{self._start}, {self.end})",
+                offset=off,
+            )
+        if off > self.end:
+            raise ResumeError(
+                f"reader {key!r} asked for byte {off} ahead of "
+                f"everything produced (retained range "
+                f"[{self._start}, {self.end}))",
+                offset=off,
+            )
+        if key in self._readers:
+            raise ValueError(f"reader {key!r} already attached")
+        self._readers[key] = off
+        return key
+
+    def detach_reader(self, key: str) -> None:
+        """Remove a reader cursor; its ack stops constraining the trim
+        (re-ack with the remaining floor to release its window)."""
+        self._readers.pop(key, None)
+
+    def ack(self, offset: int, reader: str | None = None) -> None:
+        """The receiver confirmed bytes below ``offset``: trim them.
+
+        With reader cursors attached (:meth:`attach_reader`) the trim
+        is min-offset-aware: a per-reader ack records that reader's
+        progress and the journal trims only past the minimum across
+        ALL readers; a bare ``ack(offset)`` is likewise floored by the
+        slowest reader instead of silently dropping its window."""
+        # an ack beyond production is a caller bug on EVERY path — the
+        # reader-floor below must not silently mask it
         if offset > self.end:
             raise ValueError(
                 f"ack({offset}) beyond journal end {self.end}")
+        if reader is not None:
+            if reader not in self._readers:
+                raise ValueError(f"unknown reader {reader!r}")
+            self._readers[reader] = max(self._readers[reader], offset)
+            offset = min(self._readers.values())
+        elif self._readers:
+            offset = min([offset, *self._readers.values()])
+        if offset <= self._start:
+            return
         if _OBS.on:
             _M_J_ACKED.inc(offset - self._start)
         del self._buf[: offset - self._start]
@@ -147,7 +204,8 @@ class WireJournal:
                       start=self._start)
             raise ResumeError(
                 "checkpoint predates the journal's retained window "
-                f"(asked for byte {offset}, journal starts at {self._start})",
+                f"(asked for byte {offset}, retained range "
+                f"[{self._start}, {self.end}))",
                 offset=offset,
             )
         if offset > self.end:

@@ -67,12 +67,23 @@ _M_STALLS = _counter("sidecar.stalls")
 # --stats-fd lines attribute traffic per peer
 _ACTIVE_HUB = None
 
+# fan-out mode (ISSUE 9): ONE shared FanoutServer broadcasting the
+# source session's wire to every subscriber connection
+_ACTIVE_FANOUT = None
+
 
 def set_active_hub(hub) -> None:
     """Install the hub whose per-session breakdown ``--stats-fd``
     snapshots carry (None detaches)."""
     global _ACTIVE_HUB
     _ACTIVE_HUB = hub
+
+
+def set_active_fanout(server) -> None:
+    """Install the fan-out server whose per-peer breakdown
+    ``--stats-fd`` snapshots carry (None detaches)."""
+    global _ACTIVE_FANOUT
+    _ACTIVE_FANOUT = server
 
 
 def run_session(read_bytes, write_bytes, close_write=None,
@@ -284,6 +295,108 @@ def run_session(read_bytes, write_bytes, close_write=None,
     return out
 
 
+def run_subscriber(conn: socket.socket, fanout, key: str) -> dict:
+    """Serve one fan-out subscriber connection (ISSUE 9): attach the
+    socket as a downstream peer of the shared :class:`BroadcastLog` and
+    stream the broadcast until the sealed log is fully delivered or the
+    peer is shed.  The subscriber never decodes and never hashes — the
+    digest work happened ONCE on the source session.
+
+    A joiner asking below the retained window gets a structured
+    ``{"snapshot_needed": true, "retained": [start, end]}`` record and
+    EOF; admission rejection gets ``{"rejected": true}`` — bounded
+    state, never queue growth (the hub's contract, restated for peers).
+    A subscriber that SENDS data is a misrouted source (it raced a
+    connection holding the source claim): it gets a structured
+    ``{"not_source": true}`` record and EOF instead of having its
+    uploaded session silently discarded.
+    """
+    from .fanout import FanoutBusy, SnapshotNeeded
+
+    try:
+        # a wire subscriber needs the stream FROM BYTE 0 to parse it;
+        # once the log trimmed past 0 only a snapshot can help
+        peer = fanout.attach_peer(key, fd=conn.fileno(), offset=0)
+    except SnapshotNeeded as e:
+        out = {"fanout_peer": key, "ok": False, "snapshot_needed": True,
+               "retained": list(e.retained)}
+        try:
+            conn.sendall((json.dumps(out) + "\n").encode())
+            conn.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        if _OBS.on:
+            _emit("sidecar.session", **out)
+        return out
+    except FanoutBusy as e:
+        out = {"fanout_peer": key, "ok": False, "rejected": True,
+               "peers": e.peers, "max_peers": e.max_peers}
+        try:
+            # the structured record IS the rejection: a bare EOF would
+            # be indistinguishable from an empty sealed broadcast
+            conn.sendall((json.dumps(out) + "\n").encode())
+            conn.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        if _OBS.on:
+            _emit("sidecar.session", **out)
+        return out
+    try:
+        # bounded waits interleaved with an EOF probe on the (non-
+        # blocking) socket: a subscriber that disconnects while the
+        # broadcast is idle would otherwise never surface an EPIPE —
+        # no bytes are in flight to it — and its peer slot plus this
+        # thread would leak until new bytes happened to flow
+        done = False
+        not_source = False
+        while True:
+            if peer.wait_done(timeout=0.5):
+                done = True
+                break
+            if peer.shed_reason is not None:
+                break
+            try:
+                probe = conn.recv(4096)
+            except (BlockingIOError, InterruptedError):
+                continue  # still connected, nothing sent (the normal)
+            except OSError:
+                break
+            if probe == b"":
+                break  # client went away: release the slot
+            # a subscriber has nothing to say — inbound bytes mean a
+            # SOURCE got routed here (it raced a connection holding
+            # the source claim).  Fail LOUDLY with a structured record
+            # instead of silently discarding its uploaded session.
+            not_source = True
+            break
+        stats = peer.stats()
+    finally:
+        peer.close()
+    if not_source:
+        out = {"fanout_peer": key, "ok": False, "not_source": True,
+               "detail": "subscriber connections must not send data; "
+                         "the broadcast source slot was already claimed "
+                         "— reconnect to retry as source"}
+        try:
+            conn.sendall((json.dumps(out) + "\n").encode())
+            conn.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        if _OBS.on:
+            _emit("sidecar.session", **out)
+        return out
+    try:
+        conn.shutdown(socket.SHUT_WR)  # subscriber observes clean EOF
+    except OSError:
+        pass
+    out = {"fanout_peer": key, "sent_bytes": stats["sent_bytes"],
+           "shed": stats["shed"], "ok": done and stats["shed"] is None}
+    if _OBS.on:
+        _M_SESSIONS.inc()
+        _emit("sidecar.session", **out)
+    return out
+
+
 def serve_stdio(drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
     """One session over stdin/stdout (logs go to stderr only)."""
     # close_write can fire from the session thread (drain-timeout
@@ -328,7 +441,7 @@ def serve_tcp(host: str, port: int,
               max_sessions: int | None = None,
               ready_cb=None,
               drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT,
-              retry_policy=None, hub=None) -> None:
+              retry_policy=None, hub=None, fanout=None) -> None:
     """Accept loop: one concurrent session per connection.
 
     ``max_sessions`` bounds the loop for tests; ``ready_cb(port)`` fires
@@ -338,6 +451,19 @@ def serve_tcp(host: str, port: int,
     accepted session registers with — one device pipeline multiplexed
     across all concurrent connections, admission-controlled, with
     per-session keys ``c<n>:<peer>`` in the stats breakdown.
+
+    ``fanout`` (ISSUE 9): a shared :class:`~.fanout.FanoutServer`.  The
+    first connection to CLAIM the source slot is the broadcast
+    *source*: it is served like any normal session (decoded once —
+    with ``hub`` set its digest work rides the shared engine — and its
+    digest reply streamed back), while every wire byte it sends is
+    also published into the shared :class:`~.fanout.BroadcastLog`.  A
+    claimant that closes without publishing a byte (healthcheck, port
+    scan) RELEASES the claim — the next connection can be the source.
+    Every other connection is a subscriber: it receives the source's
+    raw wire bytes via the zero-copy windowed ``writev`` fan-out path,
+    keyed ``p<n>:<peer>`` in the stats breakdown.  Digest/hash cost is
+    O(1) in subscribers.
 
     ``retry_policy`` (a :class:`~.session.reconnect.BackoffPolicy`, CLI
     flags ``--max-retries`` / ``--backoff-base``) governs the daemon's
@@ -364,6 +490,13 @@ def serve_tcp(host: str, port: int,
 
     srv = retrying(_bind, policy, retry_on=(OSError,),
                    describe=f"bind {host}:{port}")
+    # fan-out source election: the source slot is CLAIMED, not simply
+    # "connection #1" — a stray first connection that closes without
+    # publishing a byte (load-balancer healthcheck, port scan) releases
+    # the claim instead of sealing an empty log and bricking the
+    # broadcast for the daemon's lifetime
+    src_claim = {"taken": False}
+    src_lock = threading.Lock()
     bound = srv.getsockname()[1]
     print(f"sidecar: listening on {host}:{bound}",
           file=sys.stderr, flush=True)
@@ -382,14 +515,54 @@ def serve_tcp(host: str, port: int,
 
             def _one(conn=conn, peer=peer, n=served):
                 try:
-                    stats = run_session(
-                        read_bytes=conn.recv,
-                        write_bytes=conn.sendall,
-                        close_write=lambda: conn.shutdown(socket.SHUT_WR),
-                        drain_timeout=drain_timeout,
-                        hub=hub,
-                        session_key=f"c{n}:{peer[0]}:{peer[1]}",
-                    )
+                    is_source = False
+                    if fanout is not None and not fanout.log.sealed:
+                        with src_lock:
+                            if not src_claim["taken"]:
+                                src_claim["taken"] = True
+                                is_source = True
+                    if fanout is not None and not is_source:
+                        stats = run_subscriber(
+                            conn, fanout, key=f"p{n}:{peer[0]}:{peer[1]}")
+                    elif fanout is not None:
+                        # the source session: every wire byte it sends
+                        # is published into the broadcast log as it is
+                        # consumed; EOF (or teardown) seals the log so
+                        # subscribers complete
+                        def _read_published(nbytes: int) -> bytes:
+                            data = conn.recv(nbytes)
+                            if data:
+                                fanout.publish(data)
+                            return data
+
+                        try:
+                            stats = run_session(
+                                read_bytes=_read_published,
+                                write_bytes=conn.sendall,
+                                close_write=lambda: conn.shutdown(
+                                    socket.SHUT_WR),
+                                drain_timeout=drain_timeout,
+                                hub=hub,
+                                session_key=f"c{n}:{peer[0]}:{peer[1]}",
+                            )
+                        finally:
+                            if fanout.log.end > fanout.log.start:
+                                fanout.seal()
+                            else:
+                                # nothing published: a probe connection,
+                                # not the feed — give the slot back
+                                with src_lock:
+                                    src_claim["taken"] = False
+                    else:
+                        stats = run_session(
+                            read_bytes=conn.recv,
+                            write_bytes=conn.sendall,
+                            close_write=lambda: conn.shutdown(
+                                socket.SHUT_WR),
+                            drain_timeout=drain_timeout,
+                            hub=hub,
+                            session_key=f"c{n}:{peer[0]}:{peer[1]}",
+                        )
                     print(f"sidecar: {peer} {stats}", file=sys.stderr,
                           flush=True)
                 finally:
@@ -513,6 +686,9 @@ def snapshot_stats() -> dict:
     if _ACTIVE_HUB is not None:
         out["hub"] = _ACTIVE_HUB.snapshot()
         out["sessions"] = _ACTIVE_HUB.sessions_snapshot()
+    if _ACTIVE_FANOUT is not None:
+        out["fanout"] = _ACTIVE_FANOUT.snapshot()
+        out["peers"] = _ACTIVE_FANOUT.peers_snapshot()
     return out
 
 
@@ -585,6 +761,30 @@ def main(argv=None) -> int:
                         "the device mesh: 'auto' uses every local "
                         "device, an integer pins the count (default: "
                         "single-device engine)")
+    p.add_argument("--fanout", action="store_true",
+                   help="broadcast mode (--tcp only): the FIRST "
+                        "connection is the source session (decoded and "
+                        "digested ONCE); every later connection is a "
+                        "subscriber streamed the source's wire bytes "
+                        "via the zero-copy windowed writev fan-out "
+                        "(see DESIGN.md fan-out, ROBUSTNESS.md "
+                        "peer-shed contract)")
+    p.add_argument("--fanout-retention", type=int, default=64 << 20,
+                   metavar="BYTES",
+                   help="broadcast-log retention budget: how much wire "
+                        "history stays servable for late joiners and "
+                        "laggards; a peer trimmed past gets a "
+                        "structured snapshot-needed record "
+                        "(default: 64 MiB)")
+    p.add_argument("--fanout-window", type=int, default=1 << 20,
+                   metavar="BYTES",
+                   help="per-peer fan-out flow-control window (bytes "
+                        "in flight; sized for lossy high-latency "
+                        "links; default: 1 MiB)")
+    p.add_argument("--fanout-stall-timeout", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="shed a fan-out peer making no delivery "
+                        "progress for this long (default: 30)")
     p.add_argument("--max-retries", type=int, default=5, metavar="N",
                    help="transient-failure budget: bind/accept errors are "
                         "retried with backoff at most N times before the "
@@ -655,15 +855,30 @@ def main(argv=None) -> int:
                              max_sessions=args.hub_max_sessions,
                              parked_budget=args.hub_parked_budget)
         set_active_hub(hub)
+    fanout = None
+    if args.fanout:
+        if args.stdio:
+            p.error("--fanout broadcasts to many connections; it needs "
+                    "--tcp")
+        from .fanout import FanoutServer
+
+        fanout = FanoutServer(
+            retention_budget=args.fanout_retention,
+            window_bytes=args.fanout_window,
+            stall_timeout=args.fanout_stall_timeout)
+        set_active_fanout(fanout)
     try:
         if args.stdio:
             stats = serve_stdio(drain_timeout=drain)
             return 0 if stats["ok"] else 1
         host, _, port = args.tcp.rpartition(":")
         serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain,
-                  retry_policy=policy, hub=hub)
+                  retry_policy=policy, hub=hub, fanout=fanout)
         return 0
     finally:
+        if fanout is not None:
+            set_active_fanout(None)
+            fanout.close()
         if hub is not None:
             set_active_hub(None)
             hub.close()

@@ -824,3 +824,100 @@ def test_hub_isolation_suppression(tmp_path):
                     self._pipeline.flush()
     ''')
     assert "hub-isolation" not in _rules_fired(findings)
+
+
+# -- fanout-hot-path (ISSUE 9: the O(1)-writer broadcast contract) ----------
+
+# the regression shape: a "small" per-peer notification loop (and a
+# per-peer copy) inside publish — every produced byte back to O(peers)
+FANOUT_WRITER_BAD = '''
+class Server:
+    def publish(self, data):
+        self.log.append(data)
+        for peer in self._peers.values():
+            peer.pending += bytes(data)
+            peer.notify()
+'''
+
+# the shipped shape: append/publish do O(1) bookkeeping; the dispatcher
+# owns per-peer iteration
+FANOUT_WRITER_GOOD = '''
+class Server:
+    def publish(self, data):
+        self.log.append(data)
+        self._marks.append((self.log.end, self.now()))
+
+    def _dispatch_turn(self):
+        for peer in self._peers.values():
+            self.serve(peer)
+'''
+
+
+def _lint_fanout(tmp_path, name, source):
+    fdir = tmp_path / "fanout"
+    fdir.mkdir(exist_ok=True)
+    (fdir / name).write_text(textwrap.dedent(source))
+    return run_paths([tmp_path])
+
+
+def test_fanout_hot_path_fires_on_per_peer_loop_in_publish(tmp_path):
+    findings = _lint_fanout(tmp_path, "loop.py", FANOUT_WRITER_BAD)
+    hits = [f for f in findings if f.rule == "fanout-hot-path"]
+    # the loop itself, plus the peer-state reaches inside it
+    assert hits and any("O(1) in peers" in f.message for f in hits)
+
+
+def test_fanout_hot_path_clean_on_o1_writer(tmp_path):
+    findings = _lint_fanout(tmp_path, "clean.py", FANOUT_WRITER_GOOD)
+    assert "fanout-hot-path" not in _rules_fired(findings)
+
+
+def test_fanout_hot_path_fires_on_peer_state_reach_without_loop(tmp_path):
+    findings = _lint_fanout(tmp_path, "reach.py", '''
+        class Log:
+            def append(self, data):
+                self._buf += data
+                self._cursors["head"].wake()
+    ''')
+    hits = [f for f in findings if f.rule == "fanout-hot-path"]
+    assert len(hits) == 1
+    assert "per-peer state" in hits[0].message
+
+
+def test_fanout_hot_path_fires_on_comprehension_allocation(tmp_path):
+    findings = _lint_fanout(tmp_path, "comp.py", '''
+        class Server:
+            def publish(self, data):
+                self.slabs = [bytes(data) for _ in range(2)]
+    ''')
+    hits = [f for f in findings if f.rule == "fanout-hot-path"]
+    assert hits and "loop" in hits[0].message
+
+
+def test_fanout_hot_path_scoped_to_fanout_directories(tmp_path):
+    # the same shapes OUTSIDE fanout/ are other modules' business
+    findings = _lint(tmp_path, ("elsewhere.py", FANOUT_WRITER_BAD))
+    assert "fanout-hot-path" not in _rules_fired(findings)
+
+
+def test_fanout_hot_path_ignores_non_writer_functions(tmp_path):
+    findings = _lint_fanout(tmp_path, "dispatcher.py", '''
+        class Server:
+            def _dispatch_turn(self):
+                for key in list(self._peers):
+                    self._serve(self._peer_state(key))
+    ''')
+    assert "fanout-hot-path" not in _rules_fired(findings)
+
+
+def test_fanout_hot_path_suppression(tmp_path):
+    findings = _lint_fanout(tmp_path, "sup.py", '''
+        class Server:
+            def publish(self, data):
+                self.log.append(data)
+                # one-shot attach barrier, measured O(1) amortized
+                # datlint: disable=fanout-hot-path
+                for peer in self._warm_peers:
+                    peer.prime()
+    ''')
+    assert "fanout-hot-path" not in _rules_fired(findings)

@@ -1,0 +1,504 @@
+"""Unit layer for the broadcast fan-out (ISSUE 9): the multi-reader
+log's retention/cursor contract, the zero-copy scatter-gather read
+path, the fan-out server's windowed dispatch, the three-stage overload
+contract (admission -> window stall -> shed), and the hash-once
+telemetry proof.  The chaos sweep lives in test_fanout_faults.py.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.fanout import (
+    BroadcastLog,
+    FanoutBusy,
+    FanoutServer,
+    PeerShed,
+    SnapshotNeeded,
+)
+from dat_replication_protocol_tpu.session.resume import ResumeError
+
+WIRE = bytes(range(256)) * 300  # 76,800 bytes, content position-coded
+
+
+def _counting_sink(buf: bytearray):
+    def sink(views):
+        n = 0
+        for v in views:
+            buf.extend(bytes(v))
+            n += len(v)
+        return n
+    return sink
+
+
+# -- BroadcastLog -------------------------------------------------------------
+
+
+def test_log_append_read_slices_roundtrip_across_segment_kinds():
+    """Small appends coalesce, large ones become their own segments;
+    reads stitch both byte-exactly at arbitrary offsets."""
+    log = BroadcastLog(retention_budget=1 << 20)
+    log.append(b"a" * 100)        # coalesced tail
+    log.append(b"b" * 8192)       # own segment (freezes the tail)
+    log.append(b"c" * 50)         # new tail
+    log.append(b"d" * 5000)       # own segment
+    whole = b"a" * 100 + b"b" * 8192 + b"c" * 50 + b"d" * 5000
+    assert log.end == len(whole)
+    assert log.read_from(0) == whole
+    for off in (0, 1, 99, 100, 101, 8291, 8292, 8343, 13341, len(whole)):
+        assert log.read_from(off) == whole[off:]
+
+
+def test_log_read_slices_are_zero_copy_views():
+    """The scatter-gather contract: read_slices returns memoryviews
+    aliasing the log's own segments — no payload copy per read."""
+    log = BroadcastLog()
+    big = b"x" * 10000
+    log.append(big)
+    views = log.read_slices(0, 10000)
+    assert all(isinstance(v, memoryview) for v in views)
+    # the view aliases the very bytes object append stored (append of a
+    # bytes-sized chunk re-wraps but must not copy per reader: two
+    # reads alias the SAME underlying object)
+    v1 = log.read_slices(0, 10000)[0]
+    v2 = log.read_slices(0, 10000)[0]
+    assert v1.obj is v2.obj
+    v1.release()
+    v2.release()
+    for v in views:
+        v.release()
+
+
+def test_log_read_slices_respects_max_iov_and_max_bytes():
+    log = BroadcastLog()
+    for _ in range(10):
+        log.append(b"s" * 5000)  # 10 segments
+    views = log.read_slices(0, 1 << 20, max_iov=4)
+    assert len(views) == 4
+    assert sum(len(v) for v in views) == 20000
+    views = log.read_slices(2500, 6000)
+    assert sum(len(v) for v in views) == 6000
+
+
+def test_log_retains_full_budget_window_for_late_joiners():
+    """Below the retention budget the log does NOT trim behind fast
+    readers: a late joiner attaches at any retained offset."""
+    log = BroadcastLog(retention_budget=1 << 20)
+    c1 = log.attach("fast", 0)
+    log.append(b"k" * 10000)
+    log.ack(c1, 10000)
+    assert log.start == 0  # history retained for late joiners
+    late = log.attach("late", 5000)
+    assert log.read_from(5000) == b"k" * 5000
+    log.detach(late)
+    log.detach(c1)
+
+
+def test_log_budget_trim_invalidates_laggard_with_structured_error():
+    """Over budget, the budget wins: the laggard's cursor is
+    invalidated and every path out of it is a structured SnapshotNeeded
+    naming the retained range — never a silent short read."""
+    log = BroadcastLog(retention_budget=1000)
+    lag = log.attach("lag", 0)
+    ok = log.attach("ok", 0)
+    log.append(b"y" * 4000)
+    log.ack(ok, 4000)  # triggers the budget trim
+    assert log.start == 3000
+    assert lag.invalidated
+    with pytest.raises(SnapshotNeeded) as ei:
+        log.read_slices(0, 100)
+    assert ei.value.retained == (3000, 4000)
+    assert "[3000, 4000)" in str(ei.value)
+    with pytest.raises(SnapshotNeeded):
+        log.ack(lag, 500)
+    with pytest.raises(SnapshotNeeded) as ei:
+        log.attach("late", 0)
+    assert ei.value.retained == (3000, 4000)
+    # attach beyond production is the OTHER structured error
+    with pytest.raises(ResumeError):
+        log.attach("ahead", 4001)
+
+
+def test_log_enforce_retention_without_acks():
+    """Budget pressure from a burst of appends is enforced by the
+    dispatcher hook, not the O(1) write path."""
+    log = BroadcastLog(retention_budget=512)
+    log.append(b"z" * 2048)
+    assert log.start == 0  # append itself never trims (O(1) in peers)
+    log.enforce_retention()
+    assert log.start == 2048 - 512
+
+
+def test_log_seal_refuses_append_and_seek_contract():
+    log = BroadcastLog()
+    log.append(b"q")
+    log.seal()
+    assert log.sealed
+    with pytest.raises(ValueError):
+        log.append(b"more")
+    log2 = BroadcastLog()
+    log2.seek(777)  # encoder journal-tee alignment
+    assert (log2.start, log2.end) == (777, 777)
+    log2.append(b"ab")
+    assert log2.read_from(777) == b"ab"
+    with pytest.raises(ValueError):
+        log2.seek(0)  # non-empty
+
+
+def test_encoder_attach_journal_into_broadcast_log_is_byte_exact():
+    """The wiring the sidecar uses conceptually: an encoder tees its
+    wire into the broadcast log; a decoder replaying from offset 0
+    reproduces the session byte-exactly."""
+    e = protocol.encode()
+    log = BroadcastLog()
+    e.attach_journal(log)
+    e.change({"key": "a", "change": 1, "from": 0, "to": 1, "value": b"v"})
+    ws = e.blob(5)
+    ws.write(b"12")
+    ws.end(b"345")
+    e.finalize()
+    parts = []
+    while True:
+        d = e.read(7)
+        if d is None:
+            break
+        parts.append(d)
+    assert log.read_from(0) == b"".join(parts)
+    dec = protocol.decode()
+    seen = []
+    dec.change(lambda ch, done: (seen.append(ch.key), done()))
+    dec.blob(lambda b, done: b.collect(lambda data: (seen.append(data),
+                                                     done())))
+    dec.write(log.read_from(0))
+    dec.end()
+    assert dec.finished and seen == ["a", b"12345"]
+
+
+# -- FanoutServer -------------------------------------------------------------
+
+
+def test_server_admission_is_stage_one_of_the_overload_contract():
+    srv = FanoutServer(max_peers=2, stall_timeout=5.0)
+    try:
+        srv.attach_peer("a", sink=lambda vs: 0)
+        srv.attach_peer("b", sink=lambda vs: 0)
+        with pytest.raises(FanoutBusy) as ei:
+            srv.attach_peer("c", sink=lambda vs: 0)
+        assert ei.value.peers == 2 and ei.value.max_peers == 2
+        with pytest.raises(ValueError):
+            srv.attach_peer("a", sink=lambda vs: 0)  # duplicate key
+        with pytest.raises(ValueError):
+            srv.attach_peer("bad{key}", sink=lambda vs: 0)
+        with pytest.raises(ValueError):
+            srv.attach_peer(None, sink=lambda vs: 0)  # keys ride labels
+        with pytest.raises(ValueError):
+            srv.attach_peer("x", sink=lambda vs: 0, fd=1)  # both transports
+    finally:
+        srv.close()
+
+
+def test_server_delivers_byte_exact_to_sink_and_fd_peers():
+    srv = FanoutServer(stall_timeout=10.0)
+    try:
+        got = bytearray()
+        p_sink = srv.attach_peer("sink", sink=_counting_sink(got))
+        a, b = socket.socketpair()
+        recv = bytearray()
+
+        def reader():
+            while True:
+                d = b.recv(65536)
+                if not d:
+                    return
+                recv.extend(d)
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        p_fd = srv.attach_peer("fd", fd=a.fileno())
+        for off in range(0, len(WIRE), 4321):
+            srv.publish(WIRE[off:off + 4321])
+        srv.seal()
+        assert srv.drain(15)
+        assert p_sink.wait_done(5) and p_fd.wait_done(5)
+        a.close()
+        t.join(5)
+        assert bytes(got) == WIRE
+        assert bytes(recv) == WIRE
+        st = p_sink.stats()
+        assert st["sent_bytes"] == len(WIRE) and st["done"]
+    finally:
+        srv.close()
+
+
+def test_server_late_joiner_attaches_mid_stream_at_retained_offset():
+    srv = FanoutServer(stall_timeout=10.0)
+    try:
+        srv.publish(WIRE[:30000])
+        tail = bytearray()
+        p = srv.attach_peer("late", sink=_counting_sink(tail),
+                            offset=30000)
+        srv.publish(WIRE[30000:])
+        srv.seal()
+        assert srv.drain(10) and p.wait_done(5)
+        assert bytes(tail) == WIRE[30000:]
+    finally:
+        srv.close()
+
+
+def test_server_window_stall_bounds_only_the_slow_peer():
+    """Stage two: a peer whose sink would-blocks accumulates backlog
+    bounded by its own window; a healthy co-resident peer finishes at
+    full speed meanwhile."""
+    srv = FanoutServer(stall_timeout=30.0)
+    try:
+        fast = bytearray()
+        slow_gate = threading.Event()
+        slow = bytearray()
+
+        def slow_sink(views):
+            if not slow_gate.is_set():
+                return 0  # would-block
+            n = 0
+            for v in views:
+                slow.extend(bytes(v))
+                n += len(v)
+            return n
+
+        p_fast = srv.attach_peer("fast", sink=_counting_sink(fast))
+        p_slow = srv.attach_peer("slow", sink=slow_sink)
+        t0 = time.monotonic()
+        for off in range(0, len(WIRE), 8192):
+            srv.publish(WIRE[off:off + 8192])
+        srv.seal()
+        assert p_fast.wait_done(10)
+        fast_done = time.monotonic() - t0
+        assert bytes(fast) == WIRE
+        assert not p_slow.stats()["done"]
+        assert fast_done < 5.0  # never convoyed behind the slow peer
+        slow_gate.set()
+        assert p_slow.wait_done(10)
+        assert bytes(slow) == WIRE
+    finally:
+        srv.close()
+
+
+def test_server_sheds_stalled_peer_and_neighbors_never_notice():
+    """Stage three: no delivery progress for stall_timeout -> shed with
+    a structured PeerShed; the healthy peer's stream is untouched."""
+    srv = FanoutServer(stall_timeout=0.25)
+    try:
+        healthy = bytearray()
+        p_ok = srv.attach_peer("ok", sink=_counting_sink(healthy))
+        p_stuck = srv.attach_peer("stuck", sink=lambda vs: 0)
+        for off in range(0, len(WIRE), 8192):
+            srv.publish(WIRE[off:off + 8192])
+        srv.seal()
+        assert p_ok.wait_done(10)
+        deadline = time.monotonic() + 5
+        while p_stuck.shed_reason is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert p_stuck.shed_reason == "stall"
+        with pytest.raises(PeerShed) as ei:
+            p_stuck.raise_if_shed()
+        assert ei.value.key == "stuck" and ei.value.reason == "stall"
+        assert bytes(healthy) == WIRE
+    finally:
+        srv.close()
+
+
+def test_server_sheds_byzantine_acker_with_structured_error():
+    srv = FanoutServer(stall_timeout=10.0)
+    try:
+        got = bytearray()
+        p = srv.attach_peer("byz", sink=_counting_sink(got),
+                            explicit_ack=True)
+        srv.publish(b"n" * 2000)
+        deadline = time.monotonic() + 5
+        while p.sent < 2000 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(PeerShed) as ei:
+            p.ack(99999)  # acking bytes never sent
+        assert ei.value.reason == "byzantine"
+        assert p.shed_reason == "byzantine"
+    finally:
+        srv.close()
+
+
+def test_server_sheds_disconnected_fd_peer():
+    srv = FanoutServer(stall_timeout=10.0)
+    try:
+        a, b = socket.socketpair()
+        p = srv.attach_peer("gone", fd=a.fileno())
+        b.close()  # peer vanishes
+        srv.publish(b"w" * 70000)
+        srv.publish(b"w" * 70000)  # EPIPE surfaces on a later writev
+        deadline = time.monotonic() + 5
+        while p.shed_reason is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert p.shed_reason == "disconnect"
+        a.close()
+    finally:
+        srv.close()
+
+
+def test_server_sheds_budget_trimmed_laggard_as_retention():
+    srv = FanoutServer(retention_budget=4096, stall_timeout=30.0)
+    try:
+        drained = bytearray()
+        lag = srv.attach_peer("lag", sink=lambda vs: 0)
+        ok = srv.attach_peer("ok", sink=_counting_sink(drained))
+        for _ in range(8):
+            srv.publish(b"r" * 2000)
+        deadline = time.monotonic() + 5
+        while lag.shed_reason is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert lag.shed_reason == "retention"
+        srv.seal()
+        assert ok.wait_done(10)
+        assert len(drained) == 16000
+    finally:
+        srv.close()
+
+
+def test_explicit_ack_window_closes_and_reopens():
+    """WAN shape: with explicit acks, unacked in-flight bytes are
+    bounded by the peer's window; acking reopens it."""
+    srv = FanoutServer(stall_timeout=30.0)
+    try:
+        got = bytearray()
+        p = srv.attach_peer("wan", sink=_counting_sink(got),
+                            window_bytes=1024, explicit_ack=True)
+        srv.publish(b"h" * 10000)
+        deadline = time.monotonic() + 5
+        while len(got) < 1024 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)  # give the dispatcher a chance to overshoot
+        assert len(got) == 1024  # window-bounded in flight
+        p.ack(1024)
+        deadline = time.monotonic() + 5
+        while len(got) < 2048 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 2048
+        p.ack(2048)
+        srv.seal()
+        while len(got) < 10000 and time.monotonic() < deadline + 10:
+            p.ack(p.sent)
+            time.sleep(0.01)
+        assert bytes(got) == b"h" * 10000
+    finally:
+        srv.close()
+
+
+def test_hash_once_telemetry_proof(obs_enabled):
+    """The headline economics, measured: decoding (hashing) happens
+    ONCE at the source while N peers receive the bytes — the appended
+    bytes counter is wire-sized, the sent counter is N x wire-sized,
+    and the decode/digest path ran once regardless of peer count."""
+    e = protocol.encode()
+    for j in range(50):
+        e.change({"key": f"k{j}", "change": j, "from": j, "to": j + 1,
+                  "value": b"v" * 32})
+    e.finalize()
+    parts = []
+    while True:
+        d = e.read(4096)
+        if d is None:
+            break
+        parts.append(d)
+    wire = b"".join(parts)
+
+    n_peers = 4
+    srv = FanoutServer(stall_timeout=10.0)
+    try:
+        bufs = [bytearray() for _ in range(n_peers)]
+        peers = [srv.attach_peer(f"p{i}", sink=_counting_sink(bufs[i]))
+                 for i in range(n_peers)]
+        dec = protocol.decode(backend="tpu")
+        digs = []
+        dec.on_digest(lambda kind, seq, d: digs.append(d))
+        for off in range(0, len(wire), 1024):
+            chunk = wire[off:off + 1024]
+            srv.publish(chunk)   # fan-out: bytes only
+            dec.write(chunk)     # digest work: exactly once
+        dec.end()
+        srv.seal()
+        assert srv.drain(10)
+        assert dec.finished and len(digs) == 50
+        for buf in bufs:
+            assert bytes(buf) == wire
+        reg = obs_enabled.REGISTRY
+        assert reg.counter("fanout.append.bytes").value == len(wire)
+        assert reg.counter("fanout.sent.bytes").value == \
+            n_peers * len(wire)
+        for p in peers:
+            p.close()
+    finally:
+        srv.close()
+
+
+def test_peer_latency_stats_populate():
+    srv = FanoutServer(stall_timeout=10.0)
+    try:
+        got = bytearray()
+        p = srv.attach_peer("lat", sink=_counting_sink(got))
+        for off in range(0, len(WIRE), 8192):
+            srv.publish(WIRE[off:off + 8192])
+        srv.seal()
+        assert p.wait_done(10)
+        st = p.stats()
+        assert st["lat_p50_ms"] is not None
+        assert st["lat_p99_ms"] is not None
+        assert st["lat_p99_ms"] >= st["lat_p50_ms"]
+    finally:
+        srv.close()
+
+
+def test_retention_enforced_with_zero_peers_attached():
+    """Review regression: the dispatcher (started at construction) is
+    the retention enforcer — a source publishing before any subscriber
+    attaches must not grow the log past the budget."""
+    srv = FanoutServer(retention_budget=4096, stall_timeout=30.0)
+    try:
+        for _ in range(16):
+            srv.publish(b"g" * 1024)
+        deadline = time.monotonic() + 5
+        while srv.log.retained_bytes > 4096 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.log.retained_bytes <= 4096, srv.log.retained_bytes
+        assert srv.log.end == 16384  # production unaffected
+    finally:
+        srv.close()
+
+
+def test_invalidated_laggard_honest_ack_sheds_as_retention():
+    """Review regression: an explicit-ack peer the budget trimmed past
+    is a laggard, not an attacker — its next honest ack sheds it with
+    reason 'retention', never 'byzantine'."""
+    srv = FanoutServer(retention_budget=2048, stall_timeout=30.0)
+    try:
+        got = bytearray()
+        lag = srv.attach_peer("lag", sink=_counting_sink(got),
+                              explicit_ack=True)
+        for _ in range(8):
+            srv.publish(b"w" * 1024)
+        # delivery keeps up (window default 1 MiB) but acks never come:
+        # the budget trims past the cursor
+        deadline = time.monotonic() + 5
+        while srv.log.start == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.log.start > 0
+        deadline = time.monotonic() + 5
+        while lag.sent < 4096 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(PeerShed) as ei:
+            lag.ack(lag.sent)  # honest: bytes really delivered
+        assert ei.value.reason == "retention"
+        assert lag.shed_reason == "retention"
+    finally:
+        srv.close()

@@ -1,0 +1,341 @@
+"""Chaos isolation proof for the fan-out (ISSUE 9 acceptance): one
+misbehaving peer cannot hurt the broadcast.
+
+The sweep serves 8 downstream peers per seed from ONE FanoutServer;
+exactly one peer — :meth:`FaultPlan.faulty_session` (the PR 8
+per-session scenario axis, reused as the per-peer axis) — misbehaves
+per the seed's scenario, the rest consume with benign delivery jitter.
+Scenario mapping onto the peer world:
+
+* ``stall``    -> the peer stops accepting bytes at the plan's stall
+  coordinate (the client that went away without closing) — shed
+  ``stall`` once it makes no progress for the server's stall timeout;
+* ``truncate`` -> the peer's transport dies at the plan's truncate
+  coordinate (EPIPE mid-writev) — shed ``disconnect``;
+* ``flip``     -> the peer acks bytes it was never sent at the plan's
+  flip coordinate (a corrupt/hostile ack stream) — shed ``byzantine``.
+
+The contract: every healthy peer receives the wire BYTE-EXACTLY, its
+p99 frame latency stays flat (the faulty peer never convoys the
+dispatch), and the faulty peer is shed with ONE structured
+:class:`PeerShed` whose reason matches the injected scenario — the
+oracle cross-checks ``fanout.shed`` events against the predicted
+ground truth.  Tier-1 sweeps seeds 0..19; the ``slow`` soak covers 100
+more.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.fanout import FanoutServer, PeerShed
+from dat_replication_protocol_tpu.session.faults import FaultPlan
+
+N_PEERS = 8
+HARD_TIMEOUT = 20.0
+# healthy peers' p99 append->delivery latency must stay flat while the
+# faulty peer misbehaves; generous vs the ~1ms typical value so shared
+# CI boxes never flake, still far below any convoying regime
+P99_BUDGET_MS = 500.0
+
+_SCENARIO_TO_SHED = {"stall": "stall", "truncate": "disconnect",
+                     "flip": "byzantine"}
+
+
+def _build_wire() -> bytes:
+    e = protocol.encode()
+    for j in range(64):
+        e.change({"key": f"k{j}", "change": j, "from": j, "to": j + 1,
+                  "value": bytes([(j * 17 + t) % 251 for t in range(48)])})
+    b = e.blob(4096)
+    b.write(bytes(k % 241 for k in range(4096)))
+    b.end()
+    e.finalize()
+    parts = []
+    while True:
+        d = e.read(4096)
+        if d is None:
+            break
+        parts.append(d)
+    return b"".join(parts)
+
+
+WIRE = _build_wire()
+
+
+class _HealthySink:
+    """Benign delivery jitter from the peer's plan: accepts bounded
+    bites (re-segmentation) but always makes progress."""
+
+    def __init__(self, plan: FaultPlan):
+        self.buf = bytearray()
+        self._bite = plan.max_segment or (1 << 20)
+
+    def __call__(self, views) -> int:
+        n = 0
+        budget = max(512, self._bite)  # tiny bites still progress
+        for v in views:
+            take = min(len(v), budget - n)
+            self.buf.extend(bytes(v[:take]))
+            n += take
+            if n >= budget:
+                break
+        return n
+
+
+class _FaultySink:
+    """The faulty peer's transport, driven by the plan's coordinates:
+    stalls forever at ``stall_at`` or dies with OSError at
+    ``truncate_at`` (byzantine acks are driven from the test thread).
+    The coordinate is enforced WITHIN a call — a single writev burst
+    can cover the whole wire, so an entry-only check would skip it."""
+
+    def __init__(self, stall_at=None, die_at=None):
+        self.buf = bytearray()
+        self._stall_at = stall_at
+        self._die_at = die_at
+
+    def __call__(self, views) -> int:
+        fault_at = self._stall_at if self._stall_at is not None \
+            else self._die_at
+        if fault_at is None:  # byzantine peers consume normally; the
+            fault_at = 1 << 60  # fault is in their ACK stream
+        budget = fault_at - len(self.buf)
+        if budget <= 0:
+            if self._die_at is not None:
+                raise OSError(32, "Broken pipe (injected)")
+            return 0  # stalled for good: the shed scan's business now
+        n = 0
+        for v in views:
+            take = min(len(v), budget - n)
+            self.buf.extend(bytes(v[:take]))
+            n += take
+            if n >= budget:
+                break
+        return n
+
+
+def _run_fanout_seed(seed: int):
+    """One sweep seed: 8 peers, one faulted per the seed's scenario.
+    Returns (peers, sinks, faulty index, scenario, shed reason)."""
+    faulty = FaultPlan.faulty_session(seed, N_PEERS)
+    scenario = FaultPlan.session_scenario(seed, N_PEERS)
+    srv = FanoutServer(stall_timeout=0.15, retention_budget=1 << 24)
+    peers = {}
+    sinks = {}
+    byz_driver = None
+    try:
+        for i in range(N_PEERS):
+            plan = FaultPlan.for_sweep(seed, len(WIRE), attempt=0,
+                                       session=i, n_sessions=N_PEERS)
+            if i != faulty:
+                sinks[i] = _HealthySink(plan)
+                peers[i] = srv.attach_peer(f"seed{seed}-p{i}",
+                                           sink=sinks[i])
+            elif scenario == "stall":
+                sinks[i] = _FaultySink(stall_at=plan.stall_at)
+                peers[i] = srv.attach_peer(f"seed{seed}-p{i}",
+                                           sink=sinks[i])
+            elif scenario == "truncate":
+                sinks[i] = _FaultySink(die_at=plan.truncate_at)
+                peers[i] = srv.attach_peer(f"seed{seed}-p{i}",
+                                           sink=sinks[i])
+            else:  # flip -> byzantine acks, driven from a thread
+                sinks[i] = _FaultySink()
+                peers[i] = srv.attach_peer(f"seed{seed}-p{i}",
+                                           sink=sinks[i],
+                                           explicit_ack=True)
+
+                def _drive_byzantine(p=peers[i], at=plan.flip_at):
+                    deadline = time.monotonic() + HARD_TIMEOUT / 2
+                    while p.sent < at and p.shed_reason is None \
+                            and time.monotonic() < deadline:
+                        time.sleep(0.005)
+                    try:
+                        p.ack(p.sent + 1 + (plan.flip_mask or 1))
+                    except PeerShed:
+                        pass  # the structured shed IS the expectation
+
+                byz_driver = threading.Thread(target=_drive_byzantine,
+                                              daemon=True)
+                byz_driver.start()
+
+        for off in range(0, len(WIRE), 1024):
+            srv.publish(WIRE[off:off + 1024])
+        srv.seal()
+
+        deadline = time.monotonic() + HARD_TIMEOUT
+        for i in range(N_PEERS):
+            if i == faulty:
+                continue
+            assert peers[i].wait_done(max(0.1, deadline - time.monotonic())), \
+                f"seed {seed}: healthy peer {i} never finished"
+        while peers[faulty].shed_reason is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if byz_driver is not None:
+            byz_driver.join(5)
+        stats = {i: peers[i].stats() for i in range(N_PEERS)}
+        return sinks, stats, faulty, scenario
+    finally:
+        srv.close()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_sweep_one_faulty_peer_cannot_hurt_the_broadcast(seed, obs_enabled):
+    """The acceptance sweep: 8 peers, one faulted, healthy delivery
+    byte-exact with flat p99, the faulty peer shed with the predicted
+    structured reason — oracle-checked against fanout.shed events."""
+    from dat_replication_protocol_tpu.obs.events import EVENTS
+
+    sinks, stats, faulty, scenario = _run_fanout_seed(seed)
+
+    for i in range(N_PEERS):
+        if i == faulty:
+            continue
+        assert bytes(sinks[i].buf) == WIRE, \
+            f"seed {seed}: healthy peer {i} bytes diverged"
+        assert stats[i]["shed"] is None and stats[i]["done"]
+        p99 = stats[i]["lat_p99_ms"]
+        assert p99 is not None and p99 < P99_BUDGET_MS, \
+            f"seed {seed}: healthy peer {i} p99 {p99}ms"
+
+    expected = _SCENARIO_TO_SHED[scenario]
+    assert stats[faulty]["shed"] == expected, \
+        f"seed {seed}: scenario {scenario} -> {stats[faulty]['shed']}"
+
+    # oracle: every fanout.shed event names ONLY the faulty peer, with
+    # the predicted reason
+    sheds = EVENTS.events("fanout.shed")
+    assert sheds, f"seed {seed}: no fanout.shed event recorded"
+    for ev in sheds:
+        assert ev["fields"]["key"] == f"seed{seed}-p{faulty}"
+        assert ev["fields"]["reason"] == expected
+
+
+@pytest.mark.slow
+def test_sweep_soak_100_seeds():
+    for seed in range(20, 120):
+        sinks, stats, faulty, scenario = _run_fanout_seed(seed)
+        for i in range(N_PEERS):
+            if i == faulty:
+                continue
+            assert bytes(sinks[i].buf) == WIRE, \
+                f"seed {seed} peer {i} diverged"
+            assert stats[i]["done"] and stats[i]["shed"] is None
+        assert stats[faulty]["shed"] == _SCENARIO_TO_SHED[scenario], \
+            f"seed {seed}: {scenario} -> {stats[faulty]['shed']}"
+
+
+# -- targeted isolation arms --------------------------------------------------
+
+
+def test_three_second_stall_leaves_healthy_p99_flat():
+    """The acceptance arm, measured: one peer stalls for 3 s mid-wire
+    (below the shed timeout, so it is window-bounded, not shed); the
+    7 healthy peers finish long before the stall ends with flat p99,
+    and the staller still completes byte-exactly afterwards."""
+    srv = FanoutServer(stall_timeout=10.0, retention_budget=1 << 24)
+    try:
+        gate_t = [None]
+        stalled = bytearray()
+
+        def stall_sink(views):
+            # accept only up to the half-way coordinate, then stall 3 s
+            # (enforced in-call: one burst can cover the whole wire)
+            if gate_t[0] is None:
+                gate_t[0] = time.monotonic() + 3.0
+            if time.monotonic() < gate_t[0]:
+                budget = len(WIRE) // 2 - len(stalled)
+                if budget <= 0:
+                    return 0
+            else:
+                budget = 1 << 30
+            n = 0
+            for v in views:
+                take = min(len(v), budget - n)
+                stalled.extend(bytes(v[:take]))
+                n += take
+                if n >= budget:
+                    break
+            return n
+
+        healthy = [bytearray() for _ in range(N_PEERS - 1)]
+
+        def mk(buf):
+            def sink(views):
+                n = 0
+                for v in views:
+                    buf.extend(bytes(v))
+                    n += len(v)
+                return n
+            return sink
+
+        p_stall = srv.attach_peer("staller", sink=stall_sink)
+        ps = [srv.attach_peer(f"h{i}", sink=mk(healthy[i]))
+              for i in range(N_PEERS - 1)]
+        t0 = time.monotonic()
+        for off in range(0, len(WIRE), 2048):
+            srv.publish(WIRE[off:off + 2048])
+        srv.seal()
+        for i, p in enumerate(ps):
+            assert p.wait_done(10), f"healthy peer {i} hung"
+        healthy_done = time.monotonic() - t0
+        assert healthy_done < 1.5, \
+            f"healthy peers waited on the staller: {healthy_done:.2f}s"
+        for i, p in enumerate(ps):
+            st = p.stats()
+            assert bytes(healthy[i]) == WIRE
+            assert st["lat_p99_ms"] is not None
+            assert st["lat_p99_ms"] < P99_BUDGET_MS
+        assert p_stall.wait_done(10)
+        assert time.monotonic() - t0 >= 3.0  # it really did stall
+        assert bytes(stalled) == WIRE  # window-bounded, never corrupted
+    finally:
+        srv.close()
+
+
+def test_shed_peer_slot_is_released_for_a_replacement():
+    """A shed peer releases its admission slot: a full fan-out admits
+    a replacement after shedding (the bounded-state contract)."""
+    srv = FanoutServer(max_peers=2, stall_timeout=0.1,
+                       retention_budget=1 << 24)
+    try:
+        ok_buf = bytearray()
+
+        def ok_sink(views):
+            n = 0
+            for v in views:
+                ok_buf.extend(bytes(v))
+                n += len(v)
+            return n
+
+        p_ok = srv.attach_peer("ok", sink=ok_sink)
+        p_bad = srv.attach_peer("bad", sink=lambda vs: 0)
+        srv.publish(WIRE[:8192])
+        deadline = time.monotonic() + 5
+        while p_bad.shed_reason is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert p_bad.shed_reason == "stall"
+        p_bad.close()  # teardown releases the slot
+        fresh_buf = bytearray()
+
+        def fresh_sink(views):
+            n = 0
+            for v in views:
+                fresh_buf.extend(bytes(v))
+                n += len(v)
+            return n
+
+        p_fresh = srv.attach_peer("fresh", sink=fresh_sink, offset=0)
+        srv.publish(WIRE[8192:16384])
+        srv.seal()
+        assert p_ok.wait_done(10) and p_fresh.wait_done(10)
+        assert bytes(ok_buf) == WIRE[:16384]
+        assert bytes(fresh_buf) == WIRE[:16384]
+    finally:
+        srv.close()

@@ -100,6 +100,59 @@ def test_journal_window_ack_and_read_from():
         j.ack(99)
 
 
+def test_journal_trim_is_min_offset_aware_across_readers():
+    """Regression (ISSUE 9 satellite): the original ack-trim assumed a
+    single reader — with two attached cursors, one reader's ack must
+    not trim the other reader's unread window."""
+    j = WireJournal()
+    j.append(b"0123456789")
+    j.attach_reader("fast", 0)
+    j.attach_reader("slow", 0)
+    j.ack(8, reader="fast")
+    # the slow reader still pins the window: nothing trimmed
+    assert (j.start, j.end) == (0, 10)
+    assert j.read_from(0) == b"0123456789"
+    j.ack(5, reader="slow")
+    assert (j.start, j.end) == (5, 10)  # trimmed to the MINIMUM ack
+    # a bare (reader-less) ack is floored by the slowest reader too
+    j.ack(9)
+    assert j.start == 5
+    # a departed laggard releases its pin on the next ack
+    j.detach_reader("slow")
+    j.ack(8, reader="fast")
+    assert j.start == 8
+    with pytest.raises(ValueError):
+        j.ack(99, reader="fast")  # beyond production
+    with pytest.raises(ValueError):
+        j.ack(99)  # a bare over-end ack is a caller bug on EVERY
+        # path — the reader floor must not silently mask it
+    with pytest.raises(ValueError):
+        j.ack(9, reader="ghost")  # unknown cursor
+
+
+def test_journal_second_cursor_past_trim_point_is_structured():
+    """Regression (ISSUE 9 satellite): attaching a cursor below the
+    trimmed window must raise ResumeError carrying the retained range
+    in the message — not silently short-read from the wrong place."""
+    j = WireJournal()
+    j.append(b"x" * 100)
+    j.attach_reader("r1", 0)
+    j.ack(60, reader="r1")  # sole reader: trims to 60
+    assert j.start == 60
+    with pytest.raises(ResumeError) as ei:
+        j.attach_reader("r2", 40)  # past the trim point
+    assert ei.value.offset == 40
+    assert "[60, 100)" in str(ei.value)  # the retained range, in-message
+    with pytest.raises(ResumeError) as ei:
+        j.read_from(40)
+    assert "[60, 100)" in str(ei.value)
+    with pytest.raises(ResumeError):
+        j.attach_reader("r3", 101)  # ahead of production
+    # attaching INSIDE the retained range still works
+    j.attach_reader("ok", 70)
+    assert j.read_from(70) == b"x" * 30
+
+
 def test_encoder_journal_tee_is_byte_exact_and_order_preserving():
     e = protocol.encode()
     j = WireJournal()

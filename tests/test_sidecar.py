@@ -13,6 +13,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 import dat_replication_protocol_tpu as protocol
 from dat_replication_protocol_tpu import sidecar
@@ -711,3 +712,252 @@ def test_hub_mode_admission_rejection_is_structured(obs_enabled):
         held.close()
     finally:
         hub.close()
+
+
+# -- fan-out mode (ISSUE 9) ---------------------------------------------------
+
+
+def test_tcp_sidecar_fanout_broadcasts_source_wire_to_subscribers():
+    """--fanout shape: the FIRST connection is the source session
+    (decoded + digested once, reply streamed back); later connections
+    are subscribers that receive the source's wire bytes byte-exactly
+    via the zero-copy writev fan-out — including a late joiner that
+    attaches mid-stream."""
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    fanout = FanoutServer(stall_timeout=10.0)
+    ready = threading.Event()
+    port_box = {}
+    t = threading.Thread(
+        target=sidecar.serve_tcp,
+        args=("127.0.0.1", 0),
+        kwargs=dict(max_sessions=3, fanout=fanout,
+                    ready_cb=lambda p: (port_box.__setitem__("p", p),
+                                        ready.set())),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    addr = ("127.0.0.1", port_box["p"])
+
+    src = socket.create_connection(addr, timeout=10)
+    half = len(SESSION_4) // 2
+    src.sendall(SESSION_4[:half])
+
+    # subscriber 1 joins mid-stream (offset 0 is still retained)
+    sub1 = socket.create_connection(addr, timeout=10)
+
+    src.sendall(SESSION_4[half:])
+    src.shutdown(socket.SHUT_WR)
+    reply = _decode_reply(_recv_all(src))
+    src.close()
+    by_key = {ch.key: ch for ch in reply}
+    assert set(by_key) == {"blob-0", "change-0"}  # digested ONCE, at source
+
+    # late joiner: the source may already be sealed — retention serves it
+    sub2 = socket.create_connection(addr, timeout=10)
+
+    got1 = _recv_all(sub1)
+    got2 = _recv_all(sub2)
+    sub1.close()
+    sub2.close()
+    t.join(timeout=10)
+    fanout.close()
+    assert got1 == SESSION_4  # byte-exact broadcast
+    assert got2 == SESSION_4
+
+
+def test_fanout_subscriber_past_retention_gets_snapshot_needed():
+    """A joiner below the retained window gets the structured
+    snapshot-needed record and EOF — never silently wrong bytes."""
+    import json as _json
+
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    fanout = FanoutServer(retention_budget=64, stall_timeout=5.0)
+    try:
+        fanout.publish(b"x" * 400)  # budget-trims the head immediately
+        fanout.log.enforce_retention()
+        a, b = socket.socketpair()
+        out = sidecar.run_subscriber(a, fanout, key="late")
+        assert out["ok"] is False and out["snapshot_needed"] is True
+        assert out["retained"] == [400 - 64, 400]
+        line = _recv_all(b)
+        rec = _json.loads(line.decode())
+        assert rec["snapshot_needed"] is True
+        assert rec["retained"] == [336, 400]
+        a.close()
+        b.close()
+    finally:
+        fanout.close()
+
+
+def test_fanout_stats_snapshot_carries_peer_breakdown(obs_enabled):
+    """--stats-fd lines in fan-out mode answer "which peer is lagging":
+    the snapshot carries the fan-out aggregate and per-peer stats, and
+    the registry collector exposes labeled per-peer series."""
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+
+    fanout = FanoutServer(stall_timeout=5.0)
+    sidecar.set_active_fanout(fanout)
+    try:
+        got = bytearray()
+
+        def sink(views):
+            n = 0
+            for v in views:
+                got.extend(bytes(v))
+                n += len(v)
+            return n
+
+        peer = fanout.attach_peer("k1", sink=sink)
+        fanout.publish(b"z" * 5000)
+        fanout.seal()
+        assert fanout.drain(10)
+        snap = sidecar.snapshot_stats()
+        assert snap["fanout"]["peers"] == 1
+        assert snap["fanout"]["sealed"] is True
+        assert snap["peers"]["k1"]["sent_bytes"] == 5000
+        assert snap["peers"]["k1"]["shed"] is None
+        reg_snap = obs_metrics.snapshot()
+        assert reg_snap["counters"]["fanout.peer.sent_bytes{peer=k1}"] == 5000
+        assert reg_snap["gauges"]["fanout.peers"] == 1.0
+        peer.close()
+        assert bytes(got) == b"z" * 5000
+    finally:
+        sidecar.set_active_fanout(None)
+        fanout.close()
+
+
+def test_fanout_probe_connection_does_not_brick_the_broadcast():
+    """Review regression: a stray first connection that closes without
+    publishing a byte (healthcheck, port scan) must RELEASE the source
+    claim — the real source connecting afterwards still broadcasts."""
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    fanout = FanoutServer(stall_timeout=10.0)
+    ready = threading.Event()
+    port_box = {}
+    t = threading.Thread(
+        target=sidecar.serve_tcp,
+        args=("127.0.0.1", 0),
+        kwargs=dict(max_sessions=3, fanout=fanout,
+                    ready_cb=lambda p: (port_box.__setitem__("p", p),
+                                        ready.set())),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    addr = ("127.0.0.1", port_box["p"])
+
+    probe = socket.create_connection(addr, timeout=10)
+    probe.close()  # the healthcheck: no bytes, instant close
+    time.sleep(0.3)  # let its session thread release the claim
+    assert not fanout.log.sealed
+
+    src = socket.create_connection(addr, timeout=10)
+    src.sendall(SESSION_1)
+    src.shutdown(socket.SHUT_WR)
+    reply = _decode_reply(_recv_all(src))
+    src.close()
+    assert len(reply) == 1  # the REAL source was decoded + digested
+
+    sub = socket.create_connection(addr, timeout=10)
+    got = _recv_all(sub)
+    sub.close()
+    t.join(timeout=10)
+    fanout.close()
+    assert got == SESSION_1
+
+
+def test_fanout_idle_subscriber_disconnect_releases_slot():
+    """Review regression: a caught-up subscriber that disconnects while
+    the broadcast is idle (no bytes in flight to surface an EPIPE) must
+    release its peer slot instead of leaking it until new traffic."""
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    fanout = FanoutServer(stall_timeout=30.0)
+    fanout.publish(b"x" * 1000)  # subscribers catch up, log stays open
+    try:
+        a, b = socket.socketpair()
+        out = {}
+
+        def run():
+            out["stats"] = sidecar.run_subscriber(a, fanout, key="ghost")
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # wait until the broadcast reached the subscriber
+        deadline = time.monotonic() + 5
+        got = bytearray()
+        b.settimeout(5)
+        while len(got) < 1000 and time.monotonic() < deadline:
+            got.extend(b.recv(4096))
+        assert bytes(got) == b"x" * 1000
+        b.close()  # client goes away; the log is idle and unsealed
+        t.join(10)
+        assert not t.is_alive(), "subscriber thread leaked"
+        assert fanout.peers_snapshot() == {}  # the slot was released
+        a.close()
+    finally:
+        fanout.close()
+
+
+def test_fanout_rejected_subscriber_gets_structured_record():
+    """Review regression: a FanoutBusy rejection must SEND its
+    structured record — a bare EOF is indistinguishable from an empty
+    sealed broadcast."""
+    import json as _json
+
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    fanout = FanoutServer(max_peers=1, stall_timeout=5.0)
+    try:
+        held = fanout.attach_peer("occupant", sink=lambda vs: 0)
+        a, b = socket.socketpair()
+        out = sidecar.run_subscriber(a, fanout, key="refused")
+        assert out["ok"] is False and out["rejected"] is True
+        assert out["peers"] == 1 and out["max_peers"] == 1
+        rec = _json.loads(_recv_all(b).decode())
+        assert rec["rejected"] is True and rec["max_peers"] == 1
+        a.close()
+        b.close()
+        held.close()
+    finally:
+        fanout.close()
+
+
+def test_fanout_misrouted_source_fails_loudly_not_silently():
+    """Review regression: a subscriber connection that SENDS data is a
+    source that lost the claim race — it must get a structured
+    not_source record and EOF, never have its session silently
+    discarded."""
+    import json as _json
+
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    fanout = FanoutServer(stall_timeout=10.0)
+    try:
+        a, b = socket.socketpair()
+        out_box = {}
+
+        def run():
+            out_box["out"] = sidecar.run_subscriber(a, fanout, key="mis")
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        b.sendall(SESSION_1)  # "I am a source" — wrong slot
+        t.join(10)
+        assert not t.is_alive()
+        out = out_box["out"]
+        assert out["ok"] is False and out["not_source"] is True
+        raw = _recv_all(b)
+        rec = _json.loads(raw.splitlines()[-1].decode())
+        assert rec["not_source"] is True
+        assert fanout.peers_snapshot() == {}  # slot released
+        a.close()
+        b.close()
+    finally:
+        fanout.close()
