@@ -141,3 +141,55 @@ if [ ! -f "$OUT/.leg_fanout_done" ]; then
   device_artifact "$OUT/fanout_$STAMP.json" && touch "$OUT/.leg_fanout_done"
   commit_out "r06 watch: fan-out hash-once device capture ($STAMP)"
 fi
+
+# 7) ISSUE 10 rateless-reconcile device leg: the jitted scatter-add
+#    symbol build + peel throughput at the 1M+1M shape.  The benchmark
+#    itself is host-group (the wire A/B must not depend on a device),
+#    so this leg drives the device engine directly: CodedSymbols
+#    engine='device' build time + PeelDecoder round throughput at
+#    k=1000 and k=100000, emitted as one JSON line.  Config 3 rides
+#    along for the backend label.
+if [ ! -f "$OUT/.leg_rateless_done" ]; then
+  BENCH_CONFIGS=3 BENCH_DEADLINE=600 timeout 700 \
+    python bench.py --quick >"$OUT/rateless_label_$STAMP.json" \
+    2>"$OUT/rateless_label_$STAMP.log"
+  timeout 1200 python - >"$OUT/rateless_dev_$STAMP.json" \
+      2>"$OUT/rateless_dev_$STAMP.log" <<'EOF'
+import json, time
+import numpy as np
+import jax
+from dat_replication_protocol_tpu.ops import rateless as rl
+
+out = {"backend": jax.default_backend(), "arms": {}}
+rng = np.random.default_rng(1)
+n = 1_000_000
+for k in (1000, 100_000):
+    base = rng.integers(0, 256, (n + k, 32), dtype=np.uint8)
+    da, db = base[:n].copy(), np.concatenate([base[k:n], base[n:]])
+    t0 = time.perf_counter()
+    syms = rl.CodedSymbols(da, engine="device")
+    dec = rl.PeelDecoder(db, engine="device")
+    m, sent = 1024, 0
+    while True:
+        dec.add_symbols(sent, syms.extend(m)[sent:])
+        sent = m
+        got = dec.try_decode()
+        if got is not None:
+            break
+        m *= 2
+    dt = time.perf_counter() - t0
+    assert len(got[0]) == 2 * k
+    out["arms"][str(k)] = {
+        "seconds": round(dt, 3), "symbols": sent,
+        "peeled_per_s": round(2 * k / dt, 1),
+        "records_per_s": round(2 * n / dt, 1)}
+print(json.dumps(out))
+EOF
+  tail -c 16384 "$OUT/rateless_dev_$STAMP.log" \
+    >"$OUT/rateless_dev_$STAMP.log.tail" \
+    && rm -f "$OUT/rateless_dev_$STAMP.log"
+  grep -q '"arms"' "$OUT/rateless_dev_$STAMP.json" \
+    && device_artifact "$OUT/rateless_label_$STAMP.json" \
+    && touch "$OUT/.leg_rateless_done"
+  commit_out "r06 watch: rateless coded-symbol device build capture ($STAMP)"
+fi

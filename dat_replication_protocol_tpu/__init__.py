@@ -37,6 +37,7 @@ from .session import (
 )
 from .wire import (
     CAP_CHANGE_BATCH,
+    CAP_RECONCILE,
     Change,
     ProtocolError,
     decode_change,
@@ -79,6 +80,7 @@ __all__ = [
     "Pipe",
     "BatchPolicy",
     "CAP_CHANGE_BATCH",
+    "CAP_RECONCILE",
     "Change",
     "ProtocolError",
     "encode_change",

@@ -50,6 +50,15 @@ WATCHLIST = frozenset({
     # different chunks, the exact divergence the fused1p cross-checks
     # exist to refuse
     "GEAR_C1", "GEAR_C2",
+    # rateless reconciliation (ISSUE 10): the frame type + capability
+    # bit + payload version (negotiation constants, same failure class
+    # as the ChangeBatch trio), and the splitmix64 mapping constants —
+    # written down independently in ops/rateless.py and the native
+    # dat_rateless_build engine; a fork maps elements to DIFFERENT
+    # coded symbols per engine (the GEAR route-fork class: a sketch
+    # that silently never decodes against itself)
+    "TYPE_RECONCILE", "CAP_RECONCILE", "RECONCILE_VERSION",
+    "RATELESS_GAMMA", "RATELESS_MIX1", "RATELESS_MIX2",
 })
 
 _C_PATTERNS = (

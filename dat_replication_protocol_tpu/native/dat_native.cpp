@@ -585,6 +585,7 @@ int64_t dat_encode_change_batch(const uint8_t* src, int64_t n,
 #include <cstring>
 #include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <thread>
 #include <vector>
 
@@ -962,6 +963,99 @@ int64_t dat_sketch(const uint8_t* buf, const int64_t* rec_offs,
     for (int k = 0; k < 8; ++k) cell[k] += w[k];
   }
   delete[] scratch;
+  return 0;
+}
+
+// -- rateless coded-symbol build (ops/rateless.py documents the scheme) --
+//
+// The splitmix64 constants are written down independently in
+// ops/rateless.py; a fork here is a ROUTE fork — two "byte-identical"
+// engines silently mapping elements to different coded symbols (the
+// GEAR_C1/GEAR_C2 precedent).  Parity is machine-checked:
+// wire: RATELESS_GAMMA = 0x9E3779B97F4A7C15
+// wire: RATELESS_MIX1 = 0xBF58476D1CE4E5B9
+// wire: RATELESS_MIX2 = 0x94D049BB133111EB
+static inline uint64_t rateless_mix64(uint64_t z) {
+  z ^= z >> 30;
+  z *= 0xBF58476D1CE4E5B9ULL;
+  z ^= z >> 27;
+  z *= 0x94D049BB133111EBULL;
+  z ^= z >> 31;
+  return z;
+}
+
+// Advance each element's participation cursor through coded-symbol
+// indices below `m`, adding its 11-word row (count=1, 2 checksum
+// words, 8 digest words) into cells for every index in [base, m).
+// `state` / `next` are INOUT per-element cursors (the caller seeds a
+// fresh element with state = LE64(digest[0:8]), next = 0 — every
+// element participates at index 0); on return every cursor sits at its
+// first index >= m, so repeated calls with a growing bound build the
+// prefix incrementally.  `cells` is (m - base) * 11 u32, caller-zeroed.
+// The gap draw is IEEE double math (sqrt/ceil are correctly rounded),
+// bit-identical to the numpy reference in ops/rateless.py.  Threaded
+// over elements with private partial tables (u32 wrapping adds commute,
+// so the merge order cannot change a single byte).  Returns 0, or
+// DAT_ERR_NOMEM when a partial table cannot be allocated.
+int64_t dat_rateless_build(const uint8_t* digests, int64_t n,
+                           uint64_t* state, uint64_t* next, int64_t base,
+                           int64_t m, uint32_t* cells, int64_t nthreads) {
+  const int64_t width = (m - base) * 11;
+  int nt = pick_threads(nthreads, n, 1024);
+  // every partial table is allocated BEFORE any worker runs: the
+  // cursors advance in place, so a mid-flight failure after some
+  // threads finished would leave them advanced past cells that were
+  // never written — a silently corrupted prefix the Python fallback
+  // could not repair.  All-or-nothing: fail before touching anything.
+  std::vector<uint32_t*> partials(static_cast<size_t>(nt), nullptr);
+  for (int k = 1; k < nt; ++k) {
+    partials[static_cast<size_t>(k)] =
+        new (std::nothrow) uint32_t[static_cast<size_t>(width)]();
+    if (partials[static_cast<size_t>(k)] == nullptr) {
+      for (int j = 1; j < k; ++j) delete[] partials[static_cast<size_t>(j)];
+      return DAT_ERR_NOMEM;
+    }
+  }
+  parallel_for(n, nt, 1024, [&](int64_t lo, int64_t hi, int64_t k) {
+    uint32_t* block = k > 0 ? partials[static_cast<size_t>(k)] : cells;
+    for (int64_t e = lo; e < hi; ++e) {
+      const uint8_t* d = digests + e * 32;
+      uint32_t row[11];
+      row[0] = 1u;
+      uint64_t lanes[4];
+      std::memcpy(lanes, d, 32);
+      uint64_t acc = rateless_mix64(lanes[0] + 0x9E3779B97F4A7C15ULL);
+      for (int i = 1; i < 4; ++i) acc = rateless_mix64(acc ^ lanes[i]);
+      row[1] = static_cast<uint32_t>(acc);
+      row[2] = static_cast<uint32_t>(acc >> 32);
+      std::memcpy(row + 3, d, 32);
+      uint64_t st = state[e], nx = next[e];
+      const uint64_t bound = static_cast<uint64_t>(m);
+      const uint64_t lo_b = static_cast<uint64_t>(base);
+      while (nx < bound) {
+        if (nx >= lo_b) {
+          uint32_t* c = block + static_cast<int64_t>(nx - lo_b) * 11;
+          for (int w = 0; w < 11; ++w) c[w] += row[w];
+        }
+        st += 0x9E3779B97F4A7C15ULL;
+        uint32_t r32 = static_cast<uint32_t>(rateless_mix64(st) >> 32);
+        double cur = static_cast<double>(nx);
+        double gap = std::ceil(
+            (cur + 1.5) * (65536.0 / std::sqrt(static_cast<double>(r32) + 1.0)
+                           - 1.0));
+        if (gap < 1.0) gap = 1.0;
+        nx += static_cast<uint64_t>(gap);
+      }
+      state[e] = st;
+      next[e] = nx;
+    }
+  });
+  for (size_t k = 1; k < partials.size(); ++k) {
+    if (partials[k] != nullptr) {
+      for (int64_t w = 0; w < width; ++w) cells[w] += partials[k][w];
+      delete[] partials[k];
+    }
+  }
   return 0;
 }
 

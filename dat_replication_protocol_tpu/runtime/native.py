@@ -51,6 +51,7 @@ _tried = False
 
 _I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 _U32P = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
+_U64P = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
 _U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
 
 
@@ -147,6 +148,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dat_sketch.argtypes = [
         _U8P, _I64P, _I64P, _I64P, _I64P,
         ctypes.c_int64, ctypes.c_int64, _U32P, _U32P, ctypes.c_int64,
+    ]
+    lib.dat_rateless_build.restype = ctypes.c_int64
+    lib.dat_rateless_build.argtypes = [
+        _U8P, ctypes.c_int64, _U64P, _U64P,
+        ctypes.c_int64, ctypes.c_int64, _U32P, ctypes.c_int64,
     ]
     return lib
 
@@ -331,6 +337,47 @@ def sketch(buf: np.ndarray, rec_offs, rec_lens, key_offs, key_lens,
     if rc != 0:
         return None
     return table, slots
+
+
+def hash_many_fallback(buf: np.ndarray, offs: np.ndarray,
+                       lens: np.ndarray) -> np.ndarray:
+    """:func:`hash_many`, degrading to a hashlib loop on toolchain-less
+    hosts — the ONE owner of that fallback shape (consumers previously
+    each carried a copy; the digest convention must have one home)."""
+    out = hash_many(buf, offs, lens)
+    if out is not None:
+        return out
+    import hashlib
+
+    data = np.ascontiguousarray(buf, dtype=np.uint8).tobytes()
+    out = np.empty((len(offs), 32), dtype=np.uint8)
+    for i, (o, ln) in enumerate(zip(np.asarray(offs).tolist(),
+                                    np.asarray(lens).tolist())):
+        out[i] = np.frombuffer(
+            hashlib.blake2b(data[o:o + ln], digest_size=32).digest(),
+            np.uint8)
+    return out
+
+
+def rateless_build(digests: np.ndarray, state: np.ndarray,
+                   next_idx: np.ndarray, m: int, base: int = 0):
+    """Rateless coded-symbol build (see ops/rateless.py): advance the
+    per-element cursors ``state`` / ``next_idx`` (IN PLACE — the same
+    postcondition as ``IndexCursor.advance``) and return the
+    ``(m - base, 11)`` u32 cell block for indices ``[base, m)``, or
+    ``None`` when the native library is unavailable (callers fall back
+    to the numpy reference — byte-identical by construction)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    digests = np.ascontiguousarray(digests, dtype=np.uint8)
+    cells = np.zeros((m - base, 11), dtype=np.uint32)
+    rc = lib.dat_rateless_build(digests.reshape(-1), len(state), state,
+                                next_idx, base, m, cells.reshape(-1),
+                                _nthreads())
+    if rc != 0:
+        return None
+    return cells
 
 
 def cdc_hash(buf: np.ndarray, avg_bits: int, thin_bits: int,

@@ -38,7 +38,8 @@ from ..obs.metrics import OBS as _OBS, counter as _counter, \
 from ..obs.tracing import trace_instant as _trace_instant
 from ..wire.change_codec import Change, decode_change
 from ..wire.framing import LOCAL_CAPS, MAX_HEADER_LEN, TYPE_BLOB, \
-    TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_HEADER, ProtocolError
+    TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_HEADER, TYPE_RECONCILE, \
+    ProtocolError
 from ..wire.framing import header_len as _header_len
 from ..wire.varint import decode_uvarint
 
@@ -55,6 +56,8 @@ _M_DEC_REQUEUES = _counter("decoder.requeues")
 _M_DEC_ERRORS = _counter("decoder.errors")
 # columnar ChangeBatch frames dispatched (rows ride decoder.changes)
 _M_DEC_BATCH_FRAMES = _counter("decoder.batch.frames")
+# reconcile protocol frames dispatched (OBSERVABILITY.md "reconcile.*")
+_M_DEC_RC_FRAMES = _counter("decoder.reconcile.frames")
 # per-write() dispatch latency: bytes in -> handlers fired (or stalled)
 _H_DEC_DISPATCH = _histogram("decoder.dispatch.seconds")
 
@@ -210,6 +213,10 @@ class Decoder:
         self.finished = False
         self._on_change: Callable[[Change, Callable[[], None]], None] | None = None
         self._on_change_batch = None  # whole-batch columnar handler
+        self._on_reconcile = None  # reconcile protocol message handler
+        # reconcile frames delivered: rides _frames_delivered (a
+        # reconcile frame never touches the change-row counters)
+        self.reconcile_frames = 0
         self._on_blob: Callable[[BlobReader, Callable[[], None]], None] | None = None
         self._on_finalize: Callable[[Callable[[], None]], None] | None = None
         self._error_cbs: list[Callable[[Exception | None], None]] = []
@@ -265,6 +272,16 @@ class Decoder:
 
     def change(self, cb: Callable[[Change, Callable[[], None]], None]) -> "Decoder":
         self._on_change = cb
+        return self
+
+    def reconcile(self, cb) -> "Decoder":
+        """Register the reconcile-message handler: ``cb(msg, done)``
+        receives each ``TYPE_RECONCILE`` frame's decoded
+        :class:`~..wire.reconcile_codec.ReconcileMsg` and one ``done``
+        per frame (the reconcile driver's receive surface).  Without a
+        handler, reconcile frames are dropped — the same
+        never-deadlock default as unhandled changes."""
+        self._on_reconcile = cb
         return self
 
     def change_batch(self, cb) -> "Decoder":
@@ -431,9 +448,11 @@ class Decoder:
         ONE frame however many rows it carries: its rows are subtracted
         back out of ``changes`` and the frame counts once, at full
         delivery (mid-batch it is the frame being parsed, like a
-        mid-payload blob)."""
+        mid-payload blob).  A reconcile frame counts once, at delivery,
+        via its own counter."""
         return (self.changes - self._batch_rows_seen
                 + self._batch_frames_done + self.blobs
+                + self.reconcile_frames
                 - (1 if self._current_blob is not None else 0))
 
     def _checkpoint_digest(self) -> dict:
@@ -943,6 +962,17 @@ class Decoder:
                         return
                     if self._pbatch is not None or self._stalled():
                         return
+                elif type_id == TYPE_RECONCILE:
+                    # same advance-before-dispatch doctrine; delivery is
+                    # whole-frame, so only a stall can park the index
+                    f += 1
+                    self._missing = 0
+                    self._finish_reconcile(buf[start : start + flen])
+                    if self.destroyed:
+                        self._bulk = None
+                        return
+                    if self._stalled():
+                        return
                 elif type_id == TYPE_BLOB:
                     if not st["blob_open"]:
                         self._state = TYPE_BLOB
@@ -1150,6 +1180,8 @@ class Decoder:
             return self._blob_data(chunk)
         if self._state == TYPE_CHANGE_BATCH:
             return self._batch_data(chunk)
+        if self._state == TYPE_RECONCILE:
+            return self._reconcile_data(chunk)
         raise AssertionError(f"bad parser state {self._state}")
 
     def _scan_header(self, chunk: memoryview) -> memoryview | None:
@@ -1184,6 +1216,9 @@ class Decoder:
                     self._payload_parts = None
                 elif type_id == TYPE_CHANGE_BATCH:
                     self._state = TYPE_CHANGE_BATCH
+                    self._payload_parts = None
+                elif type_id == TYPE_RECONCILE:
+                    self._state = TYPE_RECONCILE
                     self._payload_parts = None
                 elif type_id == TYPE_BLOB:
                     self._state = TYPE_BLOB
@@ -1287,17 +1322,21 @@ class Decoder:
 
     # -- ChangeBatch frames --------------------------------------------------
 
-    def _batch_data(self, chunk: memoryview) -> memoryview | None:
-        """Accumulate a ChangeBatch frame's payload (same slicing as
-        :meth:`_change_data`; batches are routinely larger than one
-        transport chunk, so the slow path here is ordinary)."""
+    def _sized_payload_data(self, chunk: memoryview,
+                            finish) -> memoryview | None:
+        """Accumulate one whole-payload frame across transport chunks
+        and hand the complete payload to ``finish`` — the shared
+        parse/requeue discipline of ChangeBatch and reconcile frames
+        (same slicing as :meth:`_change_data`, which keeps its own copy:
+        per-record changes are the hot path and must not pay a callback
+        indirection per frame)."""
         if self._payload_parts is None and len(chunk) >= self._missing:
             payload = chunk[: self._missing]
             rest = chunk[self._missing :]
             self._parsed += self._missing
             self._missing = 0
             try:
-                self._finish_change_batch(payload)
+                finish(payload)
             except BaseException:
                 self._requeue_tail(rest)  # handler raise: keep the tail
                 raise
@@ -1312,11 +1351,14 @@ class Decoder:
         if self._missing == 0:
             parts, self._payload_parts = self._payload_parts, None
             try:
-                self._finish_change_batch(b"".join(parts))
+                finish(b"".join(parts))
             except BaseException:
                 self._requeue_tail(rest)  # handler raise: keep the tail
                 raise
         return rest
+
+    def _batch_data(self, chunk: memoryview) -> memoryview | None:
+        return self._sized_payload_data(chunk, self._finish_change_batch)
 
     def _finish_change_batch(self, payload) -> None:
         """Decode one complete ChangeBatch payload and start dispatching
@@ -1447,6 +1489,47 @@ class Decoder:
             if row >= n and self._pbatch is pb:
                 self._pbatch = None
                 self._batch_frames_done += 1
+
+    # -- reconcile frames ----------------------------------------------------
+
+    def _reconcile_data(self, chunk: memoryview) -> memoryview | None:
+        return self._sized_payload_data(chunk, self._finish_reconcile)
+
+    def _finish_reconcile(self, payload) -> None:
+        """Decode one complete reconcile payload and dispatch it whole.
+
+        Structural corruption (bad subtype/version, truncated symbol
+        run, trailing bytes) destroys the session with a ProtocolError
+        exactly like a corrupt Change payload — the fault-injection
+        contract: a reconcile session fails STRUCTURED, never decodes a
+        wrong diff from a torn frame."""
+        from ..wire import reconcile_codec
+
+        try:
+            msg = reconcile_codec.decode_reconcile(payload)
+        except ValueError as e:
+            self.destroy(self._protocol_error(str(e), cause=e))
+            return
+        if _OBS.on:
+            _M_DEC_RC_FRAMES.inc()
+            _trace_instant("decoder.frame", offset=self._frame_start,
+                           kind="reconcile",
+                           wire_len=_header_len(len(payload))
+                           + len(payload))
+        self._state = TYPE_HEADER
+        # delivery consumes the frame BEFORE the handler can raise (the
+        # change/blob doctrine): a caught raise-then-resume re-enters at
+        # the next frame, never re-delivering this message
+        self.reconcile_frames += 1
+        if self._on_reconcile is not None:
+            ack = _FastAck(self)
+            self._on_reconcile(msg, ack)
+            if ack.state != 1:
+                with self._ack_lock:
+                    if ack.state == 0:
+                        ack.state = 2  # armed: handler went async
+                        self._pending += 1
+        # default: drop (the unhandled-changes doctrine)
 
     # -- blob frames ---------------------------------------------------------
 

@@ -36,8 +36,9 @@ from ..obs.metrics import OBS as _OBS, counter as _counter, \
 from ..obs.tracing import trace_instant as _trace_instant
 from ..wire.change_codec import Change, _check_uint32, \
     _encode_change_with, _fastpath_mod, encode_change
-from ..wire.framing import CAP_CHANGE_BATCH, TYPE_BLOB, TYPE_CHANGE, \
-    TYPE_CHANGE_BATCH, frame_header, frame_wire_len
+from ..wire.framing import CAP_CHANGE_BATCH, CAP_RECONCILE, TYPE_BLOB, \
+    TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_RECONCILE, frame_header, \
+    frame_wire_len
 
 OnDone = Optional[Callable[[], None]]
 
@@ -58,6 +59,11 @@ _H_ENC_PARK = _histogram("encoder.park.seconds")
 _M_BATCH_FRAMES = _counter("wire.batch.frames")
 _M_BATCH_ROWS = _counter("wire.batch.rows")
 _M_BATCH_SAVED = _counter("wire.batch.bytes_saved")
+# negotiated reconcile frames (OBSERVABILITY.md "reconcile.*"): control
+# + symbol-run frames emitted, and their total wire volume — the
+# anti-entropy protocol's entire communication cost rides these
+_M_RC_FRAMES = _counter("reconcile.frames")
+_M_RC_WIRE = _counter("reconcile.wire_bytes")
 
 DEFAULT_HIGH_WATER = 64 * 1024
 
@@ -562,6 +568,43 @@ class Encoder:
                            wire_len=len(header) + len(payload))
         self._push(header, None)
         return self._push(payload, on_flush)
+
+    def reconcile_frame(self, payload, on_flush: OnDone = None) -> bool:
+        """Frame one reconcile protocol message (``TYPE_RECONCILE``;
+        payload built by :mod:`..wire.reconcile_codec`).
+
+        Strictly negotiated: raises unless the receiving peer advertised
+        ``CAP_RECONCILE`` — an un-negotiated encoder therefore emits the
+        reference wire byte-exactly (same golden contract as
+        ChangeBatch).  Pending batch rows flush first (frame order is
+        submission order); an open blob is an API error — a control
+        frame cannot be parked behind a streaming payload without
+        reordering the wire, and the reconcile driver never interleaves
+        the two."""
+        if self.destroyed:
+            raise EncoderDestroyedError("reconcile_frame after destroy")
+        if self.finalized:
+            raise EncoderDestroyedError("reconcile_frame after finalize")
+        if not (self.peer_caps & CAP_RECONCILE):
+            raise ValueError(
+                "peer did not advertise CAP_RECONCILE; reconcile frames "
+                "cannot be emitted to it (WIRE.md capability negotiation)"
+            )
+        if self._open_blobs:
+            raise ValueError(
+                "reconcile_frame with a blob open is unsupported"
+            )
+        if self._batch_rows:
+            self.flush_batch()
+        payload = bytes(payload)
+        header = frame_header(len(payload), TYPE_RECONCILE)
+        if _OBS.on:
+            _M_RC_FRAMES.inc()
+            _M_RC_WIRE.inc(len(header) + len(payload))
+            _trace_instant("encoder.frame", offset=self.bytes,
+                           kind="reconcile",
+                           wire_len=len(header) + len(payload))
+        return self._push(header + payload, on_flush)
 
     def blob(self, length: int, on_flush: OnDone = None) -> BlobWriter:
         """Open a streamed blob of exactly ``length`` bytes. The length is

@@ -397,6 +397,48 @@ def run_subscriber(conn: socket.socket, fanout, key: str) -> dict:
     return out
 
 
+def run_reconcile_session(conn_read, conn_write, close_write,
+                          replica, peer: str = "?") -> dict:
+    """Serve one anti-entropy session (ISSUE 10): the client is the
+    reconcile *initiator* streaming coded-symbol frames; this side
+    responds from ``replica`` (the ``--reconcile LOGFILE`` change log)
+    and the two exchange exactly the differing records.  Connecting to
+    a ``--reconcile`` sidecar IS the out-of-band capability
+    advertisement (WIRE.md): both directions speak
+    ``CAP_RECONCILE | CAP_CHANGE_BATCH``.
+
+    A failed decode (corrupt stream, exhausted symbols) surfaces as the
+    driver's ONE structured ProtocolError; the client observes the FAIL
+    frame + EOF, never a hang."""
+    from .runtime.reconcile_driver import run_responder
+    from .wire.framing import ProtocolError
+
+    try:
+        stats = run_responder(replica, conn_read, conn_write,
+                              close_write=close_write)
+        out = {"reconcile": True, "ok": stats["ok"],
+               "symbols": stats["symbols"], "rounds": stats["rounds"],
+               "records_sent": stats["records_sent"],
+               "records_received": len(stats["received"])}
+    except (ProtocolError, OSError) as e:
+        out = {"reconcile": True, "ok": False, "peer": peer,
+               "error": f"{type(e).__name__}: {e}"}
+    if _OBS.on:
+        _M_SESSIONS.inc()
+        _emit("sidecar.session", **out)
+    return out
+
+
+def load_reconcile_replica(path: str):
+    """Build the sidecar's replica from a change-log wire file
+    (per-record and/or ChangeBatch frames — ``replay.replay_log``'s
+    input contract)."""
+    from .runtime.reconcile_driver import RatelessReplica
+
+    with open(path, "rb") as f:
+        return RatelessReplica(f.read())
+
+
 def serve_stdio(drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
     """One session over stdin/stdout (logs go to stderr only)."""
     # close_write can fire from the session thread (drain-timeout
@@ -441,7 +483,8 @@ def serve_tcp(host: str, port: int,
               max_sessions: int | None = None,
               ready_cb=None,
               drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT,
-              retry_policy=None, hub=None, fanout=None) -> None:
+              retry_policy=None, hub=None, fanout=None,
+              reconcile_replica=None) -> None:
     """Accept loop: one concurrent session per connection.
 
     ``max_sessions`` bounds the loop for tests; ``ready_cb(port)`` fires
@@ -515,6 +558,19 @@ def serve_tcp(host: str, port: int,
 
             def _one(conn=conn, peer=peer, n=served):
                 try:
+                    if reconcile_replica is not None:
+                        # anti-entropy mode (ISSUE 10): every connection
+                        # is one reconcile initiator against the shared
+                        # replica (read-only state: sessions never step
+                        # on each other)
+                        stats = run_reconcile_session(
+                            conn.recv, conn.sendall,
+                            lambda: conn.shutdown(socket.SHUT_WR),
+                            reconcile_replica,
+                            peer=f"{peer[0]}:{peer[1]}")
+                        print(f"sidecar: {peer} {stats}", file=sys.stderr,
+                              flush=True)
+                        return
                     is_source = False
                     if fanout is not None and not fanout.log.sealed:
                         with src_lock:
@@ -785,6 +841,14 @@ def main(argv=None) -> int:
                    metavar="SECONDS",
                    help="shed a fan-out peer making no delivery "
                         "progress for this long (default: 30)")
+    p.add_argument("--reconcile", metavar="LOGFILE", default=None,
+                   help="anti-entropy mode: serve every connection as a "
+                        "rateless-reconciliation responder against the "
+                        "change-log wire file LOGFILE — the client "
+                        "streams coded symbols, both sides exchange "
+                        "exactly the differing records (O(diff) wire "
+                        "bytes; see DESIGN.md anti-entropy, WIRE.md "
+                        "Reconcile)")
     p.add_argument("--max-retries", type=int, default=5, metavar="N",
                    help="transient-failure budget: bind/accept errors are "
                         "retried with backoff at most N times before the "
@@ -867,13 +931,35 @@ def main(argv=None) -> int:
             window_bytes=args.fanout_window,
             stall_timeout=args.fanout_stall_timeout)
         set_active_fanout(fanout)
+    replica = None
+    if args.reconcile:
+        if args.hub or args.fanout:
+            p.error("--reconcile is its own session mode; it cannot "
+                    "combine with --hub/--fanout")
+        replica = load_reconcile_replica(args.reconcile)
     try:
         if args.stdio:
+            if replica is not None:
+                from .session.transport import once
+
+                def _swap_stdout() -> None:
+                    devnull = os.open(os.devnull, os.O_WRONLY)
+                    os.dup2(devnull, 1)
+                    os.close(devnull)
+
+                stats = run_reconcile_session(
+                    lambda n: os.read(0, n),
+                    lambda d: _write_all(1, d),
+                    once(_swap_stdout), replica, peer="stdio")
+                print(f"sidecar: stdio session {stats}", file=sys.stderr,
+                      flush=True)
+                return 0 if stats["ok"] else 1
             stats = serve_stdio(drain_timeout=drain)
             return 0 if stats["ok"] else 1
         host, _, port = args.tcp.rpartition(":")
         serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain,
-                  retry_policy=policy, hub=hub, fanout=fanout)
+                  retry_policy=policy, hub=hub, fanout=fanout,
+                  reconcile_replica=replica)
         return 0
     finally:
         if fanout is not None:

@@ -3,6 +3,7 @@
 from .change_codec import Change, decode_change, encode_change
 from .framing import (
     CAP_CHANGE_BATCH,
+    CAP_RECONCILE,
     KNOWN_TYPES,
     LOCAL_CAPS,
     MAX_HEADER_LEN,
@@ -10,26 +11,30 @@ from .framing import (
     TYPE_CHANGE,
     TYPE_CHANGE_BATCH,
     TYPE_HEADER,
+    TYPE_RECONCILE,
     ProtocolError,
     frame,
     frame_header,
 )
 from .varint import NeedMoreData, decode_uvarint, encode_uvarint, uvarint_length
 
-# batch_codec is imported lazily by its consumers (it needs numpy; the
-# bare protocol surface must stay importable without it on the path)
+# batch_codec / reconcile_codec are imported lazily by their consumers
+# (they need numpy; the bare protocol surface must stay importable
+# without it on the path)
 
 __all__ = [
     "Change",
     "decode_change",
     "encode_change",
     "CAP_CHANGE_BATCH",
+    "CAP_RECONCILE",
     "KNOWN_TYPES",
     "LOCAL_CAPS",
     "MAX_HEADER_LEN",
     "TYPE_BLOB",
     "TYPE_CHANGE",
     "TYPE_CHANGE_BATCH",
+    "TYPE_RECONCILE",
     "TYPE_HEADER",
     "ProtocolError",
     "frame",

@@ -24,8 +24,14 @@ TYPE_BLOB = 2
 # decoder receiving one fails with its standard unknown-type error,
 # which is exactly why the capability handshake exists.
 TYPE_CHANGE_BATCH = 3
+# Rateless reconciliation frame (negotiated extension, WIRE.md
+# "Reconcile"): coded-symbol runs and the begin/more/done/fail control
+# messages of the anti-entropy protocol (wire/reconcile_codec.py).
+# Same old-peer story as ChangeBatch: never emitted without
+# CAP_RECONCILE, unknown-type error otherwise.
+TYPE_RECONCILE = 4
 
-KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB, TYPE_CHANGE_BATCH)
+KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB, TYPE_CHANGE_BATCH, TYPE_RECONCILE)
 
 # -- capability negotiation (WIRE.md "Capability negotiation") --------------
 #
@@ -35,10 +41,11 @@ KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB, TYPE_CHANGE_BATCH)
 # later told via Encoder.negotiate) the intersection.  An encoder that
 # was never told anything assumes 0 — the reference wire, byte-exact.
 CAP_CHANGE_BATCH = 1  # peer parses TYPE_CHANGE_BATCH frames
+CAP_RECONCILE = 2  # peer parses TYPE_RECONCILE frames
 
 # Everything this package's Decoder can parse (the mask a receiver
 # advertises during session setup).
-LOCAL_CAPS = CAP_CHANGE_BATCH
+LOCAL_CAPS = CAP_CHANGE_BATCH | CAP_RECONCILE
 
 # Upper bound on header size: 10 varint bytes + 1 id byte.
 MAX_HEADER_LEN = MAX_VARINT_LEN + 1

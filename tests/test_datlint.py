@@ -446,6 +446,54 @@ def test_wire_parity_gear_constants_clean_when_agreeing(tmp_path):
                  ("native.cpp", GEAR_C_GOOD)) == []
 
 
+# Rateless reconciliation constants (ISSUE 10): the negotiation trio
+# (frame type / capability bit / payload version) plus the splitmix64
+# mapping constants written down independently in ops/rateless.py and
+# the native dat_rateless_build engine — a mapping fork is a route fork
+# (two engines assigning elements to different coded symbols, a symbol
+# stream that silently never decodes).
+RECONCILE_PY = '''
+TYPE_RECONCILE = 4
+CAP_RECONCILE = 2
+RECONCILE_VERSION = 1
+RATELESS_GAMMA = 0x9E3779B97F4A7C15
+RATELESS_MIX1 = 0xBF58476D1CE4E5B9
+RATELESS_MIX2 = 0x94D049BB133111EB
+'''
+
+RECONCILE_C_GOOD = '''
+// wire: TYPE_RECONCILE = 4
+// wire: RECONCILE_VERSION = 1
+// wire: RATELESS_GAMMA = 0x9E3779B97F4A7C15
+// wire: RATELESS_MIX1 = 0xBF58476D1CE4E5B9
+// wire: RATELESS_MIX2 = 0x94D049BB133111EB
+'''
+
+
+def test_wire_parity_covers_reconcile_constants(tmp_path):
+    bad = RECONCILE_C_GOOD.replace(
+        "TYPE_RECONCILE = 4", "TYPE_RECONCILE = 5").replace(
+        "RATELESS_GAMMA = 0x9E3779B97F4A7C15",
+        "RATELESS_GAMMA = 0x9E3779B97F4A7C16")
+    findings = _lint(tmp_path, ("rateless.py", RECONCILE_PY),
+                     ("native.cpp", bad))
+    drift = [f for f in findings if f.rule == "wire-constant-parity"]
+    assert {m.split("wire constant ")[1].split(" ")[0] for m in
+            (f.message for f in drift)} == {"TYPE_RECONCILE",
+                                            "RATELESS_GAMMA"}
+
+
+def test_wire_parity_reconcile_constants_clean_when_agreeing(tmp_path):
+    assert _lint(tmp_path, ("rateless.py", RECONCILE_PY),
+                 ("native.cpp", RECONCILE_C_GOOD)) == []
+
+
+def test_wire_parity_cap_reconcile_python_python_drift(tmp_path):
+    findings = _lint(tmp_path, ("a.py", "CAP_RECONCILE = 2\n"),
+                     ("b.py", "CAP_RECONCILE = 4\n"))
+    assert _rules_fired(findings) == {"wire-constant-parity"}
+
+
 def test_obs_discipline_covers_fused_route_telemetry(tmp_path):
     # the single-pass module's counters/engine notes carry the same
     # literal-name contract as every other telemetry site

@@ -1,0 +1,429 @@
+"""Rateless coded-symbol reconciliation (ISSUE 10): property layer.
+
+The decode contract under fuzz: across seeds and diff shapes
+(insertions, deletions, value flips; k = 0, 1, 17, 1000), peeling
+recovers EXACTLY the symmetric difference — never a wrong element,
+never a missed one — and the engines (numpy reference, native C,
+jitted JAX scatter-add) build byte-identical symbol prefixes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.ops import rateless as rl
+from dat_replication_protocol_tpu.runtime import native
+from dat_replication_protocol_tpu.wire import reconcile_codec as rc
+from dat_replication_protocol_tpu.wire.framing import (
+    CAP_RECONCILE,
+    ProtocolError,
+)
+
+
+def _digests(items) -> np.ndarray:
+    if not items:
+        return np.empty((0, 32), np.uint8)
+    return np.frombuffer(
+        b"".join(hashlib.blake2b(x, digest_size=32).digest() for x in items),
+        np.uint8,
+    ).reshape(-1, 32).copy()
+
+
+def _stream_decode(da: np.ndarray, db: np.ndarray, batch0: int = 16):
+    """A's symbols streamed to a decoder over B's set; returns
+    (digests, signs, symbols_sent)."""
+    syms = rl.CodedSymbols(rl.dedupe_digests(da)[0])
+    dec = rl.PeelDecoder(db)
+    m, sent = batch0, 0
+    while True:
+        dec.add_symbols(sent, syms.extend(m)[sent:])
+        sent = m
+        out = dec.try_decode()
+        if out is not None:
+            return out[0], out[1], sent
+        m *= 2
+        assert m <= 1 << 20, "decode never completed"
+
+
+def _diff_sets(da, db):
+    a = {bytes(d) for d in da}
+    b = {bytes(d) for d in db}
+    return a - b, b - a
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("k", [0, 1, 17])
+def test_peeling_recovers_exact_symmetric_difference(seed, k):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(50, 900))
+    base = [b"rec-%06d" % i for i in range(n)]
+    a_items = list(base)
+    b_items = list(base)
+    # spread k mutations across all three shapes
+    for i in range(k):
+        which = (seed + i) % 3
+        if which == 0 and b_items:  # deletion from b
+            b_items.pop(int(rng.integers(0, len(b_items))))
+        elif which == 1:  # insertion into b
+            b_items.insert(int(rng.integers(0, len(b_items) + 1)),
+                           b"new-%d-%d" % (seed, i))
+        else:  # value flip
+            at = int(rng.integers(0, len(b_items)))
+            b_items[at] = b_items[at] + b"~v2"
+    da, db = _digests(a_items), _digests(b_items)
+    got_d, got_s, sent = _stream_decode(da, db)
+    only_a, only_b = _diff_sets(da, db)
+    assert {bytes(d) for d, s in zip(got_d, got_s) if s == 1} == only_a
+    assert {bytes(d) for d, s in zip(got_d, got_s) if s == -1} == only_b
+    diff = len(only_a) + len(only_b)
+    if diff:
+        # rateless economy: the stream never runs past ~2x the decode
+        # point, and the decode point is a small multiple of the diff
+        assert sent <= max(64, 8 * diff)
+
+
+def test_k1000_diff_decodes_with_linear_symbols():
+    rng = np.random.default_rng(7)
+    n, k = 3000, 1000
+    base = rng.integers(0, 256, (n + k, 32), dtype=np.uint8)
+    da = base[:n].copy()  # drops the k tail
+    db = np.concatenate([base[k:n], base[n:]])  # drops head k, adds tail k
+    got_d, got_s, sent = _stream_decode(da, db, batch0=256)
+    only_a, only_b = _diff_sets(da, db)
+    assert {bytes(d) for d, s in zip(got_d, got_s) if s == 1} == only_a
+    assert {bytes(d) for d, s in zip(got_d, got_s) if s == -1} == only_b
+    assert len(got_d) == 2 * k
+    # wire economy at scale: <= ~2.2 symbols per differing element once
+    # the doubling schedule's overshoot is accounted
+    assert sent <= 2.5 * 2 * k
+
+
+def test_identical_sets_decode_empty_immediately():
+    da = _digests([b"x%d" % i for i in range(400)])
+    syms = rl.CodedSymbols(da)
+    dec = rl.PeelDecoder(da.copy())
+    dec.add_symbols(0, syms.extend(8))
+    out = dec.try_decode()
+    assert out is not None and len(out[0]) == 0
+
+
+def test_empty_vs_populated_bootstrap():
+    db = _digests([b"b%d" % i for i in range(120)])
+    got_d, got_s, _ = _stream_decode(_digests([]), db)
+    assert (got_s == -1).all() and len(got_d) == 120
+
+
+def test_duplicate_records_collapse_to_set_semantics():
+    # a duplicated record must not brick the decode (count-2 cells
+    # never peel): dedupe is part of the element contract
+    items = [b"dup"] * 5 + [b"u%d" % i for i in range(50)]
+    da = _digests(items)
+    uniq, first = rl.dedupe_digests(da)
+    assert len(uniq) == 51 and first[0] == 0
+    db = _digests([b"u%d" % i for i in range(50)])
+    got_d, got_s, _ = _stream_decode(da, db)
+    assert len(got_d) == 1 and got_s[0] == 1
+    assert bytes(got_d[0]) == hashlib.blake2b(
+        b"dup", digest_size=32).digest()
+
+
+def test_dedupe_resolves_first_word_collisions_exactly():
+    # two DISTINCT digests sharing their first 8 bytes must both
+    # survive dedupe (the u64 fast path may not silently merge them)
+    a = np.arange(32, dtype=np.uint8).reshape(1, 32)
+    b = a.copy()
+    b[0, 31] ^= 0xFF
+    d = np.concatenate([a, b, a])  # one true duplicate of a
+    uniq, first = rl.dedupe_digests(d)
+    assert len(uniq) == 2 and first.tolist() == [0, 1]
+
+
+# -- engine parity -----------------------------------------------------------
+
+
+def _parity_digests(n=257, seed=3):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, 32), dtype=np.uint8)
+
+
+def test_jax_build_matches_numpy_reference_byte_for_byte():
+    d = _parity_digests()
+    for schedule in [(64,), (16, 64, 192)]:
+        out = {}
+        for eng in ("numpy", "device"):
+            cs = rl.CodedSymbols(d, engine=eng)
+            for m in schedule:
+                cells = cs.extend(m)
+            out[eng] = np.asarray(cells)
+        assert out["numpy"].tobytes() == out["device"].tobytes(), schedule
+
+
+def test_native_build_matches_numpy_reference_byte_for_byte():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    d = _parity_digests(seed=4)
+    for schedule in [(64,), (16, 64, 192)]:
+        out = {}
+        for eng in ("numpy", "host"):
+            cs = rl.CodedSymbols(d, engine=eng)
+            for m in schedule:
+                cells = cs.extend(m)
+            out[eng] = np.asarray(cells)
+        assert out["numpy"].tobytes() == out["host"].tobytes(), schedule
+
+
+def test_index_cursor_is_incremental_and_deterministic():
+    d = _parity_digests(64, seed=9)
+    c1 = rl.IndexCursor(d)
+    e1, i1 = c1.advance(256)
+    c2 = rl.IndexCursor(d)
+    parts = [c2.advance(16), c2.advance(64), c2.advance(256)]
+    e2 = np.concatenate([p[0] for p in parts])
+    i2 = np.concatenate([p[1] for p in parts])
+    # same multiset of participations regardless of schedule
+    a = sorted(zip(e1.tolist(), i1.tolist()))
+    b = sorted(zip(e2.tolist(), i2.tolist()))
+    assert a == b
+    # every element participates at index 0 (the paper's construction)
+    assert set(e1[i1 == 0].tolist()) == set(range(64))
+
+
+# -- payload codec -----------------------------------------------------------
+
+
+def test_codec_roundtrips():
+    cells = np.arange(33, dtype=np.uint32).reshape(3, 11)
+    digs = np.arange(64, dtype=np.uint8).reshape(2, 32)
+    for payload, checks in [
+        (rc.encode_begin(12), dict(kind=rc.RC_BEGIN, n=12)),
+        (rc.encode_symbols(7, cells), dict(kind=rc.RC_SYMBOLS, start=7)),
+        (rc.encode_done(9, digs), dict(kind=rc.RC_DONE, n=9)),
+        (rc.encode_more(5), dict(kind=rc.RC_MORE, n=5)),
+        (rc.encode_fail(3, "why"), dict(kind=rc.RC_FAIL, n=3,
+                                        reason="why")),
+    ]:
+        msg = rc.decode_reconcile(payload)
+        for k, v in checks.items():
+            assert getattr(msg, k) == v, (k, payload)
+    msg = rc.decode_reconcile(rc.encode_symbols(7, cells))
+    assert np.array_equal(msg.cells, cells)
+    msg = rc.decode_reconcile(rc.encode_done(9, digs))
+    assert np.array_equal(msg.digests, digs)
+
+
+@pytest.mark.parametrize("payload", [
+    b"",                                   # empty
+    bytes([9]),                            # unknown subtype
+    bytes([rc.RC_BEGIN, 99, 1]),           # bad version
+    rc.encode_begin(3) + b"x",             # trailing bytes
+    rc.encode_symbols(0, np.zeros((2, 11), np.uint32))[:-3],  # torn cells
+    rc.encode_done(1, np.zeros((2, 32), np.uint8))[:-1],      # torn digest
+    rc.encode_more(1) + b"\x00",           # trailing bytes
+])
+def test_codec_rejects_structural_corruption(payload):
+    with pytest.raises(ValueError):
+        rc.decode_reconcile(payload)
+
+
+# -- session-layer integration ----------------------------------------------
+
+
+def test_unnegotiated_encoder_refuses_reconcile_frames_and_stays_golden():
+    # the golden contract: an encoder that was never told CAP_RECONCILE
+    # cannot emit a reconcile frame at all, so its wire is the
+    # reference wire byte-exactly (same doctrine as ChangeBatch)
+    e = protocol.encode()
+    with pytest.raises(ValueError, match="CAP_RECONCILE"):
+        e.reconcile_frame(rc.encode_begin(1))
+    e.change({"key": "a", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    wire = e.read()
+    ref = protocol.encode()
+    ref.change({"key": "a", "change": 1, "from": 0, "to": 1})
+    ref.finalize()
+    assert wire == ref.read()  # byte-exact: the refusal left no residue
+
+
+def test_decoder_advertises_cap_reconcile():
+    assert protocol.Decoder.capabilities() & CAP_RECONCILE
+
+
+def test_reconcile_frames_count_in_frame_accounting():
+    e = protocol.encode(peer_caps=CAP_RECONCILE)
+    d = protocol.decode()
+    seen = []
+    d.reconcile(lambda m, done: (seen.append(m), done()))
+    e.change({"key": "x", "change": 1, "from": 0, "to": 1})
+    e.reconcile_frame(rc.encode_more(1))
+    e.change({"key": "y", "change": 2, "from": 0, "to": 1})
+    e.finalize()
+    wire = e.read()
+    for off in range(0, len(wire), 5):
+        d.write(wire[off:off + 5])
+    d.end()
+    assert d.finished and len(seen) == 1
+    assert d.reconcile_frames == 1
+    assert d._frames_delivered() == 3
+    ckpt = d.checkpoint()
+    assert ckpt.frame == 3 and ckpt.wire_offset == len(wire)
+
+
+def test_unhandled_reconcile_frames_drop_without_deadlock():
+    e = protocol.encode(peer_caps=CAP_RECONCILE)
+    d = protocol.decode()  # no reconcile handler registered
+    e.reconcile_frame(rc.encode_begin(4))
+    e.change({"key": "x", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    d.write(e.read())
+    d.end()
+    assert d.finished and d.changes == 1 and d.reconcile_frames == 1
+
+
+def test_corrupt_reconcile_payload_is_structured_protocol_error():
+    from dat_replication_protocol_tpu.wire.framing import (
+        TYPE_RECONCILE,
+        frame,
+    )
+
+    d = protocol.decode()
+    errs = []
+    d.on_error(errs.append)
+    d.write(frame(TYPE_RECONCILE, bytes([250, 1])))
+    assert d.destroyed
+    assert isinstance(errs[0], ProtocolError)
+    assert errs[0].offset is not None and errs[0].frame == 0
+
+
+# -- driver-level convergence ------------------------------------------------
+
+
+def _mk_records(keys, flip=()):
+    return [{"key": k, "change": i, "from": i, "to": i + 1,
+             "value": (b"V2:" if k in flip else b"v:") + k.encode()}
+            for i, k in enumerate(keys)]
+
+
+def test_reconcile_local_converges_and_meters_wire():
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        reconcile_local,
+    )
+
+    keys = [f"key-{i:05d}" for i in range(800)]
+    flip = {"key-00013", "key-00777"}
+    a = RatelessReplica(_mk_records(keys + ["only-a-%d" % i
+                                            for i in range(3)]))
+    b = RatelessReplica(_mk_records(keys + ["only-b-%d" % i
+                                            for i in range(5)], flip=flip))
+    out = reconcile_local(a, b)
+    assert len(out["a_rows"]) == 3 + 2  # a-only + a's flipped versions
+    assert len(out["b_rows"]) == 5 + 2
+    # convergence: both sides end holding the identical record set
+    sa = {str(a.cols.row(i)) for i in range(len(a.cols))}
+    sb = {str(b.cols.row(i)) for i in range(len(b.cols))}
+    sa |= {str(out["b_cols"].row(i)) for i in range(len(out["b_cols"]))}
+    sb |= {str(out["a_cols"].row(i)) for i in range(len(out["a_cols"]))}
+    assert sa == sb
+    # O(diff) wire: a few KiB against a log of 800 records
+    assert out["wire_bytes"] < 64 * len(a.cols)
+    assert out["wire_bytes"] == out["wire_a2b"] + out["wire_b2a"]
+
+
+def test_live_duplex_drivers_converge_over_socketpair():
+    import socket
+    import threading
+
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        run_initiator,
+        run_responder,
+    )
+
+    keys = [f"k-{i:04d}" for i in range(300)]
+    a = RatelessReplica(_mk_records(keys + ["a-extra"]))
+    b = RatelessReplica(_mk_records(keys + ["b-extra-1", "b-extra-2"]))
+    s1, s2 = socket.socketpair()
+    box = {}
+
+    def responder():
+        box["r"] = run_responder(
+            b, s2.recv, s2.sendall,
+            close_write=lambda: s2.shutdown(socket.SHUT_WR))
+
+    t = threading.Thread(target=responder, daemon=True)
+    t.start()
+    ri = run_initiator(a, s1.recv, s1.sendall,
+                       close_write=lambda: s1.shutdown(socket.SHUT_WR))
+    t.join(20)
+    assert not t.is_alive(), "responder hung"
+    rr = box["r"]
+    assert ri["ok"] and rr["ok"]
+    assert ri["records_sent"] == 1 and rr["records_sent"] == 2
+    assert {c.key for c in ri["received"]} == {"b-extra-1", "b-extra-2"}
+    assert {c.key for c in rr["received"]} == {"a-extra"}
+    s1.close()
+    s2.close()
+
+
+def test_responder_state_fails_structured_on_symbol_exhaustion():
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        ResponderState,
+    )
+
+    b = RatelessReplica(_mk_records([f"k{i}" for i in range(40)]))
+    state = ResponderState(b, overhead_cap=0.01)
+    assert state.handle(rc.decode_reconcile(rc.encode_begin(40))) == []
+    # garbage symbols that can never peel: cap trips -> FAIL reply +
+    # ONE structured error from result()
+    junk = np.arange(400 * 11, dtype=np.uint32).reshape(400, 11)
+    replies = state.handle(
+        rc.decode_reconcile(rc.encode_symbols(0, junk)))
+    assert len(replies) == 1
+    assert rc.decode_reconcile(replies[0]).kind == rc.RC_FAIL
+    with pytest.raises(ProtocolError) as ei:
+        state.result()
+    assert ei.value.offset is not None
+
+
+def test_responder_symbol_budget_is_independent_of_claimed_n():
+    """A byzantine initiator claiming an astronomically large set must
+    not move the responder's resource bound: the absolute max_symbols
+    budget WINS over the claim-scaled overhead cap, and the session
+    fails structured instead of growing without limit (the hub/fanout
+    overload doctrine, restated for anti-entropy)."""
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        ResponderState,
+    )
+
+    b = RatelessReplica(_mk_records([f"k{i}" for i in range(20)]))
+    state = ResponderState(b, max_symbols=500)
+    state.handle(rc.decode_reconcile(rc.encode_begin(1 << 50)))
+    junk = np.arange(256 * 11, dtype=np.uint32).reshape(256, 11)
+    replies = state.handle(
+        rc.decode_reconcile(rc.encode_symbols(0, junk)))
+    assert rc.decode_reconcile(replies[0]).kind == rc.RC_MORE
+    replies = state.handle(
+        rc.decode_reconcile(rc.encode_symbols(256, junk)))
+    assert rc.decode_reconcile(replies[0]).kind == rc.RC_FAIL
+    with pytest.raises(ProtocolError):
+        state.result()
+
+
+def test_responder_state_rejects_symbols_before_begin():
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        ResponderState,
+    )
+
+    state = ResponderState(RatelessReplica(_mk_records(["a"])))
+    replies = state.handle(rc.decode_reconcile(
+        rc.encode_symbols(0, np.zeros((1, 11), np.uint32))))
+    assert rc.decode_reconcile(replies[0]).kind == rc.RC_FAIL
+    with pytest.raises(ProtocolError):
+        state.result()

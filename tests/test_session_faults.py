@@ -473,6 +473,213 @@ def test_flip_inside_batch_column_block_never_hangs():
             [ev for ev in _BATCH_EXPECTED if ev[0] == "change"])
 
 
+# -- rateless reconciliation under chaos (ISSUE 10) --------------------------
+#
+# The anti-entropy contract: a faulted symbol stream either completes
+# with the EXACT symmetric difference after resume, or raises ONE
+# structured ProtocolError — never a wrong diff.  The initiator's wire
+# (BEGIN + paced symbol batches + the requested records as ChangeBatch
+# frames) is recorded once from a healthy run and replayed through the
+# fault injector into a fresh responder per seed.
+
+
+def _build_reconcile_wire():
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        ResponderState,
+    )
+    from dat_replication_protocol_tpu.wire import reconcile_codec as rcc
+    from dat_replication_protocol_tpu.wire.framing import CAP_CHANGE_BATCH, \
+        CAP_RECONCILE
+
+    keys = [f"rc-{i:04d}" for i in range(150)]
+    a_recs = [{"key": k, "change": i, "from": i, "to": i + 1,
+               "value": b"v:" + k.encode()}
+              for i, k in enumerate(keys + ["a-only-1", "a-only-2"])]
+    b_recs = [{"key": k, "change": i, "from": i, "to": i + 1,
+               "value": b"v:" + k.encode()}
+              for i, k in enumerate(keys + ["b-only-1"])]
+    a = RatelessReplica(a_recs)
+    state = ResponderState(RatelessReplica(b_recs))
+    e = protocol.encode(peer_caps=CAP_RECONCILE | CAP_CHANGE_BATCH)
+    j = WireJournal()
+    e.attach_journal(j)
+    payload = rcc.encode_begin(a.n)
+    e.reconcile_frame(payload)
+    state.handle(rcc.decode_reconcile(payload))
+    syms = a.coded_symbols()
+    sent, m = 0, 16
+    while True:
+        payload = rcc.encode_symbols(sent, syms.extend(m)[sent:])
+        e.reconcile_frame(payload)
+        sent = m
+        replies = state.handle(rcc.decode_reconcile(payload))
+        last = rcc.decode_reconcile(replies[-1])
+        if last.kind == rcc.RC_DONE:
+            rows = a.rows_for_digests(last.digests)
+            e.change_many(a.records_for_rows(rows))
+            break
+        assert last.kind == rcc.RC_MORE
+        m *= 2
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    return j.read_from(0), b_recs
+
+
+_RC_WIRE, _RC_B_RECS = _build_reconcile_wire()
+
+
+def _fresh_reconcile_responder():
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        ResponderState,
+    )
+
+    state = ResponderState(RatelessReplica(_RC_B_RECS))
+    dec = protocol.decode()
+    dec.reconcile(lambda msg, done: (state.handle(msg), done()))
+    dec.change(lambda c, done: (state.note_remote_record(c), done()))
+    return dec, state
+
+
+def _rc_expected():
+    dec, state = _fresh_reconcile_responder()
+    for off in range(0, len(_RC_WIRE), 777):
+        dec.write(_RC_WIRE[off:off + 777])
+    dec.end()
+    assert dec.finished
+    digests, signs = state.result()
+    diff = sorted((bytes(d), int(s)) for d, s in zip(digests, signs))
+    recs = sorted(str(c) for c in state.remote_records)
+    assert len(diff) == 3 and len(recs) == 2  # 2 a-only + 1 b-only
+    return diff, recs
+
+
+_RC_EXPECTED = _rc_expected()
+
+
+def _run_reconcile_seed(seed: int):
+    dec, state = _fresh_reconcile_responder()
+
+    def source(ckpt, failures):
+        remaining = len(_RC_WIRE) - ckpt.wire_offset
+        plan = FaultPlan.for_sweep(seed, remaining, attempt=failures)
+        return FaultyReader(bytes_reader(_RC_WIRE[ckpt.wire_offset:]), plan)
+
+    def drive():
+        return run_resumable(
+            source, dec,
+            BackoffPolicy(base=0.0005, cap=0.005, max_retries=8, seed=seed),
+            chunk_size=256,  # small chunks: faults land mid-symbol-run
+            expected_total=len(_RC_WIRE),
+            stall_timeout=HARD_TIMEOUT / 2,
+        )
+
+    try:
+        stats = _with_watchdog(drive)
+    except ProtocolError as e:
+        assert e.offset is not None, f"unstructured ProtocolError: {e}"
+        return None, None
+    try:
+        digests, signs = state.result()
+    except ProtocolError as e:
+        assert e.offset is not None, f"unstructured ProtocolError: {e}"
+        return None, None
+    diff = sorted((bytes(d), int(s)) for d, s in zip(digests, signs))
+    recs = sorted(str(c) for c in state.remote_records)
+    return stats, (diff, recs)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_sweep_reconcile_resumes_exact_diff(seed):
+    """Disconnect-class faults inside the symbol stream: every seed
+    must converge after resume with the EXACT symmetric difference and
+    the exact record set — a resumed symbol stream continues (the
+    decoder's accumulated symbols survive the transport), it never
+    restarts or double-counts a run."""
+    stats, out = _run_reconcile_seed(seed)
+    assert stats is not None, "disconnect-class fault must resume, not error"
+    assert out == _RC_EXPECTED
+
+
+@pytest.mark.slow
+def test_sweep_reconcile_soak_100_seeds():
+    wrong = []
+    for seed in range(20, 120):
+        stats, out = _run_reconcile_seed(seed)
+        if stats is not None and out != _RC_EXPECTED:
+            wrong.append(seed)  # the one outcome the contract forbids
+    assert not wrong, f"seeds {wrong} delivered a WRONG diff"
+
+
+def _rc_symbol_frame_extent():
+    """(payload_start, payload_len) of the first SYMBOLS frame."""
+    import numpy as np
+
+    from dat_replication_protocol_tpu.runtime import replay
+    from dat_replication_protocol_tpu.wire.framing import TYPE_RECONCILE
+
+    idx = replay.split_frames(np.frombuffer(_RC_WIRE, np.uint8))
+    rc_frames = np.nonzero(idx.ids == TYPE_RECONCILE)[0]
+    f = int(rc_frames[1])  # frame 0 is BEGIN; 1 is the first symbol run
+    return int(idx.starts[f]), int(idx.lens[f])
+
+
+def test_flip_inside_symbol_frame_never_delivers_wrong_diff():
+    """A flipped byte inside a coded-symbol run must end in ONE
+    structured ProtocolError (structural validation, a failed decode,
+    or the end-of-stream incompleteness check) — recovering a wrong
+    element needs a 64-bit checksum collision, so a completed decode is
+    trusted and must equal the truth."""
+    start, flen = _rc_symbol_frame_extent()
+    for probe in (0, 3, flen // 2, flen - 1):
+        flip_at = start + probe
+
+        def source(ckpt, failures, flip_at=flip_at):
+            plan = FaultPlan(seed=13, flip_at=flip_at - ckpt.wire_offset,
+                             flip_mask=0x20)
+            return FaultyReader(
+                bytes_reader(_RC_WIRE[ckpt.wire_offset:]), plan)
+
+        dec, state = _fresh_reconcile_responder()
+        try:
+            _with_watchdog(lambda: run_resumable(
+                source, dec, BackoffPolicy(base=0, max_retries=0, seed=0),
+                expected_total=len(_RC_WIRE), stall_timeout=5))
+            digests, signs = state.result()
+        except ProtocolError as e:
+            assert e.offset is not None, f"unstructured: {e}"
+            continue
+        diff = sorted((bytes(d), int(s)) for d, s in zip(digests, signs))
+        assert diff == _RC_EXPECTED[0], f"flip at +{probe} changed the diff"
+
+
+def test_truncate_inside_symbol_frame_resumes_symbol_stream():
+    """Truncation mid-symbol-run: the resumed connection continues the
+    SAME symbol stream from the checkpoint byte — the peeler sees every
+    cell exactly once and decodes the exact diff."""
+    start, flen = _rc_symbol_frame_extent()
+    cut = start + flen // 2
+    calls = {"n": 0}
+
+    def source(ckpt, failures):
+        calls["n"] += 1
+        plan = FaultPlan(seed=17, truncate_at=(cut - ckpt.wire_offset)
+                         if failures == 0 else None)
+        return FaultyReader(bytes_reader(_RC_WIRE[ckpt.wire_offset:]), plan)
+
+    dec, state = _fresh_reconcile_responder()
+    stats = _with_watchdog(lambda: run_resumable(
+        source, dec, BackoffPolicy(base=0.0001, max_retries=2, seed=0),
+        expected_total=len(_RC_WIRE), stall_timeout=5))
+    assert calls["n"] == 2 and stats["reconnects"] == 1
+    digests, signs = state.result()
+    diff = sorted((bytes(d), int(s)) for d, s in zip(digests, signs))
+    assert diff == _RC_EXPECTED[0]
+    assert sorted(str(c) for c in state.remote_records) == _RC_EXPECTED[1]
+
+
 def test_payload_flip_is_undetected_at_wire_layer():
     """Documented failure-model limit (ROBUSTNESS.md): a flipped byte
     inside a blob payload does not violate framing — the session

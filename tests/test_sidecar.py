@@ -961,3 +961,98 @@ def test_fanout_misrouted_source_fails_loudly_not_silently():
         b.close()
     finally:
         fanout.close()
+
+
+# -- anti-entropy mode (ISSUE 10) ---------------------------------------------
+
+
+def test_tcp_sidecar_reconcile_exchanges_exact_diff(tmp_path):
+    """--reconcile shape: the daemon answers a reconcile initiator from
+    a change-log wire file — the two sides exchange exactly their
+    differing records over O(diff) wire, and every extra connection is
+    its own independent session against the shared (read-only)
+    replica."""
+    from dat_replication_protocol_tpu.runtime import replay
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        run_initiator,
+    )
+
+    def log_bytes(keys):
+        return replay.encode_change_log(
+            [{"key": k, "change": i, "from": i, "to": i + 1,
+              "value": b"v:" + k.encode()} for i, k in enumerate(keys)])
+
+    keys = [f"key-{i:05d}" for i in range(400)]
+    logfile = tmp_path / "srv_log.bin"
+    logfile.write_bytes(log_bytes(keys + ["srv-only-1", "srv-only-2"]))
+    client = RatelessReplica(log_bytes(keys + ["cli-only"]))
+
+    replica = sidecar.load_reconcile_replica(str(logfile))
+    ready = threading.Event()
+    port_box = {}
+    t = threading.Thread(
+        target=sidecar.serve_tcp,
+        args=("127.0.0.1", 0),
+        kwargs=dict(max_sessions=2, reconcile_replica=replica,
+                    ready_cb=lambda p: (port_box.__setitem__("p", p),
+                                        ready.set())),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    addr = ("127.0.0.1", port_box["p"])
+
+    for _ in range(2):  # a second session against the same replica
+        c = socket.create_connection(addr, timeout=10)
+        out = run_initiator(
+            client, c.recv, c.sendall,
+            close_write=lambda c=c: c.shutdown(socket.SHUT_WR))
+        c.close()
+        assert out["ok"]
+        assert out["records_sent"] == 1  # cli-only, requested by the daemon
+        assert {ch.key for ch in out["received"]} == {"srv-only-1",
+                                                      "srv-only-2"}
+    t.join(timeout=10)
+
+
+def test_sidecar_reconcile_corrupt_stream_fails_structured():
+    """A garbage initiator against --reconcile observes the FAIL frame
+    + EOF; the session record carries the structured error — never a
+    hang (the reconcile failure contract at the daemon edge)."""
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+    )
+    from dat_replication_protocol_tpu.session.transport import once
+    from dat_replication_protocol_tpu.wire import reconcile_codec as rcc
+    from dat_replication_protocol_tpu.wire.framing import (
+        TYPE_RECONCILE,
+        frame,
+    )
+
+    replica = RatelessReplica([
+        {"key": "a", "change": 1, "from": 0, "to": 1, "value": b"x"}])
+    a, b = socket.socketpair()
+    a.settimeout(10)
+    b.settimeout(10)
+    box = {}
+
+    def serve():
+        box["out"] = sidecar.run_reconcile_session(
+            b.recv, b.sendall,
+            once(lambda: b.shutdown(socket.SHUT_WR)), replica)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    # symbols with a bad subtype byte: structural corruption
+    a.sendall(frame(TYPE_RECONCILE, rcc.encode_begin(1)))
+    a.sendall(frame(TYPE_RECONCILE, bytes([99, 1, 2, 3])))
+    a.shutdown(socket.SHUT_WR)
+    _recv_all(a)  # daemon closes its side: EOF, not a hang
+    t.join(10)
+    assert not t.is_alive()
+    out = box["out"]
+    assert out["reconcile"] is True and out["ok"] is False
+    assert "error" in out
+    a.close()
+    b.close()
