@@ -193,3 +193,60 @@ EOF
     && touch "$OUT/.leg_rateless_done"
   commit_out "r06 watch: rateless coded-symbol device build capture ($STAMP)"
 fi
+
+# 8) ISSUE 11 fleet-plane device leg: the scrape endpoint serving LIVE
+#    device-leg telemetry — watermark links + jit_sites captured
+#    THROUGH /snapshot and /metrics while a device hash runs, proving
+#    the pull path works against real accelerator state (recompile
+#    sentinel entries, device.* counters) and costs the hot path
+#    nothing the overhead test didn't already bound on host.
+if [ ! -f "$OUT/.leg_fleet_done" ]; then
+  timeout 900 python - >"$OUT/fleet_dev_$STAMP.json" \
+      2>"$OUT/fleet_dev_$STAMP.log" <<'EOF'
+import json, time, urllib.request
+import numpy as np
+import jax
+from dat_replication_protocol_tpu.obs import metrics
+from dat_replication_protocol_tpu.obs.http import ObsHttpServer
+from dat_replication_protocol_tpu.obs.watermarks import WATERMARKS
+from dat_replication_protocol_tpu.runtime.content import content_digests
+
+metrics.enable()
+srv = ObsHttpServer(0).start()
+out = {"backend": jax.default_backend()}
+rng = np.random.default_rng(7)
+blob = rng.integers(0, 256, 256 << 20, dtype=np.uint8).tobytes()
+done = {"n": 0}
+WATERMARKS.track("append", "devleg", lambda: len(blob))
+WATERMARKS.track("parsed", "devleg", lambda: done["n"])
+t0 = time.perf_counter()
+cuts, digests = content_digests(blob)
+done["n"] = len(blob)
+dt = time.perf_counter() - t0
+snap = json.loads(urllib.request.urlopen(
+    srv.url + "/snapshot", timeout=10).read())
+prom = urllib.request.urlopen(srv.url + "/metrics", timeout=10).read()
+hz = json.loads(urllib.request.urlopen(
+    srv.url + "/healthz", timeout=10).read())
+srv.close()
+out.update({
+    "chunks": len(digests), "gib_s": round(len(blob) / dt / 2**30, 3),
+    "jit_sites": snap.get("jit_sites"),
+    "watermark_links": list((snap.get("watermarks") or {})
+                            .get("links", {})),
+    "prom_bytes": len(prom), "healthz_ok": hz.get("ok"),
+})
+print(json.dumps(out))
+EOF
+  grep -q '"watermark_links"' "$OUT/fleet_dev_$STAMP.json" \
+    && python - "$OUT/fleet_dev_$STAMP.json" <<'EOF' \
+    && touch "$OUT/.leg_fleet_done"
+import json, sys
+d = json.loads([l for l in open(sys.argv[1]) if l.strip()][-1])
+sys.exit(0 if d.get("backend") not in ("cpu", None) else 1)
+EOF
+  tail -c 16384 "$OUT/fleet_dev_$STAMP.log" \
+    >"$OUT/fleet_dev_$STAMP.log.tail" \
+    && rm -f "$OUT/fleet_dev_$STAMP.log"
+  commit_out "r06 watch: fleet-plane endpoint device capture ($STAMP)"
+fi

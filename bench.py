@@ -1209,6 +1209,9 @@ def bench_resume(quick: bool, backend: str) -> dict:
     enc = protocol.encode()
     journal = WireJournal()
     enc.attach_journal(journal)
+    # fleet-plane cursors (ISSUE 11): with --metrics the config's
+    # --fleet-snapshot view carries this link's append/acked offsets
+    journal.watermark("bench-resume")
     for i in range(rows):
         enc.change({"key": f"key-{i:07d}", "change": i, "from": i,
                     "to": i + 1, "value": b"v" * (i % 48)})
@@ -2125,6 +2128,40 @@ def _export_config_trace(name: str, trace_dir) -> None:
         log(f"bench: config {name} trace export failed ({e})")
 
 
+def _export_config_fleet(name: str, fleet_dir) -> None:
+    """--fleet-snapshot artifact per config (ISSUE 11): the same JSON
+    record the sidecar's /snapshot endpoint serves — registry metrics,
+    jit_sites, watermark links — dumped under
+    <fleet_dir>/configs/<name>.fleet.json next to the --trace
+    artifacts, so a bench run leaves per-config fleet views an
+    `obs fleet` file target (or a human) can read directly.  Like the
+    trace export, content needs --metrics/DAT_OBS; dark runs dump an
+    honest near-empty shell."""
+    try:
+        if fleet_dir:
+            from dat_replication_protocol_tpu.obs.http import (
+                default_snapshot,
+            )
+
+            out = os.path.join(fleet_dir, "configs", f"{name}.fleet.json")
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(default_snapshot(), f, default=repr)
+                f.write("\n")
+            log(f"bench: config {name} fleet view -> {out}")
+    except Exception as e:  # an unwritable dir must not blank the run
+        log(f"bench: config {name} fleet export failed ({e})")
+    finally:
+        # like the per-config ring clears, and UNCONDITIONALLY (not
+        # only under --fleet-snapshot): a config's watermark links
+        # must not leak into the next config's snapshot, and a link's
+        # cursor closures must not pin the config's journal buffers
+        # for the rest of the run
+        from dat_replication_protocol_tpu.obs.watermarks import WATERMARKS
+
+        WATERMARKS.reset_for_tests()
+
+
 def _emit() -> None:
     """Print the one JSON artifact line from whatever has completed.
 
@@ -2163,6 +2200,7 @@ def main() -> None:
         _metrics_on()
     trace_dir = None
     flight_dir = None
+    fleet_dir = None
     args = sys.argv[1:]
     for i, arg in enumerate(args):
         if arg.startswith("--trace="):
@@ -2174,6 +2212,11 @@ def main() -> None:
         elif arg == "--flight-dir" and i + 1 < len(args) \
                 and not args[i + 1].startswith("-"):
             flight_dir = args[i + 1]
+        elif arg.startswith("--fleet-snapshot="):
+            fleet_dir = arg.split("=", 1)[1]
+        elif arg == "--fleet-snapshot" and i + 1 < len(args) \
+                and not args[i + 1].startswith("-"):
+            fleet_dir = args[i + 1]
     if flight_dir:
         # armed recorder: a stuck backend init (the watchdog below) or
         # any structured session error dumps a post-mortem bundle here
@@ -2210,6 +2253,10 @@ def main() -> None:
         try:
             res = fn(quick, backend)
             res["seconds"] = round(time.perf_counter() - t0, 2)
+            # fleet view BEFORE _attach_metrics: that call resets the
+            # registry, and the view's whole point is this config's
+            # live metrics + watermark links
+            _export_config_fleet(name, fleet_dir)
             _attach_metrics(res)
             _state["configs"][name] = res
             log(f"bench: config {key} ({name}) ok in {res['seconds']}s")
@@ -2217,6 +2264,7 @@ def main() -> None:
             log(f"bench: config {key} ({name}) FAILED: {e}")
             traceback.print_exc(file=sys.stderr)
             err_res = {"error": f"{type(e).__name__}: {e}"}
+            _export_config_fleet(name, fleet_dir)
             _attach_metrics(err_res)  # partial-work attribution
             _state["configs"][name] = err_res
         _export_config_trace(name, trace_dir)

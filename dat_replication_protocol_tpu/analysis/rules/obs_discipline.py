@@ -10,26 +10,39 @@ dynamic name becomes a brand-new metric instead of an error.
 
 Flagged shapes (Python sources only):
 
-* a call to a registry factory, event emitter, span opener, or
-  jit-site registration — ``counter(...)``, ``gauge(...)``,
-  ``histogram(...)``, ``emit(...)``, ``trace_span(...)``,
-  ``trace_instant(...)``, ``jit_site(...)`` (bare, aliased with
-  leading underscores, or as an attribute like ``EVENTS.emit``) —
-  whose first argument is not a string literal: span names carry the
-  SAME greppability contract as event names (ISSUE 4), and the
-  recompile sentinel's per-site names (ISSUE 5) the same again — the
-  sentinel's snapshot, ``device.jit.trace`` events, and the docs
-  catalog all key on them;
+* a call to a registry factory, event emitter, span opener, jit-site
+  registration, or watermark registration — ``counter(...)``,
+  ``gauge(...)``, ``histogram(...)``, ``emit(...)``,
+  ``trace_span(...)``, ``trace_instant(...)``, ``jit_site(...)``,
+  ``track(...)`` (bare, aliased with leading underscores, or as an
+  attribute like ``EVENTS.emit``) — whose first argument is not a
+  string literal: span names carry the SAME greppability contract as
+  event names (ISSUE 4), the recompile sentinel's per-site names
+  (ISSUE 5) the same again, and a watermark's ROLE (its first
+  argument, ISSUE 11) once more — the fleet aggregator's lag join
+  keys on the role vocabulary, so a runtime-built role is a silent
+  fork of the join itself (the LINK argument is runtime by design: it
+  names a session, like a collector label);
 * a bare ``print(...)`` (no ``file=`` keyword, i.e. stdout) anywhere
   in the package: stdout belongs to the wire/CLI protocol, and
   diagnostics belong in the structured event log (:mod:`...obs.events`)
-  or explicitly on stderr.
+  or explicitly on stderr;
+* in ``obs/http.py`` only: a ``/healthz``-serving function (name
+  contains ``healthz``) that takes ANY lock via ``with`` or makes a
+  device-dispatch-shaped call (the hub-isolation vocabulary).  The
+  health probe exists to detect a wedged engine; a probe that blocks
+  behind the engine's lock — or worse, touches the device — inverts
+  its purpose.  Owners feed admission state through LOCK-FREE
+  callables (``ReplicationHub.admission_state``) instead.
 
 Exemptions:
 
 * ``obs/metrics.py`` and ``obs/events.py`` themselves — the registry
   and the log legitimately forward ``name`` parameters; they are the
-  plumbing, not instrumentation sites;
+  plumbing, not instrumentation sites (likewise ``obs/watermarks.py``,
+  ``obs/http.py``, and ``obs/fleet.py``: the board renders labeled
+  names from tracked state, the endpoint and aggregator ship whole
+  snapshots — their callers hold the greppable literals);
 * ``__main__.py`` modules for the bare-print check — a CLI's stdout IS
   its interface (the datlint CLI prints findings there by design);
 * the standard ``# datlint: disable=obs-discipline`` suppression.
@@ -41,9 +54,10 @@ import ast
 from typing import Iterator
 
 from ..engine import Finding, Project
+from .hub_isolation import _dispatchy_call, _is_lock_ctx
 
 _TELEMETRY_FNS = {"counter", "gauge", "histogram", "emit",
-                  "trace_span", "trace_instant", "jit_site"}
+                  "trace_span", "trace_instant", "jit_site", "track"}
 # attribute-call receivers that denote the obs layer (normalized:
 # underscores stripped, lowercased) — `EVENTS.emit(...)`,
 # `obs_metrics.counter(...)`, `registry.histogram(...)`.  Unrelated
@@ -51,13 +65,18 @@ _TELEMETRY_FNS = {"counter", "gauge", "histogram", "emit",
 # `np.histogram(data, bins)`) must NOT trip the rule.
 _TELEMETRY_RECEIVERS = {"events", "metrics", "obs", "obs_events",
                         "obs_metrics", "obs_tracing", "registry", "reg",
-                        "spans", "tracing", "device", "obs_device"}
+                        "spans", "tracing", "device", "obs_device",
+                        "watermarks", "obs_watermarks", "board"}
 # the obs plumbing itself: (parent dir, filename) pairs exempt from the
 # literal-name check (they forward `name` parameters by design; the
 # greppable sites are their callers)
 _PLUMBING = {("obs", "metrics.py"), ("obs", "events.py"),
              ("obs", "tracing.py"), ("obs", "flight.py"),
-             ("obs", "device.py"), ("obs", "__init__.py")}
+             ("obs", "device.py"), ("obs", "__init__.py"),
+             ("obs", "watermarks.py"), ("obs", "http.py"),
+             ("obs", "fleet.py")}
+# the /healthz lock-discipline check applies to the endpoint module
+_HEALTHZ_MODULE = ("obs", "http.py")
 
 
 def _telemetry_fn_name(call: ast.Call) -> str | None:
@@ -105,6 +124,46 @@ class ObsDiscipline:
                     yield from self._check_literal_name(src, node)
                 if not is_cli:
                     yield from self._check_bare_print(src, node)
+            if tuple(parts[-2:]) == _HEALTHZ_MODULE:
+                yield from self._check_healthz_lockfree(src, tree)
+
+    def _check_healthz_lockfree(self, src, tree) -> Iterator[Finding]:
+        """The /healthz lock discipline (module docstring): any
+        function whose name mentions healthz must not take a lock or
+        make a device-dispatch-shaped call — reusing the hub-isolation
+        vocabulary for what 'dispatch-shaped' means."""
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if "healthz" not in fn.name.lower():
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.With) and \
+                        any(_is_lock_ctx(i) for i in sub.items):
+                    yield Finding(
+                        path=str(src.path), line=sub.lineno,
+                        rule=self.name,
+                        message=(
+                            f"{fn.name}() takes a lock: the /healthz "
+                            "probe must stay lock-free — a wedged "
+                            "engine holding that lock would wedge the "
+                            "very probe meant to detect it (owners "
+                            "expose lock-free admission_state views "
+                            "instead)"),
+                    )
+                elif isinstance(sub, ast.Call):
+                    offender = _dispatchy_call(sub)
+                    if offender is not None:
+                        yield Finding(
+                            path=str(src.path), line=sub.lineno,
+                            rule=self.name,
+                            message=(
+                                f"{offender}(...) in {fn.name}(): the "
+                                "/healthz probe must never touch the "
+                                "device or hub dispatch path — health "
+                                "is read from already-maintained "
+                                "state, not probed by new work"),
+                        )
 
     def _check_literal_name(self, src, call: ast.Call) -> Iterator[Finding]:
         fn_name = _telemetry_fn_name(call)

@@ -52,6 +52,7 @@ from ..obs.metrics import (
     histogram as _histogram,
 )
 from ..obs.tracing import trace_span as _trace_span
+from ..obs.watermarks import WATERMARKS as _WATERMARKS
 from .log import BroadcastLog, SnapshotNeeded
 
 __all__ = ["FanoutServer", "FanoutPeer", "FanoutBusy", "PeerShed"]
@@ -72,6 +73,12 @@ _WAKE_FALLBACK = 0.05
 # past the ring simply miss those samples (bounded memory by design)
 _MARK_RING = 1024
 _PEER_LAT_RING = 512
+
+# fleet-plane link for the shared broadcast wire (ISSUE 11): ONE marks
+# ring for the publish path (O(1) in peers by contract); per-peer links
+# alias it via marks_from so every peer's lag-in-seconds reads the same
+# sender clock
+_WM_LINK = "fanout"
 
 
 class FanoutBusy(RuntimeError):
@@ -250,6 +257,8 @@ class FanoutServer:
             if len(self._marks) == self._marks.maxlen:
                 self._mark_base += 1
             self._marks.append((end, now))
+        if _OBS.on:
+            _WATERMARKS.mark(_WM_LINK, end)
 
     def seal(self) -> None:
         """No more bytes: peers complete once fully delivered."""
@@ -331,6 +340,15 @@ class FanoutServer:
             st.mark_seq = self._mark_base + len(self._marks)
             self._peers[key] = st
             self._work.notify_all()
+            # fleet-plane watermarks: this peer's wire is one link —
+            # append is the shared log's frontier, delivered is the
+            # peer's transport position; seconds come from the shared
+            # publish marks ring (marks_from)
+            log = self.log
+            _WATERMARKS.track("append", f"fanout/{key}",
+                              lambda: log.end, marks_from=_WM_LINK)
+            _WATERMARKS.track("delivered", f"fanout/{key}",
+                              lambda st=st: st.sent)
             if _OBS.on:
                 _M_ATTACHED.inc()
                 _M_PEERS.set(len(self._peers))
@@ -358,6 +376,7 @@ class FanoutServer:
                 _M_PEERS.set(len(self._peers))
                 _emit("fanout.detach", key=st.key, sent=st.sent,
                       shed=st.shed)
+        _WATERMARKS.untrack(f"fanout/{st.key}")
         self.log.detach(st.cursor)
 
     def _ack_peer(self, st: _PeerState, offset: int) -> None:
@@ -627,6 +646,19 @@ class FanoutServer:
                 "sealed": self.log.sealed,
             }
 
+    def admission_state(self) -> dict:
+        """Lock-free admission view for ``/healthz`` (ISSUE 11): plain
+        attribute reads, at worst one update stale — the health probe
+        must never block behind the dispatcher's lock (the hub's
+        ``admission_state`` contract, restated for peers)."""
+        peers = len(self._peers)
+        return {
+            "open": not self._closed and peers < self.max_peers,
+            "peers": peers,
+            "max_peers": self.max_peers,
+            "sealed": self.log.sealed,
+        }
+
     def _collect(self) -> dict:
         """Registry collector: labeled per-peer entries for peers
         currently attached (bounded cardinality by construction — the
@@ -684,6 +716,11 @@ class FanoutServer:
                 os.close(fd)
             except OSError:
                 pass
+        with self._lock:
+            keys = list(self._peers)
+        for key in keys:
+            _WATERMARKS.untrack(f"fanout/{key}")
+        _WATERMARKS.untrack(_WM_LINK)
         _REGISTRY.unregister_collector("fanout", self._collector_fn)
 
     def __enter__(self) -> "FanoutServer":

@@ -777,6 +777,27 @@ class ReplicationHub:
                                 f"{self._failed}"),
             }
 
+    def admission_state(self) -> dict:
+        """Lock-free admission view for ``/healthz`` (ISSUE 11): plain
+        attribute reads only — GIL-atomic, at worst one update stale,
+        by design.  A health probe must never block behind the hub
+        lock: a wedged dispatcher holding it would turn the liveness
+        check itself into a hang, inverting its purpose.  The datlint
+        healthz check keeps the handler side of this contract honest;
+        this method is the hub's matching half."""
+        sessions = len(self._sessions)
+        parked = self._parked_bytes
+        return {
+            "open": (not self._closed and self._failed is None
+                     and sessions < self.max_sessions
+                     and parked < self.parked_budget // 2),
+            "sessions": sessions,
+            "max_sessions": self.max_sessions,
+            "parked_bytes": parked,
+            "parked_budget": self.parked_budget,
+            "failed": self._failed is not None,
+        }
+
     def _collect(self) -> dict:
         """Registry snapshot collector: labeled per-session entries for
         sessions currently alive (bounded cardinality by construction —

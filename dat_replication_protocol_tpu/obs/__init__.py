@@ -41,11 +41,19 @@ Four parts:
   ``bench.py --metrics`` artifact against checked-in per-metric
   budgets (``artifacts/perf_budgets.json``); the CLI's ``perf-check``
   exits nonzero on regression.
+* :mod:`.watermarks` / :mod:`.http` / :mod:`.fleet` — the fleet plane
+  (ISSUE 11): wire-position cursors exported as labeled gauges
+  (``append − parsed`` is exact replication lag in bytes; append
+  marks make lag-in-seconds clock-free), a read-only stdlib-HTTP
+  scrape endpoint (sidecar ``--obs-http``: ``/metrics`` ``/snapshot``
+  ``/healthz`` ``/events``), and the N-target aggregator behind
+  ``obs fleet`` (TTY dashboard, declarative SLO gate).
 
-Offline CLI: ``python -m dat_replication_protocol_tpu.obs`` merges two
+Offline CLI: ``python -m dat_replication_protocol_tpu.obs`` merges N
 peers' JSONL logs into one causally-ordered timeline (``timeline``),
 converts logs/bundles to Perfetto-loadable traces (``export-trace``),
-and pretty-prints bundles (``dump``).
+pretty-prints bundles (``dump``), and joins live replica targets into
+per-link lag (``fleet``).
 
 The fault injector (:mod:`..session.faults`) is the layer's
 correctness oracle: it emits ground-truth ``fault.*`` events for every
@@ -93,6 +101,7 @@ from .tracing import (
     trace_instant,
     trace_span,
 )
+from .watermarks import WATERMARKS, WatermarkBoard, link_lag
 
 __all__ = [
     "OBS",
@@ -128,4 +137,7 @@ __all__ = [
     "jit_site",
     "note_engine",
     "sample_device_gauges",
+    "WATERMARKS",
+    "WatermarkBoard",
+    "link_lag",
 ]

@@ -1,17 +1,24 @@
 """Offline telemetry CLI: causal timelines, trace export, bundle dumps.
 
-    python -m dat_replication_protocol_tpu.obs timeline SENDER.jsonl RECEIVER.jsonl
+    python -m dat_replication_protocol_tpu.obs timeline SENDER.jsonl RECEIVER.jsonl [PEER.jsonl ...]
     python -m dat_replication_protocol_tpu.obs export-trace LOG.jsonl|BUNDLE_DIR [-o OUT]
     python -m dat_replication_protocol_tpu.obs dump BUNDLE_DIR [--json]
     python -m dat_replication_protocol_tpu.obs perf-check BENCH.json [--budgets PATH] [--host-only]
+    python -m dat_replication_protocol_tpu.obs fleet TARGET... [--check SLO.json | --watch]
 
-``timeline`` merges two peers' JSONL event/span logs (written by
+``timeline`` merges N peers' JSONL event/span logs (written by
 ``obs.tracing.attach_jsonl_sink`` / ``EVENTS.attach_sink``) into ONE
 causally-ordered timeline keyed on wire offset — the byte offset every
 frame starts at is the same number on both sides of the wire, so a
 receiver record at offset X provably happened after the sender record
-at X, with no clock synchronization at all.  While merging it audits
-the frame streams and flags:
+at X, with no clock synchronization at all.  With exactly two logs the
+classic sender/receiver audit runs (unchanged output); with more, each
+log's emit/dispatch streams are audited independently and dispatch
+streams are paired with their emitting peer — by exact ``link`` label
+when frame records carry one, else by best coverage match (one emitter
+may serve many dispatchers: the fan-out shape) — the offline mirror of
+the fleet aggregator's live join.  While merging it audits the frame
+streams and flags:
 
 * ``gap``        — a hole in a peer's frame coverage (bytes never
                    emitted / never dispatched);
@@ -95,9 +102,18 @@ def _frames(records: list[dict]) -> list[dict]:
         out.append({
             "i": i, "seq": r.get("seq", i), "offset": off, "wire_len": wl,
             "frames": f.get("frames", 1), "kind": f.get("kind"),
-            "action": action, "name": name,
+            "action": action, "name": name, "link": f.get("link"),
         })
     return out
+
+
+def _stream_link(frames: list[dict]):
+    """The session label a frame stream carries (the first record's
+    ``link`` field), or None — the N-log pairing key."""
+    for fr in frames:
+        if fr.get("link"):
+            return fr["link"]
+    return None
 
 
 def _audit_role(role: str, frames: list[dict]) -> list[dict]:
@@ -153,13 +169,19 @@ def _record_offset(rec: dict) -> Optional[int]:
 
 
 def _merge_timeline(sender: list[dict], receiver: list[dict]) -> list[dict]:
-    """One causally-ordered merged timeline: primary key is the wire
-    offset (sender-before-receiver at equal offsets — emission causes
+    """Two-peer merge (the classic shape): delegates to the N-peer
+    merge with the canonical sender/receiver roles."""
+    return _merge_timeline_n([("sender", sender), ("receiver", receiver)])
+
+
+def _merge_timeline_n(peers: list[tuple[str, list[dict]]]) -> list[dict]:
+    """One causally-ordered merged timeline over N peers: primary key
+    is the wire offset (earlier-listed peers first at equal offsets —
+    CLI order puts emitters before their dispatchers, emission causes
     dispatch); records without an offset of their own inherit the last
     offset seen in their file, preserving their local order."""
     rows: list[dict] = []
-    for rank, (role, records) in enumerate(
-            (("sender", sender), ("receiver", receiver))):
+    for rank, (role, records) in enumerate(peers):
         last = 0
         for i, r in enumerate(records):
             off = _record_offset(r)
@@ -181,7 +203,107 @@ def _merge_timeline(sender: list[dict], receiver: list[dict]) -> list[dict]:
     return rows
 
 
+def _timeline_n(paths: list[str], json_out: bool) -> int:
+    """The N-log merge (>= 3 peers): audit every file's emit/dispatch
+    streams independently, pair each dispatch stream with its emitting
+    peer (exact ``link`` label first, best coverage match as fallback —
+    one emitter may serve many dispatchers, the fan-out shape), flag
+    per-pair divergence, and merge everything onto the one wire-offset
+    axis.  The offline mirror of the fleet aggregator's live join."""
+    names: list[str] = []
+    for p in paths:
+        base = os.path.basename(p)
+        # duplicate basenames must stay distinguishable in roles
+        names.append(base if base not in names else p)
+    files = [(name, _load_jsonl(p)) for name, p in zip(names, paths)]
+    flags: list[dict] = []
+    streams = []
+    for name, records in files:
+        by = {a: [f for f in _frames(records) if f["action"] == a]
+              for a in ("emit", "dispatch")}
+        for action, frames in by.items():
+            flags.extend(_audit_role(f"{name}:{action}", frames))
+        streams.append({"name": name, "records": records, "by": by})
+    emitters = [s for s in streams if s["by"]["emit"]]
+    links: list[dict] = []
+    for s in streams:
+        disp = s["by"]["dispatch"]
+        if not disp:
+            continue
+        cands = [e for e in emitters if e is not s]
+        if not cands:
+            flags.append({
+                "flag": "peer-divergence", "role": f"{s['name']}:dispatch",
+                "offset": 0,
+                "detail": f"{s['name']} dispatched frames but no other "
+                          f"peer emitted any — unpaired wire"})
+            continue
+        label = _stream_link(disp)
+        if label is not None:
+            labeled = [e for e in cands
+                       if _stream_link(e["by"]["emit"]) == label]
+            if labeled:
+                cands = labeled
+        d_cov, d_end = _coverage(disp)
+        emitter = min(cands, key=lambda e: (
+            abs(_coverage(e["by"]["emit"])[1] - d_end)
+            + abs(_coverage(e["by"]["emit"])[0] - d_cov)))
+        e_cov, e_end = _coverage(emitter["by"]["emit"])
+        link = label or f"{emitter['name']}->{s['name']}"
+        links.append({
+            "link": link, "emitter": emitter["name"],
+            "dispatcher": s["name"],
+            "emit_covered": e_cov, "emit_end": e_end,
+            "dispatch_covered": d_cov, "dispatch_end": d_end,
+        })
+        if (e_cov, e_end) != (d_cov, d_end):
+            flags.append({
+                "flag": "peer-divergence", "role": link,
+                "offset": min(e_end, d_end),
+                "detail": f"link {link}: emitter {emitter['name']} "
+                          f"covered {e_cov} byte(s) ending at {e_end}, "
+                          f"dispatcher {s['name']} {d_cov} ending at "
+                          f"{d_end}"})
+    rows = _merge_timeline_n([(s["name"], s["records"]) for s in streams])
+    peers = {s["name"]: {
+        "frames": len(s["by"]["emit"]) + len(s["by"]["dispatch"]),
+        "emit": list(_coverage(s["by"]["emit"])),
+        "dispatch": list(_coverage(s["by"]["dispatch"])),
+    } for s in streams}
+    if json_out:
+        print(json.dumps({"flags": flags, "peers": peers, "links": links,
+                          "timeline": rows}))
+    else:
+        for w in rows:
+            mark = "@" if w["keyed"] else "~"
+            extra = ""
+            if w["fields"]:
+                extra = " " + " ".join(
+                    f"{k}={v}" for k, v in sorted(w["fields"].items()))
+            print(f"{mark}{w['offset']:<10} {w['role']:<16} "
+                  f"{w['name']}{extra}")
+        for name, rec in peers.items():
+            print(f"-- {name}: {rec['frames']} frame record(s), "
+                  f"emit {rec['emit'][0]}B/end {rec['emit'][1]}, "
+                  f"dispatch {rec['dispatch'][0]}B/end "
+                  f"{rec['dispatch'][1]}")
+        for ln in links:
+            print(f"-- link {ln['link']}: {ln['emitter']} -> "
+                  f"{ln['dispatcher']}, {ln['emit_covered']} -> "
+                  f"{ln['dispatch_covered']} byte(s)")
+        if flags:
+            for fl in flags:
+                print(f"FLAG {fl['flag']} [{fl['role']}] @{fl['offset']}: "
+                      f"{fl['detail']}")
+        else:
+            print("-- clean: no gaps, reorders, or duplicate deliveries")
+    return 1 if flags else 0
+
+
 def cmd_timeline(args) -> int:
+    if args.peers:
+        return _timeline_n([args.sender, args.receiver, *args.peers],
+                           args.json)
     sender = _load_jsonl(args.sender)
     receiver = _load_jsonl(args.receiver)
     # split each peer's frames by direction: emissions and dispatches
@@ -313,6 +435,31 @@ def cmd_perf_check(args) -> int:
                      host_only=args.host_only)
 
 
+def cmd_fleet(args) -> int:
+    from .fleet import FleetView, run_dashboard, run_fleet_check
+
+    if args.check:
+        return run_fleet_check(
+            args.targets, args.check,
+            polls=args.polls if args.polls is not None else 3,
+            interval=args.interval)
+    if args.watch:
+        return run_dashboard(args.targets, interval=args.interval,
+                             max_polls=args.polls)
+    # one-shot: a single joined sample as JSON (the scripting surface)
+    view = FleetView(args.targets)
+    polls = args.polls if args.polls is not None else 1
+    sample = None
+    import time as _time
+
+    for i in range(max(1, polls)):
+        if i:
+            _time.sleep(args.interval)
+        sample = view.poll(healthz=True)
+    print(json.dumps(sample, default=repr))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m dat_replication_protocol_tpu.obs",
@@ -323,10 +470,16 @@ def main(argv=None) -> int:
 
     tl = sub.add_parser(
         "timeline",
-        help="merge sender+receiver JSONL logs into one causally-ordered "
-             "timeline keyed on wire offset; flag gaps/reorders/duplicates")
+        help="merge N JSONL logs into one causally-ordered timeline "
+             "keyed on wire offset; flag gaps/reorders/duplicates "
+             "(2 logs: the classic sender/receiver audit; more: "
+             "per-link pairing, the fleet join's offline mirror)")
     tl.add_argument("sender", help="the sending peer's JSONL event/span log")
     tl.add_argument("receiver", help="the receiving peer's JSONL log")
+    tl.add_argument("peers", nargs="*", metavar="PEER",
+                    help="further peers' JSONL logs (N-log mode: "
+                         "dispatch streams pair with their emitting "
+                         "peer by link label, else best coverage match)")
     tl.add_argument("--json", action="store_true",
                     help="machine-readable output")
     tl.set_defaults(fn=cmd_timeline)
@@ -359,6 +512,33 @@ def main(argv=None) -> int:
     pc.add_argument("--host-only", action="store_true",
                     help="evaluate only host-group configs (CPU-safe)")
     pc.set_defaults(fn=cmd_perf_check)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="poll N replica targets (http:// endpoints and/or "
+             "--stats-fd JSONL files), join watermarks into per-link "
+             "replication lag; render a live dashboard or gate on a "
+             "declarative SLO (exit 1 on breach)")
+    fl.add_argument("targets", nargs="+", metavar="TARGET",
+                    help="http://host:port scrape endpoint or path to a "
+                         "--stats-fd JSONL file")
+    fl.add_argument("--check", metavar="SLO.json", default=None,
+                    help="evaluate the fleet against a declarative SLO "
+                         "file and exit 1 on breach (the perf-check "
+                         "contract for fleet health; see "
+                         "OBSERVABILITY.md for the schema)")
+    fl.add_argument("--watch", action="store_true",
+                    help="live TTY dashboard (plain ANSI, one screen "
+                         "per poll) instead of a one-shot JSON sample")
+    fl.add_argument("--interval", type=float, default=2.0,
+                    metavar="SECONDS",
+                    help="poll period for --watch / between --check "
+                         "polls (default: 2)")
+    fl.add_argument("--polls", type=int, default=None, metavar="N",
+                    help="stop after N polls (--watch: frames; --check: "
+                         "evaluate the final poll; default: --check 3, "
+                         "--watch unbounded)")
+    fl.set_defaults(fn=cmd_fleet)
 
     args = p.parse_args(argv)
     return args.fn(args)

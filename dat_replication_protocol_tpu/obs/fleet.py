@@ -1,0 +1,579 @@
+"""Fleet aggregator: join replica watermarks into live per-link lag.
+
+The fleet plane's control layer (ISSUE 11).  One aggregator polls N
+*targets* — scrape endpoints (:mod:`.http`), ``--stats-fd`` JSONL
+files, or in-process callables — and joins their ``watermarks``
+sections into per-link replication lag:
+
+* **lag in bytes** is exact: ``sender append − receiver parsed`` for
+  one link, both cursors read from state the data plane already
+  maintains (no wire traffic, no coordination protocol — replicas
+  export, the aggregator joins, "Simplicity Scales");
+* **lag in seconds** is clock-free: the sender's append-marks ring
+  timestamps every wire frontier on the SENDER's monotonic clock, and
+  the age of the oldest unparsed byte is
+  ``sender_monotonic_at_snapshot − mark_time`` — no wall-clock
+  synchronization between replicas, ever (the PR 4 wire-offset trick,
+  applied to time);
+* **convergence** rides the reconcile gauges
+  (``reconcile.symbols.seen`` / ``reconcile.decoded.diff``) and the
+  terminal watermark identity: a link whose append == parsed has lag
+  exactly 0 — not "small", zero — because both numbers count the same
+  bytes.
+
+A bounded history ring per link supports rate/burn computation (bytes
+drained per second, polls-until-caught-up).  Rendering is either a
+plain-ANSI one-screen TTY dashboard (:func:`render_dashboard`) or
+``--check slo.json``: declarative SLOs evaluated into the same
+row-shaped report ``perf-check`` emits, exit 1 on breach — CI gates on
+fleet health exactly like it gates on perf budgets.
+
+SLO file schema (JSON object; every key optional — an empty object
+passes vacuously is NOT allowed, same contract as perf budgets):
+
+``max_lag_bytes`` / ``max_lag_seconds``
+    per-link bounds at the final poll;
+``require_converged``
+    every joined link must be at lag exactly 0;
+``max_shed`` / ``max_rejected``
+    fleet-wide sums of hub/fanout shed + rejected counters;
+``recompile_budget``
+    max jit traces per site across targets (the PR 5 sentinel);
+``require_healthz``
+    every target's ``/healthz`` (or snapshot-embedded health) must be
+    ok;
+``max_events_dropped``
+    per-target event-ring drop bound.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import deque
+from typing import Callable, Optional
+from urllib.request import urlopen
+
+from .watermarks import link_lag
+
+__all__ = [
+    "FleetTarget",
+    "FleetView",
+    "evaluate_slo",
+    "load_slo",
+    "render_dashboard",
+    "SLO_KEYS",
+]
+
+DEFAULT_HISTORY = 128
+DEFAULT_TIMEOUT = 5.0
+
+SLO_KEYS = frozenset({
+    "max_lag_bytes", "max_lag_seconds", "require_converged",
+    "max_shed", "max_rejected", "recompile_budget", "require_healthz",
+    "max_events_dropped",
+})
+
+
+class FleetTarget:
+    """One polled replica.  ``spec`` is an ``http(s)://`` endpoint (its
+    ``/snapshot`` route is fetched, ``/healthz`` alongside), a filesystem
+    path to a ``--stats-fd`` JSONL file (the last complete snapshot
+    line is used; ``emit_seq`` gaps are counted as dropped lines), or a
+    zero-argument callable returning the snapshot dict (in-process
+    fleets: tests, bench legs)."""
+
+    def __init__(self, spec, name: Optional[str] = None,
+                 timeout: float = DEFAULT_TIMEOUT):
+        self._spec = spec
+        self._timeout = timeout
+        if callable(spec):
+            self.kind = "callable"
+            self.name = name or getattr(spec, "__name__", "inproc")
+        elif isinstance(spec, str) and spec.startswith(("http://",
+                                                        "https://")):
+            self.kind = "http"
+            self.name = name or spec
+        elif isinstance(spec, str):
+            self.kind = "file"
+            self.name = name or os.path.basename(spec)
+        else:
+            raise ValueError(f"unknown fleet target spec {spec!r}")
+        self.last_error: Optional[str] = None
+        self.last_emit_seq: Optional[int] = None
+        self.dropped_lines = 0  # emit_seq gaps observed across polls
+
+    def poll(self) -> Optional[dict]:
+        """One snapshot dict, or None (the failure is recorded on
+        ``last_error`` — an unreachable replica is a visible state, not
+        an exception that kills the whole poll)."""
+        try:
+            if self.kind == "callable":
+                snap = self._spec()
+            elif self.kind == "http":
+                base = self._spec.rstrip("/")
+                with urlopen(base + "/snapshot",
+                             timeout=self._timeout) as r:
+                    snap = json.loads(r.read().decode("utf-8"))
+            else:
+                snap = self._read_last_line(self._spec)
+        except Exception as e:
+            self.last_error = f"{type(e).__name__}: {e}"
+            return None
+        if snap is None:
+            self.last_error = "no complete snapshot line yet"
+            return None
+        self.last_error = None
+        seq = snap.get("emit_seq")
+        if isinstance(seq, int):
+            if self.last_emit_seq is not None \
+                    and seq > self.last_emit_seq + 1:
+                # lines the emitter consumed a seq for but this reader
+                # never saw: EAGAIN skips, torn-line latches, or a
+                # truncated tail — surfaced, not silently absorbed
+                self.dropped_lines += seq - self.last_emit_seq - 1
+            self.last_emit_seq = seq
+        return snap
+
+    def poll_healthz(self, snap: Optional[dict] = None) -> Optional[dict]:
+        """The target's staged health record: fetched from ``/healthz``
+        for endpoint targets (503 bodies are still parsed — degraded IS
+        the answer), read from the snapshot's embedded ``healthz`` key
+        for file/callable targets (the sidecar's ``--stats-fd`` lines
+        carry one).  Pass the snapshot already polled this sample via
+        ``snap`` to avoid re-polling."""
+        if self.kind == "http":
+            base = self._spec.rstrip("/")
+            try:
+                with urlopen(base + "/healthz",
+                             timeout=self._timeout) as r:
+                    return json.loads(r.read().decode("utf-8"))
+            except Exception as e:
+                body = getattr(e, "read", None)
+                if body is not None:
+                    try:  # HTTPError 503 carries the staged record
+                        return json.loads(body().decode("utf-8"))
+                    except Exception:
+                        pass
+                return {"ok": False,
+                        "error": f"{type(e).__name__}: {e}"}
+        if snap is None:
+            snap = self.poll()
+        return (snap or {}).get("healthz")
+
+    @staticmethod
+    def _read_last_line(path: str) -> Optional[dict]:
+        # the last COMPLETE JSON line wins; a torn final line (emitter
+        # mid-write, or latched dead mid-record) parses as garbage and
+        # is skipped — exactly the JSONL consumer discipline the event
+        # sink documents
+        last = None
+        with open(path, encoding="utf-8") as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    obj = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(obj, dict) and "watermarks" in obj:
+                    last = obj
+        return last
+
+
+def _join_links(snaps: dict) -> dict:
+    """Join every target's watermark links by link name.  Returns
+    ``{link: {"offsets", "marks", "mark_clock", "targets", "lag_bytes",
+    "lag_seconds"}}``.  When sender and receiver cursors come from
+    DIFFERENT targets (the normal fleet case), the seconds join uses
+    the marks + monotonic stamp of the target that exported the
+    ``append`` cursor — one clock, the sender's."""
+    links: dict = {}
+    for tname, snap in snaps.items():
+        wm = (snap or {}).get("watermarks") or {}
+        clock = wm.get("monotonic")
+        for lname, rec in (wm.get("links") or {}).items():
+            entry = links.setdefault(lname, {
+                "offsets": {}, "marks": [], "mark_clock": None,
+                "marks_dropped": 0, "targets": []})
+            entry["targets"].append(tname)
+            offsets = rec.get("offsets") or {}
+            for role, value in offsets.items():
+                entry["offsets"][role] = value
+            marks = rec.get("marks") or []
+            src = rec.get("marks_from")
+            if src and not marks:
+                src_rec = (wm.get("links") or {}).get(src)
+                if src_rec:
+                    marks = src_rec.get("marks") or []
+            if "append" in offsets:
+                # the sender side of the join: its marks and ITS clock
+                entry["marks"] = marks
+                entry["mark_clock"] = clock
+                entry["marks_dropped"] = rec.get("marks_dropped", 0)
+    for entry in links.values():
+        lag_bytes, lag_seconds = link_lag(
+            entry["offsets"], entry["marks"],
+            entry["mark_clock"] if entry["mark_clock"] is not None
+            else 0.0,
+            marks_dropped=entry["marks_dropped"])
+        if entry["mark_clock"] is None and lag_bytes is not None:
+            # no sender clock came with the marks: behind -> unknown
+            # age, caught up -> exactly 0 (the byte identity needs no
+            # clock at all)
+            lag_seconds = None if lag_bytes else 0.0
+        entry["lag_bytes"] = lag_bytes
+        entry["lag_seconds"] = lag_seconds
+    return links
+
+
+def _counter_sum(snaps: dict, names: tuple) -> int:
+    total = 0
+    for snap in snaps.values():
+        counters = ((snap or {}).get("metrics") or {}).get("counters") or {}
+        for name, v in counters.items():
+            base = name.partition("{")[0]
+            if base in names:
+                total += int(v)
+    return total
+
+
+class FleetView:
+    """N targets, joined.  :meth:`poll` takes one fleet-wide sample;
+    the per-link history ring feeds rate computation and the
+    dashboard's sparklines."""
+
+    def __init__(self, targets, history: int = DEFAULT_HISTORY):
+        self.targets = [t if isinstance(t, FleetTarget) else FleetTarget(t)
+                        for t in targets]
+        if not self.targets:
+            raise ValueError("a fleet needs at least one target")
+        # target names key the per-poll snapshot dict: two targets
+        # sharing one (two anonymous lambdas, twice the same file)
+        # would silently shadow each other in every join
+        seen: dict = {}
+        for t in self.targets:
+            n = seen.get(t.name, 0)
+            seen[t.name] = n + 1
+            if n:
+                t.name = f"{t.name}#{n + 1}"
+        self._history: dict[str, deque] = {}
+        self._hist_len = history
+        self.polls = 0
+
+    def poll(self, healthz: bool = False) -> dict:
+        """One sample: per-target snapshot + joined links + fleet-wide
+        overload counters (+ per-target health with ``healthz=True``).
+        Unreachable targets appear in ``errors`` — visible, never
+        fatal."""
+        now = time.monotonic()
+        snaps: dict = {}
+        errors: dict = {}
+        for t in self.targets:
+            snap = t.poll()
+            if snap is None:
+                errors[t.name] = t.last_error
+            else:
+                snaps[t.name] = snap
+        links = _join_links(snaps)
+        for lname, entry in links.items():
+            ring = self._history.setdefault(
+                lname, deque(maxlen=self._hist_len))
+            ring.append((now, entry["lag_bytes"], entry["lag_seconds"]))
+            entry["drain_bps"] = self._drain_rate(ring)
+        sample = {
+            "polled": now,
+            "targets": {name: {
+                "ts": snap.get("ts"),
+                "events_dropped": snap.get("events_dropped", 0),
+                "emit_seq": snap.get("emit_seq"),
+                "jit_sites": snap.get("jit_sites") or {},
+                "hub": snap.get("hub"),
+                "fanout": snap.get("fanout"),
+            } for name, snap in snaps.items()},
+            "errors": errors,
+            "links": links,
+            "shed": _counter_sum(snaps, ("hub.shed", "fanout.peer.shed")),
+            "rejected": _counter_sum(snaps, ("hub.rejected",
+                                             "fanout.rejected")),
+            "reconcile": {
+                "rounds": _counter_sum(snaps, ("reconcile.rounds",)),
+                "symbols_seen": self._gauge_max(snaps,
+                                                "reconcile.symbols.seen"),
+                "decoded_diff": self._gauge_max(snaps,
+                                                "reconcile.decoded.diff"),
+            },
+            "dropped_lines": {t.name: t.dropped_lines
+                              for t in self.targets if t.dropped_lines},
+        }
+        if healthz:
+            # file/callable targets reuse the snapshot this sample
+            # already took (their health rides the snapshot record);
+            # only endpoint targets pay a second request, to /healthz
+            sample["healthz"] = {
+                t.name: t.poll_healthz(snap=snaps.get(t.name))
+                for t in self.targets}
+        self.polls += 1
+        return sample
+
+    @staticmethod
+    def _gauge_max(snaps: dict, name: str) -> float:
+        best = 0.0
+        for snap in snaps.values():
+            gauges = ((snap or {}).get("metrics") or {}).get("gauges") or {}
+            v = gauges.get(name)
+            if v is not None:
+                best = max(best, float(v))
+        return best
+
+    @staticmethod
+    def _drain_rate(ring) -> Optional[float]:
+        """Bytes/second the link's lag is shrinking at over the ring
+        window (negative: the link is falling further behind)."""
+        pts = [(t, b) for t, b, _s in ring if b is not None]
+        if len(pts) < 2:
+            return None
+        (t0, b0), (t1, b1) = pts[0], pts[-1]
+        if t1 <= t0:
+            return None
+        return round((b0 - b1) / (t1 - t0), 1)
+
+    def history(self, link: str) -> list:
+        return list(self._history.get(link, ()))
+
+
+# -- SLO gate -----------------------------------------------------------------
+
+
+def load_slo(path: str) -> dict:
+    """Parse + validate an SLO file.  Malformed input (not an object,
+    unknown keys, non-numeric bounds, or NO evaluable keys) raises
+    ``ValueError`` — a gate that silently evaluates nothing is not a
+    gate (the perf-budget precedent)."""
+    with open(path, encoding="utf-8") as f:
+        slo = json.load(f)
+    if not isinstance(slo, dict):
+        raise ValueError(f"SLO file {path}: expected a JSON object")
+    unknown = set(slo) - SLO_KEYS
+    if unknown:
+        raise ValueError(
+            f"SLO file {path}: unknown key(s) {sorted(unknown)} "
+            f"(known: {sorted(SLO_KEYS)})")
+    if not slo:
+        raise ValueError(
+            f"SLO file {path}: no evaluable keys — an empty SLO would "
+            "pass vacuously")
+    for key in ("max_lag_bytes", "max_lag_seconds", "max_shed",
+                "max_rejected", "recompile_budget", "max_events_dropped"):
+        if key in slo and not isinstance(slo[key], (int, float)):
+            raise ValueError(f"SLO file {path}: {key} must be a number")
+    for key in ("require_converged", "require_healthz"):
+        if key in slo and not isinstance(slo[key], bool):
+            raise ValueError(f"SLO file {path}: {key} must be a boolean")
+    return slo
+
+
+def evaluate_slo(slo: dict, sample: dict) -> list[dict]:
+    """One fleet sample against one SLO: verdict rows in the
+    ``perf-check`` shape (``{"check", "subject", "status", "detail"}``;
+    callers gate on ``any(r["status"] == "fail")``)."""
+    rows: list[dict] = []
+
+    def row(check: str, subject: str, ok: bool, detail: str) -> None:
+        rows.append({"check": check, "subject": subject,
+                     "status": "ok" if ok else "fail", "detail": detail})
+
+    links = sample.get("links") or {}
+    if "max_lag_bytes" in slo or "max_lag_seconds" in slo \
+            or slo.get("require_converged"):
+        if not links:
+            row("lag", "-", False,
+                "no joined links: nothing to evaluate lag against")
+    for lname, entry in sorted(links.items()):
+        lb, ls = entry.get("lag_bytes"), entry.get("lag_seconds")
+        if "max_lag_bytes" in slo:
+            bound = slo["max_lag_bytes"]
+            if lb is None:
+                row("max_lag_bytes", lname, False,
+                    "link not joined (one side missing)")
+            else:
+                row("max_lag_bytes", lname, lb <= bound,
+                    f"lag {lb} byte(s), bound {bound}")
+        if "max_lag_seconds" in slo:
+            bound = slo["max_lag_seconds"]
+            if lb == 0:
+                row("max_lag_seconds", lname, True, "caught up (lag 0)")
+            elif ls is None:
+                row("max_lag_seconds", lname, False,
+                    "behind with no age attribution (marks missing)")
+            else:
+                row("max_lag_seconds", lname, ls <= bound,
+                    f"oldest unparsed byte {ls:.3f}s old, bound {bound}")
+        if slo.get("require_converged"):
+            row("require_converged", lname, lb == 0,
+                f"lag {lb} byte(s) (must be exactly 0)")
+    if "max_shed" in slo:
+        row("max_shed", "fleet", sample.get("shed", 0) <= slo["max_shed"],
+            f"shed {sample.get('shed', 0)}, bound {slo['max_shed']}")
+    if "max_rejected" in slo:
+        row("max_rejected", "fleet",
+            sample.get("rejected", 0) <= slo["max_rejected"],
+            f"rejected {sample.get('rejected', 0)}, "
+            f"bound {slo['max_rejected']}")
+    if "recompile_budget" in slo:
+        bound = slo["recompile_budget"]
+        worst, site = 0, "-"
+        for tname, t in (sample.get("targets") or {}).items():
+            for sname, rec in (t.get("jit_sites") or {}).items():
+                if rec.get("traces", 0) > worst:
+                    worst, site = rec["traces"], f"{tname}:{sname}"
+        row("recompile_budget", site, worst <= bound,
+            f"worst site traced {worst}x, bound {bound}")
+    if "max_events_dropped" in slo:
+        bound = slo["max_events_dropped"]
+        for tname, t in sorted((sample.get("targets") or {}).items()):
+            dropped = t.get("events_dropped", 0)
+            row("max_events_dropped", tname, dropped <= bound,
+                f"ring dropped {dropped}, bound {bound}")
+    if slo.get("require_healthz"):
+        hz = sample.get("healthz") or {}
+        if not hz:
+            row("require_healthz", "-", False,
+                "no healthz records polled")
+        for tname, rec in sorted(hz.items()):
+            ok = bool(rec and rec.get("ok"))
+            degraded = "-"
+            if rec and not ok:
+                degraded = ",".join(
+                    s for s, st in (rec.get("stages") or {}).items()
+                    if not st.get("ok")) or rec.get("error", "?")
+            row("require_healthz", tname, ok,
+                "healthy" if ok else f"degraded: {degraded}")
+    for tname, err in sorted((sample.get("errors") or {}).items()):
+        row("reachable", tname, False, f"target unreachable: {err}")
+    return rows
+
+
+def run_fleet_check(targets, slo_path: str, polls: int = 3,
+                    interval: float = 0.5, out=None) -> int:
+    """The CI gate: poll, evaluate the FINAL sample, report one line
+    per check, exit 1 on breach (the ``perf-check`` contract for fleet
+    health).  A malformed SLO is itself a failure row — a gate must
+    fail loudly, never pass on an unreadable contract."""
+    out = out if out is not None else sys.stdout
+    try:
+        slo = load_slo(slo_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"FAIL slo          {type(e).__name__}: {e}", file=out)
+        print("fleet-check: 1 check(s), 1 failed — SLO BREACH", file=out)
+        return 1
+    view = FleetView(targets)
+    sample = None
+    for i in range(max(1, polls)):
+        if i:
+            time.sleep(interval)
+        sample = view.poll(healthz=bool(slo.get("require_healthz")))
+    rows = evaluate_slo(slo, sample)
+    failed = 0
+    for r in rows:
+        mark = "OK  " if r["status"] == "ok" else "FAIL"
+        subject = f"{r['check']}[{r['subject']}]"
+        print(f"{mark} {subject:<40} {r['detail']}", file=out)
+        failed += r["status"] == "fail"
+    verdict = "SLO BREACH" if failed else "within SLO"
+    print(f"fleet-check: {len(rows)} check(s), {failed} failed — "
+          f"{verdict}", file=out)
+    return 1 if failed else 0
+
+
+# -- TTY dashboard ------------------------------------------------------------
+
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width: int = 24) -> str:
+    vals = [v for v in values if v is not None][-width:]
+    if not vals:
+        return "-" * width
+    top = max(vals) or 1
+    return "".join(_SPARK[min(8, int(8 * v / top + 0.5))]
+                   for v in vals).rjust(width)
+
+
+def render_dashboard(view: FleetView, sample: dict,
+                     width: int = 78) -> str:
+    """One screen, plain ANSI (no curses, no deps): per-target health
+    column, per-link lag + sparkline over the history ring, overload /
+    convergence summary, recent errors.  Returns the frame as a string
+    (the CLI clears + prints; tests assert on content)."""
+    lines: list[str] = []
+    bar = "─" * width
+    lines.append(f"fleet · {len(view.targets)} target(s) · "
+                 f"poll #{view.polls}")
+    lines.append(bar)
+    hz = sample.get("healthz") or {}
+    for t in view.targets:
+        if t.name in (sample.get("errors") or {}):
+            status = f"UNREACHABLE  {sample['errors'][t.name]}"
+        elif hz and hz.get(t.name) is not None:
+            status = "healthy" if hz[t.name].get("ok") else "DEGRADED"
+        else:
+            # reachable but no health record (a bare snapshot file):
+            # an honest "up", not a fabricated DEGRADED
+            status = "up"
+        drop = f"  dropped_lines={t.dropped_lines}" if t.dropped_lines \
+            else ""
+        lines.append(f"  {t.name[:40]:<40} {status}{drop}")
+    lines.append(bar)
+    links = sample.get("links") or {}
+    if links:
+        lines.append(f"  {'link':<20} {'lag_bytes':>10} {'age_s':>8} "
+                     f"{'drain_B/s':>10}  history")
+        for lname, entry in sorted(links.items()):
+            ring = view.history(lname)
+            lb = entry.get("lag_bytes")
+            ls = entry.get("lag_seconds")
+            dr = entry.get("drain_bps")
+            lines.append(
+                f"  {lname[:20]:<20} "
+                f"{('-' if lb is None else str(lb)):>10} "
+                f"{('-' if ls is None else f'{ls:.3f}'):>8} "
+                f"{('-' if dr is None else str(dr)):>10}  "
+                f"{_sparkline([b for _t, b, _s in ring])}")
+    else:
+        lines.append("  (no joined links yet)")
+    lines.append(bar)
+    rec = sample.get("reconcile") or {}
+    lines.append(
+        f"  shed={sample.get('shed', 0)} "
+        f"rejected={sample.get('rejected', 0)} "
+        f"reconcile_rounds={rec.get('rounds', 0)} "
+        f"symbols={int(rec.get('symbols_seen', 0))} "
+        f"diff={int(rec.get('decoded_diff', 0))}")
+    return "\n".join(lines)
+
+
+def run_dashboard(targets, interval: float = 2.0,
+                  max_polls: Optional[int] = None, out=None) -> int:
+    """The live TTY loop: clear, render, sleep.  ``max_polls`` bounds
+    the loop (tests, one-shot inspection); Ctrl-C exits cleanly."""
+    out = out if out is not None else sys.stdout
+    view = FleetView(targets)
+    n = 0
+    try:
+        while max_polls is None or n < max_polls:
+            sample = view.poll(healthz=True)
+            frame = render_dashboard(view, sample)
+            if out.isatty():
+                print("\x1b[2J\x1b[H" + frame, file=out, flush=True)
+            else:
+                print(frame, file=out, flush=True)
+            n += 1
+            if max_polls is None or n < max_polls:
+                time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    return 0

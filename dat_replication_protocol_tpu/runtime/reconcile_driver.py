@@ -46,7 +46,7 @@ import threading
 import numpy as np
 
 from ..obs.events import emit as _emit
-from ..obs.metrics import OBS as _OBS, counter as _counter
+from ..obs.metrics import OBS as _OBS, counter as _counter, gauge as _gauge
 from ..ops import rateless
 from ..session.decoder import Decoder
 from ..session.encoder import Encoder
@@ -81,6 +81,13 @@ DEFAULT_MAX_SYMBOLS = 4 << 20
 
 _M_ROUNDS = _counter("reconcile.rounds")
 _M_RECORDS = _counter("reconcile.records")
+# fleet-plane convergence watermarks (ISSUE 11): the aggregator reads
+# these to track anti-entropy progress — symbols streamed so far (the
+# wire cost cursor) and the decoded symmetric-difference size (0 means
+# the replicas proved identical; >0 names how far apart they were when
+# the decode landed)
+_G_SYMBOLS = _gauge("reconcile.symbols.seen")
+_G_DIFF = _gauge("reconcile.decoded.diff")
 
 
 def _hash_extents(buf: np.ndarray, offs: np.ndarray,
@@ -264,11 +271,13 @@ class ResponderState:
             self.rounds += 1
             if _OBS.on:
                 _M_ROUNDS.inc()
+                _G_SYMBOLS.set(self.peeler.symbols_seen)
             out = self.peeler.try_decode()
             if out is not None:
                 self.decoded = out
                 digests, signs = out
                 if _OBS.on:
+                    _G_DIFF.set(len(digests))
                     _emit("reconcile.decoded", diff=len(digests),
                           symbols=self.peeler.symbols_seen,
                           rounds=self.rounds)
@@ -432,6 +441,7 @@ def run_initiator(replica: RatelessReplica, read_bytes, write_bytes,
         stats["rounds"] += 1
         if _OBS.on:
             _M_ROUNDS.inc()
+            _G_SYMBOLS.set(m)
 
     def on_reconcile(msg, done) -> None:
         if msg.kind == rc.RC_MORE:

@@ -36,6 +36,7 @@ from ..obs.flight import FLIGHT as _FLIGHT
 from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
 from ..obs.tracing import trace_instant as _trace_instant
+from ..obs.watermarks import WATERMARKS as _WATERMARKS
 from ..wire.change_codec import Change, decode_change
 from ..wire.framing import LOCAL_CAPS, MAX_HEADER_LEN, TYPE_BLOB, \
     TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_HEADER, TYPE_RECONCILE, \
@@ -238,6 +239,9 @@ class Decoder:
         # tracks its own base and re-syncs _parsed when a run retires.
         self._parsed = 0
         self._frame_start = 0
+        # wire offset of the last exported checkpoint (fleet-plane
+        # watermark: the resume point a reconnect would pay back to)
+        self._ckpt_offset = 0
 
         # flow control
         self._pending = 0
@@ -428,6 +432,7 @@ class Decoder:
         """
         from .resume import SessionCheckpoint
 
+        self._ckpt_offset = self.bytes
         if emit_event and _OBS.on:
             _emit("session.checkpoint", wire_offset=self.bytes,
                   frame=self._frames_delivered(), row=self.changes)
@@ -439,6 +444,20 @@ class Decoder:
             blob_offset=blob.received if blob is not None else 0,
             digest=self._checkpoint_digest(),
         )
+
+    def watermark(self, link: str) -> None:
+        """Export this decoder's wire-position cursors on the fleet
+        plane (OBSERVABILITY.md "Fleet plane") under ``link``:
+        ``accepted`` (bytes taken from the transport — the resume
+        point), ``parsed`` (bytes the parser fully consumed — the lag
+        join's receive frontier), and ``checkpoint`` (the last exported
+        resume point).  All three already exist for resume/tracing;
+        exporting them costs the hot path nothing — values are read
+        only at snapshot time.  Call
+        ``WATERMARKS.untrack(link)`` when the session ends."""
+        _WATERMARKS.track("accepted", link, lambda: self.bytes)
+        _WATERMARKS.track("parsed", link, lambda: self._parsed)
+        _WATERMARKS.track("checkpoint", link, lambda: self._ckpt_offset)
 
     def _frames_delivered(self) -> int:
         """Frames fully delivered — the single frame-index authority for

@@ -30,6 +30,7 @@ from typing import Any
 
 from ..obs.events import emit as _emit
 from ..obs.metrics import OBS as _OBS, counter as _counter
+from ..obs.watermarks import WATERMARKS as _WATERMARKS
 from ..wire.framing import ProtocolError
 
 __all__ = ["SessionCheckpoint", "WireJournal", "ResumeError"]
@@ -106,6 +107,10 @@ class WireJournal:
         # across them — the single-reader assumption the original trim
         # baked in silently dropped a second reader's unread window
         self._readers: dict[str, int] = {}
+        # fleet-plane link name (ISSUE 11): set by watermark(); while
+        # set, appends note a monotonic mark so lag-in-seconds is
+        # derivable entirely on this sender's clock
+        self._wm_link: str | None = None
 
     @property
     def start(self) -> int:
@@ -122,6 +127,20 @@ class WireJournal:
         self._buf += data
         if _OBS.on:
             _M_J_APPEND.inc(len(data))
+            if self._wm_link is not None:
+                _WATERMARKS.mark(self._wm_link, self.end)
+
+    def watermark(self, link: str) -> None:
+        """Export this journal's cursors on the fleet plane
+        (OBSERVABILITY.md "Fleet plane"): ``append`` (bytes produced)
+        and ``acked`` (trim floor) under ``link``, plus an append-time
+        mark per journaled write so the aggregator can answer "how old
+        is the oldest unreplicated byte" without any clock sync.
+        Call :func:`~..obs.watermarks.WATERMARKS.untrack` with the same
+        link when the session ends."""
+        _WATERMARKS.track("append", link, lambda: self.end)
+        _WATERMARKS.track("acked", link, lambda: self.start)
+        self._wm_link = link
 
     def seek(self, offset: int) -> None:
         """Align an EMPTY journal's window to an absolute wire offset —

@@ -42,11 +42,13 @@ import time
 from .obs import device as obs_device
 from .obs import events as obs_events
 from .obs import flight as obs_flight
+from .obs import http as obs_http
 from .obs import metrics as obs_metrics
 from .obs import tracing as obs_tracing
 from .obs.events import emit as _emit
 from .obs.metrics import OBS as _OBS, counter as _counter
 from .obs.tracing import trace_span as _trace_span
+from .obs.watermarks import WATERMARKS as _WATERMARKS
 from .session.transport import recv_over, send_over
 
 DIGEST_SUBSET_CHANGE = "digest:change"
@@ -154,6 +156,10 @@ def run_session(read_bytes, write_bytes, close_write=None,
     else:
         dec = decode(backend="tpu")
     stats = {"digests": 0}
+    # fleet-plane watermarks: this session's receive cursors, one link
+    # per connection (untracked on exit — dead sessions vanish)
+    wm_link = session_key if session_key else "stdio"
+    dec.watermark(wm_link)
 
     # reply write progress, shared by every stall check: refreshed each
     # time a reply byte actually reaches the transport
@@ -289,6 +295,7 @@ def run_session(read_bytes, write_bytes, close_write=None,
         # completions discard on arrival — a torn-down session cannot
         # park bytes against the shared budget
         hub_session.close()
+    _WATERMARKS.untrack(wm_link)
     if _OBS.on:
         _M_SESSIONS.inc()
         _emit("sidecar.session", **out)
@@ -654,6 +661,11 @@ class StatsEmitter:
         self._wake = threading.Event()
         self._stopped = False
         self._dead = False  # fd failed or a line tore: never write again
+        # monotonic per-emitter line sequence (ISSUE 11): every dump
+        # ATTEMPT consumes a number, so a file-based fleet target can
+        # detect dropped lines (EAGAIN skip, torn-line latch) as seq
+        # gaps instead of silently reading a thinner history
+        self._emit_seq = 0
         self._thread = threading.Thread(
             target=self._run, name="sidecar-stats", daemon=True)
 
@@ -687,10 +699,14 @@ class StatsEmitter:
 
         if self._dead:
             return False
+        seq = self._emit_seq
+        self._emit_seq += 1
         if self._fmt == "prom":
             body = snapshot_stats_prom()
         else:
-            body = json.dumps(snapshot_stats()) + "\n"
+            snap = snapshot_stats()
+            snap["emit_seq"] = seq
+            body = json.dumps(snap) + "\n"
         line = body.encode("utf-8")
         view = memoryview(line)
         deadline = time.monotonic() + 2.0
@@ -738,6 +754,9 @@ def snapshot_stats() -> dict:
         "metrics": obs_metrics.snapshot(),
         "events_dropped": obs_events.EVENTS.dropped,
         "jit_sites": obs_device.SENTINEL.snapshot(),
+        # the fleet plane's join input (ISSUE 11): per-link wire
+        # cursors + append marks — the SAME dict /snapshot serves
+        "watermarks": _WATERMARKS.snapshot(),
     }
     if _ACTIVE_HUB is not None:
         out["hub"] = _ACTIVE_HUB.snapshot()
@@ -745,7 +764,22 @@ def snapshot_stats() -> dict:
     if _ACTIVE_FANOUT is not None:
         out["fanout"] = _ACTIVE_FANOUT.snapshot()
         out["peers"] = _ACTIVE_FANOUT.peers_snapshot()
+    # staged health rides every snapshot record, so file-based fleet
+    # targets (tailing --stats-fd lines) can evaluate require_healthz
+    # — not just endpoint targets with a /healthz route
+    out["healthz"] = obs_http.default_healthz(_active_admission_fn())
     return out
+
+
+def _active_admission_fn():
+    """The lock-free admission view of whichever shared engine this
+    daemon runs (hub wins when both are set — fanout composes with it
+    as the broadcast layer, admission is the hub's)."""
+    if _ACTIVE_HUB is not None:
+        return _ACTIVE_HUB.admission_state
+    if _ACTIVE_FANOUT is not None:
+        return _ACTIVE_FANOUT.admission_state
+    return None
 
 
 def snapshot_stats_prom() -> str:
@@ -872,6 +906,13 @@ def main(argv=None) -> int:
                    help="--stats-fd output format: self-contained JSON "
                         "lines (default) or Prometheus text exposition "
                         "blocks (obs.metrics.to_prom_text)")
+    p.add_argument("--obs-http", type=int, default=None, metavar="PORT",
+                   help="enable telemetry and serve the read-only scrape "
+                        "endpoint on 127.0.0.1:PORT — /metrics (Prometheus "
+                        "text), /snapshot (the --stats-fd JSON record), "
+                        "/healthz (staged health, 503 when degraded), "
+                        "/events (bounded JSONL tail); 0 binds an "
+                        "ephemeral port (see OBSERVABILITY.md fleet plane)")
     p.add_argument("--flight-dir", metavar="DIR", default=None,
                    help="arm the flight recorder: on any protocol error "
                         "or retry exhaustion, dump an atomic post-mortem "
@@ -937,6 +978,14 @@ def main(argv=None) -> int:
             p.error("--reconcile is its own session mode; it cannot "
                     "combine with --hub/--fanout")
         replica = load_reconcile_replica(args.reconcile)
+    obs_srv = None
+    if args.obs_http is not None:
+        obs_metrics.enable()  # a dark endpoint would serve zeros
+        obs_srv = obs_http.ObsHttpServer(
+            args.obs_http, snapshot_fn=snapshot_stats,
+            admission_fn=_active_admission_fn()).start()
+        print(f"sidecar: obs endpoint on {obs_srv.url}",
+              file=sys.stderr, flush=True)
     try:
         if args.stdio:
             if replica is not None:
@@ -962,6 +1011,8 @@ def main(argv=None) -> int:
                   reconcile_replica=replica)
         return 0
     finally:
+        if obs_srv is not None:
+            obs_srv.close()
         if fanout is not None:
             set_active_fanout(None)
             fanout.close()

@@ -51,7 +51,7 @@ def obs_enabled():
     afterwards — all five are process-global, so isolation is
     explicit."""
     from dat_replication_protocol_tpu.obs import device, events, flight, \
-        metrics, tracing
+        metrics, tracing, watermarks
 
     was_on = metrics.OBS.on
     metrics.REGISTRY.reset()
@@ -60,6 +60,7 @@ def obs_enabled():
     flight.FLIGHT._reset_for_tests()
     device.SENTINEL.reset_for_tests()
     device.reset_engine_notes()
+    watermarks.WATERMARKS.reset_for_tests()
     metrics.enable()
     try:
         yield metrics
@@ -73,3 +74,4 @@ def obs_enabled():
         flight.FLIGHT._reset_for_tests()
         device.SENTINEL.reset_for_tests()
         device.reset_engine_notes()
+        watermarks.WATERMARKS.reset_for_tests()

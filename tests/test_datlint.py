@@ -678,6 +678,96 @@ def test_obs_discipline_suppression(tmp_path):
     assert "obs-discipline" not in _rules_fired(findings)
 
 
+# -- obs-discipline: fleet-plane extensions (ISSUE 11) ----------------------
+
+def test_obs_discipline_watermark_role_must_be_literal(tmp_path):
+    # the watermark ROLE keys the fleet lag join — same greppability
+    # contract as metric names; the LINK argument is runtime by design
+    findings = _lint(tmp_path, ("wm.py", '''
+        def register(WATERMARKS, role, link, j):
+            WATERMARKS.track(role, link, lambda: j.end)
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 1
+    findings = _lint(tmp_path, ("wm_ok.py", '''
+        def register(WATERMARKS, link, j):
+            WATERMARKS.track("append", link, lambda: j.end)
+    '''))
+    # tmp_path still holds wm.py from above — scope to the literal case
+    assert not [f for f in findings if f.path.endswith("wm_ok.py")]
+
+
+def test_obs_discipline_exempts_fleet_plane_plumbing(tmp_path):
+    # obs/watermarks.py renders labeled names from tracked state,
+    # obs/fleet.py ships whole snapshots — plumbing, not sites
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    (obs_dir / "watermarks.py").write_text(textwrap.dedent('''
+        def _collect(links):
+            return {f"session.wire.offset{{link={k}}}": v
+                    for k, v in links.items()}
+
+        def track(role, link, fn, registry):
+            registry.gauge(role + link)
+    '''))
+    (obs_dir / "fleet.py").write_text(textwrap.dedent('''
+        def join(name, registry):
+            return registry.counter(name)
+    '''))
+    findings = run_paths([tmp_path])
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
+HEALTHZ_LOCK_BAD = '''
+def serve_healthz(self):
+    with self._lock:
+        return {"ok": True, "sessions": len(self._sessions)}
+'''
+
+HEALTHZ_DISPATCH_BAD = '''
+def default_healthz(pipeline):
+    pipeline.flush()
+    return {"ok": True}
+'''
+
+HEALTHZ_OK = '''
+def default_healthz(self, admission_fn):
+    adm = admission_fn()
+    return {"ok": bool(adm.get("open"))}
+
+def other_route(self):
+    with self._lock:  # non-healthz handlers may lock (snapshots do)
+        return dict(self._state)
+'''
+
+
+def _lint_obs_http(tmp_path, source):
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir(exist_ok=True)
+    (obs_dir / "http.py").write_text(textwrap.dedent(source))
+    return run_paths([tmp_path])
+
+
+def test_healthz_handler_must_not_take_a_lock(tmp_path):
+    findings = _lint_obs_http(tmp_path, HEALTHZ_LOCK_BAD)
+    obs = [f for f in findings if f.rule == "obs-discipline"]
+    assert len(obs) == 1 and "lock-free" in obs[0].message
+
+
+def test_healthz_handler_must_not_dispatch(tmp_path):
+    findings = _lint_obs_http(tmp_path, HEALTHZ_DISPATCH_BAD)
+    obs = [f for f in findings if f.rule == "obs-discipline"]
+    assert len(obs) == 1 and "device" in obs[0].message
+
+
+def test_healthz_check_scoped_to_healthz_functions_in_obs_http(tmp_path):
+    # locks in NON-healthz functions of obs/http.py are fine, and the
+    # same healthz-named code outside obs/http.py is out of scope
+    assert "obs-discipline" not in _rules_fired(
+        _lint_obs_http(tmp_path, HEALTHZ_OK))
+    findings = _lint(tmp_path, ("elsewhere.py", HEALTHZ_LOCK_BAD))
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
 def test_obs_discipline_covers_trace_span_sites(tmp_path):
     # ISSUE 4 satellite: span names carry the same literal-name contract
     # as event names — the timeline CLI and trace viewers key on them
