@@ -250,3 +250,64 @@ EOF
     && rm -f "$OUT/fleet_dev_$STAMP.log"
   commit_out "r06 watch: fleet-plane endpoint device capture ($STAMP)"
 fi
+
+# 9) ISSUE 12 snapshot-bootstrap device leg: manifest hashing at 2 GiB
+#    through the fused1p route (the SnapshotSource materialize pass —
+#    one read, one hash sweep, device single-residency pipeline), plus
+#    the weighted chunk-set symbol build on the jitted device engine
+#    (the SAME cached scatter-add program specialized to the 12-word
+#    weighted row).  The protocol A/B itself is host-group (bench
+#    config 12 runs in the tier-1 live gate); this leg prices the two
+#    device-eligible stages at dataset scale.  Config 3 rides along
+#    for the backend label.
+if [ ! -f "$OUT/.leg_snapshot_done" ]; then
+  BENCH_CONFIGS=3 BENCH_DEADLINE=600 timeout 700 \
+    python bench.py --quick >"$OUT/snapshot_label_$STAMP.json" \
+    2>"$OUT/snapshot_label_$STAMP.log"
+  DAT_CDC_ROUTE=fused1p timeout 2400 python - \
+      >"$OUT/snapshot_dev_$STAMP.json" \
+      2>"$OUT/snapshot_dev_$STAMP.log" <<'EOF'
+import json, time
+import numpy as np
+import jax
+from dat_replication_protocol_tpu.ops import rateless as rl
+from dat_replication_protocol_tpu.runtime.snapshot_driver import SnapshotSource
+
+out = {"backend": jax.default_backend(), "arms": {}}
+rng = np.random.default_rng(12)
+data = rng.integers(0, 256, 2 << 30, dtype=np.uint8)  # 2 GiB
+
+# arm 1: manifest materialize (fused1p cuts+digests, merkle root,
+# unique set + assembly ranks) at dataset scale
+t0 = time.perf_counter()
+src = SnapshotSource(data)
+dt = time.perf_counter() - t0
+out["arms"]["materialize_2gib"] = {
+    "seconds": round(dt, 3),
+    "gib_s": round(data.nbytes / dt / 2**30, 3),
+    "chunks": int(src.manifest.n_chunks),
+}
+
+# arm 2: weighted coded-symbol build over the chunk set on the device
+# engine — the WANT-set reconcile's source-side cost per cold manifest
+for m in (4096, 65536):
+    t0 = time.perf_counter()
+    ws = rl.WeightedSymbols(src.uniq_digests, src.uniq_lens,
+                            engine="device")
+    cells = ws.extend(m)
+    dt = time.perf_counter() - t0
+    out["arms"][f"wbuild_m{m}"] = {
+        "seconds": round(dt, 3),
+        "cells": int(len(cells)),
+        "cells_per_s": round(m / dt, 1),
+    }
+print(json.dumps(out))
+EOF
+  tail -c 16384 "$OUT/snapshot_dev_$STAMP.log" \
+    >"$OUT/snapshot_dev_$STAMP.log.tail" \
+    && rm -f "$OUT/snapshot_dev_$STAMP.log"
+  grep -q '"arms"' "$OUT/snapshot_dev_$STAMP.json" \
+    && device_artifact "$OUT/snapshot_label_$STAMP.json" \
+    && touch "$OUT/.leg_snapshot_done"
+  commit_out "r06 watch: snapshot-bootstrap manifest + weighted-build device capture ($STAMP)"
+fi

@@ -28,6 +28,11 @@ The headline metric is config 3 (the 50 GiB/s north-star target);
                   1M+1M divergent replicas: rateless coded symbols vs
                   the sketch-table exchange vs the tree descent — wire
                   bytes and wall clock per arm (ISSUE 10)
+  12 snapshot_bootstrap  content-addressed snapshot transfer: 2%-stale
+                  joiner wire ratio vs cold full transfer (target
+                  <= 0.05 at 1 GiB), 8-joiner cold flash crowd with
+                  hash-once counter proof (hash_ratio 1.0), and a
+                  torn-wire exactly-once resume arm (ISSUE 12)
 
 Robustness (round-1 failure was a backend-init crash that cost the round
 its only perf artifact): device-backend init is retried with backoff and
@@ -43,7 +48,9 @@ BENCH_RECONCILE_N / BENCH_RECONCILE_KS (config 11),
 BENCH_FUSED_MIB / BENCH_FUSED_REPS / BENCH_FUSED_DEVICE (config 8),
 BENCH_HUB_SESSIONS / BENCH_HUB_ROWS / BENCH_HUB_BLOB_KIB /
 BENCH_HUB_MESH (config 9), BENCH_FANOUT_ROWS / BENCH_FANOUT_BLOB_KIB /
-BENCH_FANOUT_PEERS / BENCH_FANOUT_STALL_S (config 10).
+BENCH_FANOUT_PEERS / BENCH_FANOUT_STALL_S (config 10),
+BENCH_SNAPSHOT_MIB / BENCH_SNAPSHOT_JOINERS / BENCH_SNAPSHOT_STALE
+(config 12).
 """
 
 from __future__ import annotations
@@ -2031,6 +2038,219 @@ def bench_reconcile_rateless(quick: bool, backend: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# config 12: content-addressed snapshot bootstrap — stale-joiner wire
+# scales with staleness, a cold flash crowd shares one hash pass, and
+# mid-snapshot resume is exactly-once (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def bench_snapshot_bootstrap(quick: bool, backend: str) -> dict:
+    """Config 12 (ISSUE 12): the snapshot bootstrap's three claims,
+    each measured on the real protocol with exact wire metering:
+
+    * **stale arm** — a joiner whose dataset diverges in ~2% of its
+      CDC chunks reconciles its chunk set (weighted rateless symbols)
+      and moves <= 5% of the cold full-transfer bytes: bytes-on-wire
+      scale with STALENESS, not dataset size;
+    * **cold flash crowd** — N joiners bootstrap the same manifest and
+      the source's digest-work counters stay flat (``hash_ratio`` 1.0):
+      the dataset is hashed once at materialize, the shared cold log is
+      framed once, every session is served zero-copy slices;
+    * **chaos arm** — a recorded joiner wire is torn mid-CHUNKS-frame
+      and resumed through the reconnect driver: the assembled dataset
+      is byte-exact and every chunk verified EXACTLY once.
+
+    Host-group: the protocol core is numpy + native; no device backend
+    is initialized (the TPU watch script drives the device legs).
+    """
+    import numpy as np
+
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+    from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+        SnapshotSource,
+        snapshot_local,
+    )
+
+    mib = _env_int("BENCH_SNAPSHOT_MIB", 8 if quick else 1024)
+    joiners = _env_int("BENCH_SNAPSHOT_JOINERS", 8)
+    stale_frac = float(os.environ.get("BENCH_SNAPSHOT_STALE", "0.02"))
+
+    rng = np.random.default_rng(12)
+    data = rng.integers(0, 256, mib << 20, dtype=np.uint8)
+
+    # SOURCE digest-work counters: dataset bytes through the fused
+    # chunk-hash pass (host route) or shipped to the device (device
+    # route).  device.native.hash.bytes is deliberately excluded — the
+    # joiners' own merkle-root verification (32 B/chunk, per session BY
+    # DESIGN) rides it and would read as false source work.
+    _DIGEST_COUNTERS = ("cdc.fused.bytes",
+                        "device.submit.bytes", "device.h2d.bytes")
+
+    def _digest_work() -> int:
+        snap = obs_metrics.snapshot()["counters"]
+        return sum(int(snap.get(k, 0)) for k in _DIGEST_COUNTERS)
+
+    was_on = obs_metrics.OBS.on
+    obs_metrics.enable()
+    try:
+        h0 = _digest_work()
+        t0 = time.perf_counter()
+        src = SnapshotSource(data)  # ONE hash+read pass, counted
+        mat_wall = time.perf_counter() - t0
+        hash_once = _digest_work() - h0
+
+        # -- stale arm: 2% of chunks diverge ------------------------------
+        n_chunks = len(src.offs)
+        pick = rng.choice(n_chunks, size=max(1, int(n_chunks * stale_frac)),
+                          replace=False)
+        stale = data.copy()
+        stale[src.offs[pick]] ^= 0x5A
+        t0 = time.perf_counter()
+        out = snapshot_local(src, stale.tobytes())
+        stale_wall = time.perf_counter() - t0
+        assert out["data"] == data.tobytes()
+        stale_wire = out["wire_bytes"]
+        del stale
+
+        # -- cold flash crowd: N joiners, one hash pass --------------------
+        h1 = _digest_work()
+        t0 = time.perf_counter()
+        cold_wire = None
+        for _ in range(joiners):
+            cold = snapshot_local(src, None)
+            assert cold["data"] == data.tobytes()
+            cold_wire = cold["wire_bytes"]
+        crowd_wall = time.perf_counter() - t0
+        crowd_hash = _digest_work() - h1
+        hash_ratio = (hash_once + crowd_hash) / max(1, hash_once)
+
+        # -- chaos arm: torn mid-chunk, resumed exactly-once ---------------
+        chaos = _snapshot_chaos_arm(src, data)
+    finally:
+        obs_metrics.OBS.on = was_on
+
+    ratio = stale_wire / max(1, cold_wire)
+    log(f"bench[snapshot_bootstrap]: {mib} MiB, {n_chunks} chunks — "
+        f"stale({stale_frac:.0%}) {stale_wire} B vs cold {cold_wire} B "
+        f"(ratio {ratio:.4f}); crowd x{joiners} hash_ratio "
+        f"{hash_ratio:.3f}; chaos {chaos}")
+    return {
+        "metric": "snapshot_bootstrap_stale_wire_ratio",
+        "value": round(ratio, 5),
+        "unit": "ratio",
+        "vs_baseline": None,
+        "dataset_mib": mib,
+        "chunks": n_chunks,
+        "stale_frac": stale_frac,
+        "stale_wire_bytes": stale_wire,
+        "cold_wire_bytes": cold_wire,
+        "stale_wall_s": round(stale_wall, 3),
+        "materialize_wall_s": round(mat_wall, 3),
+        "chunks_reused": out["chunks_reused"],
+        "symbols": out["symbols"],
+        "joiners": joiners,
+        "crowd_wall_s": round(crowd_wall, 3),
+        "crowd_mib_s": round(joiners * mib / max(crowd_wall, 1e-9), 1),
+        "hash_once_bytes": hash_once,
+        "crowd_hash_bytes": crowd_hash,
+        "hash_ratio": round(hash_ratio, 4),
+        "chaos": chaos,
+        "reduced_config": mib < 1024,
+        "full_config": "1 GiB dataset, 2% stale chunks, 8-joiner cold "
+                       "crowd, torn-wire resume",
+    }
+
+
+def _snapshot_chaos_arm(src, data) -> dict:
+    """Record one stale-joiner wire, tear it inside the first CHUNKS
+    frame, resume through the reconnect driver, and prove exactly-once:
+    byte-exact assembly, every wanted chunk verified once."""
+    import numpy as np
+
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+        SnapshotJoiner,
+        SnapshotResponder,
+    )
+    from dat_replication_protocol_tpu.session.faults import (
+        FaultPlan,
+        FaultyReader,
+        bytes_reader,
+    )
+    from dat_replication_protocol_tpu.session.reconnect import (
+        BackoffPolicy,
+        run_resumable,
+    )
+    from dat_replication_protocol_tpu.session.resume import WireJournal
+    from dat_replication_protocol_tpu.wire import snapshot_codec as sn
+    from dat_replication_protocol_tpu.wire.framing import (
+        CAP_SNAPSHOT,
+        iter_frames,
+    )
+
+    # the chaos dataset is a small window of the bench dataset: the
+    # exactly-once contract is size-independent and the recorded wire
+    # replays byte-at-a-time territory
+    chaos_data = np.ascontiguousarray(data[: 4 << 20])
+    from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+        SnapshotSource,
+    )
+
+    csrc = SnapshotSource(chaos_data)
+    stale = chaos_data.copy()
+    stale[csrc.offs[:: max(1, len(csrc.offs) // 20)]] ^= 0x5A
+    resp = SnapshotResponder(csrc)
+    pilot = SnapshotJoiner(stale.tobytes())
+    e = protocol.encode(peer_caps=CAP_SNAPSHOT)
+    j = WireJournal()
+    e.attach_journal(j)
+    pending = list(resp.begin_payloads())
+    while pending and not pilot.done:
+        replies = []
+        for payload in pending:
+            e.snapshot_frame(payload)
+            replies.extend(pilot.handle(sn.decode_snapshot(payload)))
+        pending = []
+        for r in replies:
+            pending.extend(resp.handle(sn.decode_snapshot(r)))
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    wanted = pilot.chunks_verified
+    wire = j.read_from(0)
+
+    # first CHUNKS frame extent -> truncate mid-body
+    cut = None
+    for _start, _tid, p0, end in iter_frames(wire):
+        if wire[p0] == sn.SN_CHUNKS:
+            cut = p0 + (end - p0) // 2  # mid-body
+            break
+    assert cut is not None
+
+    joiner = SnapshotJoiner(stale.tobytes())
+    dec = protocol.decode()
+    dec.snapshot(lambda msg, done: (joiner.handle(msg), done()))
+
+    def source(ckpt, failures):
+        remaining = wire[ckpt.wire_offset:]
+        plan = FaultPlan(truncate_at=cut) if failures == 0 else FaultPlan()
+        return FaultyReader(bytes_reader(remaining), plan)
+
+    stats = run_resumable(
+        source, dec, BackoffPolicy(base=0.0005, cap=0.005, max_retries=4),
+        expected_total=len(wire))
+    out = joiner.result()
+    return {
+        "resumed": stats["reconnects"] >= 1,
+        "exactly_once": (out["data"] == chaos_data.tobytes()
+                         and joiner.chunks_verified == wanted),
+        "reconnects": stats["reconnects"],
+        "chunks_verified": joiner.chunks_verified,
+        "wanted": wanted,
+    }
+
+
+# ---------------------------------------------------------------------------
 
 
 BENCHES = {
@@ -2045,6 +2265,7 @@ BENCHES = {
     "9": ("hub_soak", bench_hub_soak),
     "10": ("fanout", bench_fanout),
     "11": ("reconcile_rateless", bench_reconcile_rateless),
+    "12": ("snapshot_bootstrap", bench_snapshot_bootstrap),
 }
 
 
@@ -2226,7 +2447,7 @@ def main() -> None:
     which = [
         k.strip()
         for k in os.environ.get(
-            "BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11").split(",")
+            "BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11,12").split(",")
         if k.strip() in BENCHES
     ]
 
@@ -2274,7 +2495,7 @@ def main() -> None:
     # (config 8's opt-in device leg initializes jax itself — it is for
     # the TPU watch script, which only fires when the tunnel answers)
     for key in which:
-        if key in ("1", "2", "6", "7", "8", "9", "10", "11"):
+        if key in ("1", "2", "6", "7", "8", "9", "10", "11", "12"):
             run_config(key, "host")
 
     # priority order for the device leg: the headline hash config first,
@@ -2283,7 +2504,7 @@ def main() -> None:
     priority = {"3": 0, "5": 1, "4": 2}
     device_keys = sorted(
         (k for k in which
-         if k not in ("1", "2", "6", "7", "8", "9", "10", "11")),
+         if k not in ("1", "2", "6", "7", "8", "9", "10", "11", "12")),
         key=lambda k: priority.get(k, 9)
     )
     if device_keys:

@@ -38,6 +38,7 @@ from .session import (
 from .wire import (
     CAP_CHANGE_BATCH,
     CAP_RECONCILE,
+    CAP_SNAPSHOT,
     Change,
     ProtocolError,
     decode_change,
@@ -81,6 +82,7 @@ __all__ = [
     "BatchPolicy",
     "CAP_CHANGE_BATCH",
     "CAP_RECONCILE",
+    "CAP_SNAPSHOT",
     "Change",
     "ProtocolError",
     "encode_change",

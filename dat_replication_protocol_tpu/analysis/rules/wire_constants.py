@@ -59,6 +59,16 @@ WATCHLIST = frozenset({
     # that silently never decodes against itself)
     "TYPE_RECONCILE", "CAP_RECONCILE", "RECONCILE_VERSION",
     "RATELESS_GAMMA", "RATELESS_MIX1", "RATELESS_MIX2",
+    # snapshot bootstrap (ISSUE 12): the frame type + capability bit +
+    # payload version (negotiation constants, the ChangeBatch/Reconcile
+    # failure class), and the weighted-participation constants — the
+    # variable-size extension's cell mapping is written down
+    # independently in ops/rateless.py and the native
+    # dat_rateless_build_w twin (`// wire:` markers); a fork maps
+    # chunks to DIFFERENT cells per engine (the GEAR route-fork class:
+    # a chunk-set sketch that silently never decodes against itself)
+    "TYPE_SNAPSHOT", "CAP_SNAPSHOT", "SNAPSHOT_VERSION",
+    "RATELESS_W_SHIFT", "RATELESS_W_CAP",
 })
 
 _C_PATTERNS = (

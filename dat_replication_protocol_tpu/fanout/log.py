@@ -64,13 +64,17 @@ _COALESCE_BELOW = 4096
 class SnapshotNeeded(ResumeError):
     """The requested offset is below the log's retained window: the
     receiver cannot be served from the log alone and must fetch a
-    snapshot (or restart) out of band.  ``retained`` is the
-    ``(start, end)`` window that *is* servable."""
+    snapshot first.  ``retained`` is the ``(start, end)`` window that
+    *is* servable; ``hint`` (when the deployment serves the snapshot
+    bootstrap protocol, ISSUE 12) names where — a dict like
+    ``{"port": N, "cap": CAP_SNAPSHOT}`` the fan-out server attaches so
+    joiners can redirect without out-of-band config."""
 
     def __init__(self, message: str, *, offset: int,
-                 retained: tuple[int, int]):
+                 retained: tuple[int, int], hint: dict | None = None):
         super().__init__(message, offset=offset)
         self.retained = retained
+        self.hint = hint
 
 
 class BroadcastCursor:

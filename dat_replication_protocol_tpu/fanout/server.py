@@ -32,7 +32,9 @@ path):
 
 Late joiners attach at any retained offset
 (:meth:`BroadcastLog.attach`); past the window they get the structured
-:class:`~.log.SnapshotNeeded` instead of silently wrong bytes.
+:class:`~.log.SnapshotNeeded` instead of silently wrong bytes — and
+when the deployment serves the snapshot bootstrap (ISSUE 12,
+``snapshot_hint``), the refusal carries the redirect that answers it.
 """
 
 from __future__ import annotations
@@ -208,6 +210,7 @@ class FanoutServer:
         max_iov: int = 64,
         stall_timeout: float = 30.0,
         linger_s: float = 0.0005,
+        snapshot_hint: Optional[dict] = None,
     ):
         self.log = log if log is not None else BroadcastLog(
             retention_budget=retention_budget)
@@ -216,6 +219,13 @@ class FanoutServer:
         self.max_iov = int(max_iov)
         self.stall_timeout = float(stall_timeout)
         self._linger_s = float(linger_s)
+        # where the snapshot bootstrap answers what this log cannot
+        # (ISSUE 12): a dict like {"port": N, "cap": CAP_SNAPSHOT}
+        # attached to every SnapshotNeeded raised at attach, so a
+        # trimmed-past joiner learns the redirect IN the refusal —
+        # no out-of-band config.  Settable after construction (the
+        # sidecar binds the snapshot listener late).
+        self.snapshot_hint = snapshot_hint
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._peers: dict[str, _PeerState] = {}
@@ -289,7 +299,8 @@ class FanoutServer:
         Raises :class:`FanoutBusy` at ``max_peers`` (admission — stage
         one of the overload contract) and the structured
         :class:`~.log.SnapshotNeeded` for an offset below the retained
-        window."""
+        window — carrying ``snapshot_hint`` when set, so the caller can
+        redirect the joiner to the bootstrap protocol."""
         if (fd is None) == (sink is None):
             raise ValueError("exactly one of fd/sink is required")
         if not isinstance(key, str) or not key or any(
@@ -323,7 +334,15 @@ class FanoutServer:
                 fd = os.dup(fd)
                 os.set_blocking(fd, False)
             try:
-                cursor = self.log.attach(key, offset)  # SnapshotNeeded
+                cursor = self.log.attach(key, offset)
+            except SnapshotNeeded as e:
+                # the one refusal the stack can now ANSWER: attach the
+                # bootstrap hint so the joiner redirects to the
+                # snapshot protocol instead of being stranded
+                if fd is not None:
+                    os.close(fd)
+                e.hint = self.snapshot_hint
+                raise
             except BaseException:
                 if fd is not None:
                     os.close(fd)

@@ -1059,6 +1059,85 @@ int64_t dat_rateless_build(const uint8_t* digests, int64_t n,
   return 0;
 }
 
+// Weighted (variable-size element) twin of dat_rateless_build — the
+// "Rateless Bloom Filters" extension the snapshot bootstrap (ISSUE 12)
+// reconciles CDC chunk sets with.  Cells are 12 u32 words: count, two
+// checksum words (the chain above extended by one mix over the length
+// word), 8 digest words, and a wrapping-u32 LENGTH word.  The drawn
+// index gap divides (integer division, clamped to >= 1) by
+// weight_class + 1, where weight_class = min(W_CAP,
+// bit_length(len >> W_SHIFT)) — heavy chunks participate more densely.
+// The participation constants are written down independently in
+// ops/rateless.py; a fork is a ROUTE fork, parity machine-checked:
+// wire: RATELESS_W_SHIFT = 12
+// wire: RATELESS_W_CAP = 8
+int64_t dat_rateless_build_w(const uint8_t* digests, const int64_t* lens,
+                             int64_t n, uint64_t* state, uint64_t* next,
+                             int64_t base, int64_t m, uint32_t* cells,
+                             int64_t nthreads) {
+  const int64_t width = (m - base) * 12;
+  int nt = pick_threads(nthreads, n, 1024);
+  std::vector<uint32_t*> partials(static_cast<size_t>(nt), nullptr);
+  for (int k = 1; k < nt; ++k) {
+    partials[static_cast<size_t>(k)] =
+        new (std::nothrow) uint32_t[static_cast<size_t>(width)]();
+    if (partials[static_cast<size_t>(k)] == nullptr) {
+      for (int j = 1; j < k; ++j) delete[] partials[static_cast<size_t>(j)];
+      return DAT_ERR_NOMEM;
+    }
+  }
+  parallel_for(n, nt, 1024, [&](int64_t lo, int64_t hi, int64_t k) {
+    uint32_t* block = k > 0 ? partials[static_cast<size_t>(k)] : cells;
+    for (int64_t e = lo; e < hi; ++e) {
+      const uint8_t* d = digests + e * 32;
+      const uint64_t len = static_cast<uint64_t>(lens[e]);
+      uint32_t row[12];
+      row[0] = 1u;
+      uint64_t lanes[4];
+      std::memcpy(lanes, d, 32);
+      uint64_t acc = rateless_mix64(lanes[0] + 0x9E3779B97F4A7C15ULL);
+      for (int i = 1; i < 4; ++i) acc = rateless_mix64(acc ^ lanes[i]);
+      acc = rateless_mix64(acc ^ static_cast<uint64_t>(
+                                     static_cast<uint32_t>(len)));
+      row[1] = static_cast<uint32_t>(acc);
+      row[2] = static_cast<uint32_t>(acc >> 32);
+      std::memcpy(row + 3, d, 32);
+      row[11] = static_cast<uint32_t>(len);
+      uint64_t wclass = 0;
+      for (uint64_t v = len >> 12; v != 0 && wclass < 8; v >>= 1) ++wclass;
+      const uint64_t div = wclass + 1;
+      uint64_t st = state[e], nx = next[e];
+      const uint64_t bound = static_cast<uint64_t>(m);
+      const uint64_t lo_b = static_cast<uint64_t>(base);
+      while (nx < bound) {
+        if (nx >= lo_b) {
+          uint32_t* c = block + static_cast<int64_t>(nx - lo_b) * 12;
+          for (int w = 0; w < 12; ++w) c[w] += row[w];
+        }
+        st += 0x9E3779B97F4A7C15ULL;
+        uint32_t r32 = static_cast<uint32_t>(rateless_mix64(st) >> 32);
+        double cur = static_cast<double>(nx);
+        double gap = std::ceil(
+            (cur + 1.5) * (65536.0 / std::sqrt(static_cast<double>(r32) + 1.0)
+                           - 1.0));
+        if (gap < 1.0) gap = 1.0;
+        uint64_t g = static_cast<uint64_t>(gap) / div;
+        if (g < 1) g = 1;
+        nx += g;
+      }
+      state[e] = st;
+      next[e] = nx;
+    }
+  });
+  for (size_t k = 1; k < partials.size(); ++k) {
+    if (partials[k] != nullptr) {
+      for (int64_t w = 0; w < width; ++w) cells[w] += partials[k][w];
+      delete[] partials[k];
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"
 
 extern "C" {

@@ -55,6 +55,8 @@ shared helper.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..obs.metrics import OBS as _OBS, counter as _counter
@@ -64,6 +66,17 @@ DIGEST_BYTES = 32
 DIGEST_WORDS = 8
 SYMBOL_WORDS = 11  # count + 2 checksum words + 8 sum words
 SYMBOL_BYTES = SYMBOL_WORDS * 4
+
+# Weighted (variable-size element) cells — the "Rateless Bloom Filters"
+# extension (PAPERS.md) the snapshot bootstrap reconciles CDC chunk
+# sets with (ISSUE 12): an element is a (digest, byte length) pair and
+# the cell grows one wrapping-u32 LENGTH word, so a recovered element
+# carries its size — the joiner learns exactly how many bytes each
+# missing chunk is, and the participation density below can be
+# recomputed from the recovered value alone (nothing out-of-band, the
+# same recoverability invariant as the unweighted construction).
+WSYMBOL_WORDS = 12  # count + 2 checksum words + 8 sum words + length
+WSYMBOL_BYTES = WSYMBOL_WORDS * 4
 
 # telemetry (OBSERVABILITY.md "reconcile.*"): symbols built (cells
 # produced into a local prefix) and elements recovered by peeling
@@ -78,6 +91,18 @@ _M_PEELED = _counter("reconcile.peeled")
 RATELESS_GAMMA = 0x9E3779B97F4A7C15
 RATELESS_MIX1 = 0xBF58476D1CE4E5B9
 RATELESS_MIX2 = 0x94D049BB133111EB
+
+# weighted-participation constants (same parity story — the native
+# dat_rateless_build_w twin carries `// wire:` markers): an element's
+# weight class is ``min(W_CAP, bit_length(len >> W_SHIFT))`` and its
+# index gaps divide by ``class + 1``, so a 1 MiB chunk participates in
+# ~9x the cells of a 4 KiB one — heavy chunks decode first, which is
+# what makes the WANT set's wire cost track BYTES of divergence, not
+# just element count ("Rateless Bloom Filters", PAPERS.md).  A fork
+# here maps elements to DIFFERENT cells per engine: the GEAR
+# route-fork class.
+RATELESS_W_SHIFT = 12
+RATELESS_W_CAP = 8
 
 _GAMMA = np.uint64(RATELESS_GAMMA)
 _MIX1 = np.uint64(RATELESS_MIX1)
@@ -250,17 +275,20 @@ def build_symbols_device(rows: np.ndarray, elems: np.ndarray,
 
             # one dump row past the block swallows the padding updates;
             # clip keeps every index in-range regardless of backend OOB
-            # semantics
-            table = jnp.zeros((nsym + 1, SYMBOL_WORDS), dtype=jnp.uint32)
+            # semantics.  Cell width comes from the rows themselves
+            # (static at trace time), so the SAME program serves both
+            # the 11-word unweighted and 12-word weighted layouts.
+            table = jnp.zeros((nsym + 1, rows.shape[1]), dtype=jnp.uint32)
             idxs = jnp.minimum(idxs, nsym)
             return table.at[idxs].add(rows[elems])[:nsym]
 
         _BUILD_JIT = _jit_site("ops.rateless.build",
                                jax.jit(_build, static_argnums=(3,)))
+    width = rows.shape[1] if getattr(rows, "ndim", 0) == 2 else SYMBOL_WORDS
     if len(elems) == 0 or len(rows) == 0:
         # nothing to scatter (an empty set, or a fully-covered cursor):
         # the gather below must never index a 0-row array
-        return np.zeros((m - base, SYMBOL_WORDS), dtype=np.uint32)
+        return np.zeros((m - base, width), dtype=np.uint32)
     k = len(elems)
     cap = max(16, 1 << (k - 1).bit_length()) if k else 16
     pe = np.zeros(cap, dtype=np.int32)
@@ -454,3 +482,280 @@ class PeelDecoder:
         if not complete:
             return None
         return digests, signs
+
+
+# -- weighted (variable-size element) extension ------------------------------
+#
+# The snapshot bootstrap (ISSUE 12) reconciles CDC chunk SETS, whose
+# elements carry a byte length.  The construction below is the
+# "Rateless Bloom Filters" variable-size extension of everything above:
+# same splitmix64 draw stream, same index line, but (a) the cell grows
+# a wrapping-u32 LENGTH word (and the checksum chain covers it), and
+# (b) index gaps divide by ``weight_class + 1`` so heavy chunks
+# participate more densely and decode earlier.  Both additions preserve
+# the recoverability invariant: a pure cell's sum IS (digest, length),
+# and the weighted cursor is recomputable from that pair alone.
+
+
+def weight_classes(lens) -> np.ndarray:
+    """Weight class per element: ``min(RATELESS_W_CAP,
+    bit_length(len >> RATELESS_W_SHIFT))`` as uint64 — pure integer
+    math, bit-identical across engines (the native twin runs the same
+    shift loop)."""
+    v = np.asarray(lens, dtype=np.uint64) >> np.uint64(RATELESS_W_SHIFT)
+    c = np.zeros(len(v), dtype=np.uint64)
+    for _ in range(RATELESS_W_CAP):
+        nz = v > 0
+        if not nz.any():
+            break
+        c[nz] += np.uint64(1)
+        v = v >> np.uint64(1)
+    return c
+
+
+def _as_len_words(lens) -> np.ndarray:
+    lens = np.asarray(lens)
+    arr = lens.astype(np.int64, copy=False)
+    if len(arr) and (arr < 0).any():
+        raise ValueError("element lengths must be >= 0")
+    if len(arr) and (arr >> 32).any():
+        raise ValueError("element lengths must fit in u32")
+    return arr.astype(np.uint32)
+
+
+def weighted_checksum_words(sum_words: np.ndarray,
+                            len_words: np.ndarray) -> np.ndarray:
+    """64-bit checksum of each (digest, length) row as (n, 2) u32 words:
+    the :func:`checksum_words` chain extended by one mix over the
+    length word, so a cell whose sum and length were perturbed together
+    still fails the pure test."""
+    w = np.ascontiguousarray(sum_words, dtype=np.uint32)
+    lanes = w.view("<u8")
+    acc = _mix64(lanes[:, 0] + _GAMMA)
+    for k in range(1, 4):
+        acc = _mix64(acc ^ lanes[:, k])
+    acc = _mix64(acc ^ np.asarray(len_words, np.uint32).astype(np.uint64))
+    out = np.empty((len(w), 2), dtype=np.uint32)
+    out[:, 0] = (acc & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out[:, 1] = (acc >> np.uint64(32)).astype(np.uint32)
+    return out
+
+
+def weighted_element_rows(digests: np.ndarray, lens) -> np.ndarray:
+    """(n, 32) u8 digests + lengths -> (n, 12) u32 weighted symbol rows
+    (count=1)."""
+    words = _digest_words(digests)
+    lw = _as_len_words(lens)
+    if len(lw) != len(words):
+        raise ValueError("digests and lens must align")
+    rows = np.empty((len(words), WSYMBOL_WORDS), dtype=np.uint32)
+    rows[:, 0] = 1
+    rows[:, 1:3] = weighted_checksum_words(words, lw)
+    rows[:, 3:11] = words
+    rows[:, 11] = lw
+    return rows
+
+
+class WeightedIndexCursor:
+    """:class:`IndexCursor` for (digest, length) elements: the SAME
+    splitmix64 draw stream and gap formula, with the drawn gap divided
+    (integer division, then clamped to >= 1) by ``weight_class + 1`` —
+    the one owner of the weighted float math, shared by the numpy and
+    device routes; the native engine advances the same arrays in
+    place."""
+
+    def __init__(self, digests: np.ndarray, lens):
+        words = _digest_words(digests)
+        lw = _as_len_words(lens)
+        if len(lw) != len(words):
+            raise ValueError("digests and lens must align")
+        self._state = words.view("<u8")[:, 0].astype(np.uint64, copy=True)
+        self._next = np.zeros(len(words), dtype=np.uint64)
+        self._div = weight_classes(lw) + np.uint64(1)
+
+    def advance(self, bound: int) -> tuple[np.ndarray, np.ndarray]:
+        out_e: list[np.ndarray] = []
+        out_i: list[np.ndarray] = []
+        b = np.uint64(bound)
+        active = np.nonzero(self._next < b)[0]
+        while active.size:
+            idx = self._next[active]
+            out_e.append(active.astype(np.int64))
+            out_i.append(idx.astype(np.int64))
+            st = self._state[active] + _GAMMA
+            self._state[active] = st
+            r = (_mix64(st) >> np.uint64(32)).astype(np.float64)
+            cur = idx.astype(np.float64)
+            gap = np.ceil(
+                (cur + 1.5) * (np.float64(1 << 16) / np.sqrt(r + 1.0) - 1.0)
+            )
+            gap_u = np.maximum(gap, 1.0).astype(np.uint64)
+            gap_u = np.maximum(gap_u // self._div[active], np.uint64(1))
+            self._next[active] = idx + gap_u
+            active = active[self._next[active] < b]
+        if not out_e:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        return np.concatenate(out_e), np.concatenate(out_i)
+
+
+class WeightedSymbols:
+    """One replica's weighted coded-symbol prefix over a chunk set —
+    the :class:`CodedSymbols` shape for (digest, length) elements, same
+    three byte-identical engines (native ``dat_rateless_build_w``,
+    numpy reference, jitted JAX scatter-add — the device build is the
+    SAME cached program, specialized to the 12-word row width)."""
+
+    def __init__(self, digests: np.ndarray, lens, engine: str = "auto"):
+        if engine not in ("auto", "host", "numpy", "device"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.digests = np.ascontiguousarray(digests, dtype=np.uint8)
+        self.lens = np.ascontiguousarray(
+            np.asarray(lens, dtype=np.int64))
+        self.n = len(self.digests)
+        self._rows = None
+        self._cursor = WeightedIndexCursor(self.digests, self.lens)
+        self._cells = np.zeros((0, WSYMBOL_WORDS), dtype=np.uint32)
+        self._engine = engine
+        # unlike CodedSymbols (one per reconcile session), a weighted
+        # prefix is SHARED per snapshot manifest across concurrent
+        # responder sessions — extend() is a read-modify-write of the
+        # in-place cursor arrays (the native engine mutates them too),
+        # so it must serialize
+        self._lock = threading.Lock()
+
+    @property
+    def rows(self) -> np.ndarray:
+        if self._rows is None:
+            self._rows = weighted_element_rows(self.digests, self.lens)
+        return self._rows
+
+    def _extend_block(self, have: int, m: int) -> np.ndarray:
+        if self._engine in ("auto", "host"):
+            from ..runtime import native
+
+            block = native.rateless_build_w(
+                self.digests, self.lens, self._cursor._state,
+                self._cursor._next, m, have)
+            if block is not None:
+                return block
+        elems, idxs = self._cursor.advance(m)
+        if self._engine == "device":
+            return build_symbols_device(self.rows, elems, idxs, m, have)
+        cells = np.zeros((m - have, WSYMBOL_WORDS), dtype=np.uint32)
+        np.add.at(cells, idxs - have, self.rows[elems])
+        return cells
+
+    def extend(self, m: int) -> np.ndarray:
+        with self._lock:
+            have = len(self._cells)
+            if m <= have:
+                return self._cells[:m]
+            with span("reconcile.build"):
+                block = self._extend_block(have, m)
+            self._cells = np.concatenate([self._cells, block]) \
+                if have else block
+            if _OBS.on:
+                _M_SYMBOLS.inc(m - have)
+            return self._cells
+
+
+def peel_weighted(work: np.ndarray, max_rounds: int = 1 << 20,
+                  ) -> tuple[np.ndarray, np.ndarray, np.ndarray, bool]:
+    """:func:`peel` for weighted cells, IN PLACE.  Returns
+    ``(digests (k, 32) u8, lens (k,) int64, signs (k,) int8, complete)``
+    — the recovered elements carry their byte lengths."""
+    m = len(work)
+    rec_digests: list[np.ndarray] = []
+    rec_lens: list[np.ndarray] = []
+    rec_signs: list[np.ndarray] = []
+    with span("reconcile.peel"):
+        for _ in range(max_rounds):
+            cnt = _counts_i32(work)
+            cand = np.nonzero((cnt == 1) | (cnt == -1))[0]
+            if not cand.size:
+                break
+            signs = np.where(cnt[cand] == 1, 1, -1).astype(np.int8)
+            sums = work[cand, 3:11]
+            lenw = work[cand, 11]
+            css = work[cand, 1:3]
+            negm = signs == -1
+            if negm.any():
+                sums = sums.copy()
+                css = css.copy()
+                lenw = lenw.copy()
+                sums[negm] = _neg(sums[negm])
+                css[negm] = _neg(css[negm])
+                lenw[negm] = (np.uint32(0) - lenw[negm]).astype(np.uint32)
+            ok = (weighted_checksum_words(sums, lenw) == css).all(axis=1)
+            if not ok.any():
+                break
+            vals = np.ascontiguousarray(sums[ok], dtype=np.uint32)
+            signs = signs[ok]
+            lens = lenw[ok].astype(np.int64)
+            digests = vals.view(np.uint8).reshape(-1, DIGEST_BYTES)
+            digests, first = dedupe_digests(digests)
+            signs = signs[first]
+            lens = lens[first]
+            rows = weighted_element_rows(digests, lens)
+            srows = rows.copy()
+            if (signs == -1).any():
+                srows[signs == -1] = _neg(rows[signs == -1])
+            elems, idxs = WeightedIndexCursor(digests, lens).advance(m)
+            np.subtract.at(work, idxs, srows[elems])
+            rec_digests.append(digests)
+            rec_lens.append(lens)
+            rec_signs.append(signs)
+    if rec_digests:
+        digests = np.concatenate(rec_digests)
+        lens = np.concatenate(rec_lens)
+        signs = np.concatenate(rec_signs)
+    else:
+        digests = np.empty((0, DIGEST_BYTES), np.uint8)
+        lens = np.empty(0, np.int64)
+        signs = np.empty(0, np.int8)
+    complete = not work.any()
+    if _OBS.on and len(digests):
+        _M_PEELED.inc(len(digests))
+    return digests, lens, signs, complete
+
+
+class WeightedPeelDecoder:
+    """The receiving half of a weighted (chunk-set) reconciliation —
+    :class:`PeelDecoder` over (digest, length) elements."""
+
+    def __init__(self, local_digests: np.ndarray, local_lens,
+                 engine: str = "auto", assume_unique: bool = False):
+        digests = np.ascontiguousarray(local_digests, dtype=np.uint8)
+        lens = np.ascontiguousarray(np.asarray(local_lens, dtype=np.int64))
+        if not assume_unique:
+            digests, first = dedupe_digests(digests)
+            lens = lens[first]
+        self.local = WeightedSymbols(digests, lens, engine=engine)
+        self._remote = np.zeros((0, WSYMBOL_WORDS), dtype=np.uint32)
+        self.symbols_seen = 0
+
+    def add_symbols(self, start: int, cells: np.ndarray) -> None:
+        cells = np.ascontiguousarray(cells, dtype=np.uint32)
+        if cells.ndim != 2 or cells.shape[1] != WSYMBOL_WORDS:
+            raise ValueError(f"cells must be (k, {WSYMBOL_WORDS}) u32")
+        if start != self.symbols_seen:
+            raise ValueError(
+                f"symbol run starts at {start}, expected {self.symbols_seen}"
+            )
+        self._remote = np.concatenate([self._remote, cells]) \
+            if self.symbols_seen else cells
+        self.symbols_seen = len(self._remote)
+
+    def try_decode(self):
+        """``None`` when more symbols are needed; otherwise
+        ``(digests, lens, signs)`` — sign +1: remote-only (the chunks
+        this side is missing), −1: local-only."""
+        m = self.symbols_seen
+        if m == 0:
+            return None
+        local = self.local.extend(m)
+        work = (self._remote - local).astype(np.uint32)
+        digests, lens, signs, complete = peel_weighted(work)
+        if not complete:
+            return None
+        return digests, lens, signs

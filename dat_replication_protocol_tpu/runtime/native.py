@@ -154,6 +154,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _U8P, ctypes.c_int64, _U64P, _U64P,
         ctypes.c_int64, ctypes.c_int64, _U32P, ctypes.c_int64,
     ]
+    lib.dat_rateless_build_w.restype = ctypes.c_int64
+    lib.dat_rateless_build_w.argtypes = [
+        _U8P, _I64P, ctypes.c_int64, _U64P, _U64P,
+        ctypes.c_int64, ctypes.c_int64, _U32P, ctypes.c_int64,
+    ]
     return lib
 
 
@@ -375,6 +380,28 @@ def rateless_build(digests: np.ndarray, state: np.ndarray,
     rc = lib.dat_rateless_build(digests.reshape(-1), len(state), state,
                                 next_idx, base, m, cells.reshape(-1),
                                 _nthreads())
+    if rc != 0:
+        return None
+    return cells
+
+
+def rateless_build_w(digests: np.ndarray, lens: np.ndarray,
+                     state: np.ndarray, next_idx: np.ndarray,
+                     m: int, base: int = 0):
+    """Weighted coded-symbol build over (digest, length) elements (see
+    ops/rateless.py's variable-size extension): same INOUT cursor
+    contract as :func:`rateless_build`, 12-word cells, or ``None`` when
+    the native library is unavailable (callers fall back to the numpy
+    reference — byte-identical by construction)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    digests = np.ascontiguousarray(digests, dtype=np.uint8)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    cells = np.zeros((m - base, 12), dtype=np.uint32)
+    rc = lib.dat_rateless_build_w(digests.reshape(-1), lens, len(state),
+                                  state, next_idx, base, m,
+                                  cells.reshape(-1), _nthreads())
     if rc != 0:
         return None
     return cells

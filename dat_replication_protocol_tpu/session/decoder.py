@@ -40,7 +40,7 @@ from ..obs.watermarks import WATERMARKS as _WATERMARKS
 from ..wire.change_codec import Change, decode_change
 from ..wire.framing import LOCAL_CAPS, MAX_HEADER_LEN, TYPE_BLOB, \
     TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_HEADER, TYPE_RECONCILE, \
-    ProtocolError
+    TYPE_SNAPSHOT, ProtocolError
 from ..wire.framing import header_len as _header_len
 from ..wire.varint import decode_uvarint
 
@@ -59,6 +59,8 @@ _M_DEC_ERRORS = _counter("decoder.errors")
 _M_DEC_BATCH_FRAMES = _counter("decoder.batch.frames")
 # reconcile protocol frames dispatched (OBSERVABILITY.md "reconcile.*")
 _M_DEC_RC_FRAMES = _counter("decoder.reconcile.frames")
+# snapshot protocol frames dispatched (OBSERVABILITY.md "snapshot.*")
+_M_DEC_SN_FRAMES = _counter("decoder.snapshot.frames")
 # per-write() dispatch latency: bytes in -> handlers fired (or stalled)
 _H_DEC_DISPATCH = _histogram("decoder.dispatch.seconds")
 
@@ -215,9 +217,11 @@ class Decoder:
         self._on_change: Callable[[Change, Callable[[], None]], None] | None = None
         self._on_change_batch = None  # whole-batch columnar handler
         self._on_reconcile = None  # reconcile protocol message handler
-        # reconcile frames delivered: rides _frames_delivered (a
-        # reconcile frame never touches the change-row counters)
+        self._on_snapshot = None  # snapshot protocol message handler
+        # reconcile/snapshot frames delivered: ride _frames_delivered
+        # (neither touches the change-row counters)
         self.reconcile_frames = 0
+        self.snapshot_frames = 0
         self._on_blob: Callable[[BlobReader, Callable[[], None]], None] | None = None
         self._on_finalize: Callable[[Callable[[], None]], None] | None = None
         self._error_cbs: list[Callable[[Exception | None], None]] = []
@@ -286,6 +290,16 @@ class Decoder:
         handler, reconcile frames are dropped — the same
         never-deadlock default as unhandled changes."""
         self._on_reconcile = cb
+        return self
+
+    def snapshot(self, cb) -> "Decoder":
+        """Register the snapshot-message handler: ``cb(msg, done)``
+        receives each ``TYPE_SNAPSHOT`` frame's decoded
+        :class:`~..wire.snapshot_codec.SnapshotMsg` and one ``done``
+        per frame (the snapshot driver's receive surface).  Without a
+        handler, snapshot frames are dropped — the same never-deadlock
+        default as unhandled changes."""
+        self._on_snapshot = cb
         return self
 
     def change_batch(self, cb) -> "Decoder":
@@ -467,11 +481,11 @@ class Decoder:
         ONE frame however many rows it carries: its rows are subtracted
         back out of ``changes`` and the frame counts once, at full
         delivery (mid-batch it is the frame being parsed, like a
-        mid-payload blob).  A reconcile frame counts once, at delivery,
-        via its own counter."""
+        mid-payload blob).  A reconcile/snapshot frame counts once, at
+        delivery, via its own counter."""
         return (self.changes - self._batch_rows_seen
                 + self._batch_frames_done + self.blobs
-                + self.reconcile_frames
+                + self.reconcile_frames + self.snapshot_frames
                 - (1 if self._current_blob is not None else 0))
 
     def _checkpoint_digest(self) -> dict:
@@ -992,6 +1006,16 @@ class Decoder:
                         return
                     if self._stalled():
                         return
+                elif type_id == TYPE_SNAPSHOT:
+                    # same whole-frame doctrine as reconcile
+                    f += 1
+                    self._missing = 0
+                    self._finish_snapshot(buf[start : start + flen])
+                    if self.destroyed:
+                        self._bulk = None
+                        return
+                    if self._stalled():
+                        return
                 elif type_id == TYPE_BLOB:
                     if not st["blob_open"]:
                         self._state = TYPE_BLOB
@@ -1201,6 +1225,8 @@ class Decoder:
             return self._batch_data(chunk)
         if self._state == TYPE_RECONCILE:
             return self._reconcile_data(chunk)
+        if self._state == TYPE_SNAPSHOT:
+            return self._snapshot_data(chunk)
         raise AssertionError(f"bad parser state {self._state}")
 
     def _scan_header(self, chunk: memoryview) -> memoryview | None:
@@ -1238,6 +1264,9 @@ class Decoder:
                     self._payload_parts = None
                 elif type_id == TYPE_RECONCILE:
                     self._state = TYPE_RECONCILE
+                    self._payload_parts = None
+                elif type_id == TYPE_SNAPSHOT:
+                    self._state = TYPE_SNAPSHOT
                     self._payload_parts = None
                 elif type_id == TYPE_BLOB:
                     self._state = TYPE_BLOB
@@ -1543,6 +1572,48 @@ class Decoder:
         if self._on_reconcile is not None:
             ack = _FastAck(self)
             self._on_reconcile(msg, ack)
+            if ack.state != 1:
+                with self._ack_lock:
+                    if ack.state == 0:
+                        ack.state = 2  # armed: handler went async
+                        self._pending += 1
+        # default: drop (the unhandled-changes doctrine)
+
+    # -- snapshot frames -----------------------------------------------------
+
+    def _snapshot_data(self, chunk: memoryview) -> memoryview | None:
+        return self._sized_payload_data(chunk, self._finish_snapshot)
+
+    def _finish_snapshot(self, payload) -> None:
+        """Decode one complete snapshot payload and dispatch it whole.
+
+        Structural corruption (bad subtype/version, truncated chunk
+        entry, trailing bytes) destroys the session with a
+        ProtocolError exactly like a corrupt Change payload — the
+        fault-injection contract: a snapshot session fails STRUCTURED,
+        never assembles from a torn frame (a flipped chunk BODY is the
+        per-chunk digest verification's job in the joiner)."""
+        from ..wire import snapshot_codec
+
+        try:
+            msg = snapshot_codec.decode_snapshot(payload)
+        except ValueError as e:
+            self.destroy(self._protocol_error(str(e), cause=e))
+            return
+        if _OBS.on:
+            _M_DEC_SN_FRAMES.inc()
+            _trace_instant("decoder.frame", offset=self._frame_start,
+                           kind="snapshot",
+                           wire_len=_header_len(len(payload))
+                           + len(payload))
+        self._state = TYPE_HEADER
+        # delivery consumes the frame BEFORE the handler can raise (the
+        # change/blob doctrine): a caught raise-then-resume re-enters at
+        # the next frame, never re-delivering this message
+        self.snapshot_frames += 1
+        if self._on_snapshot is not None:
+            ack = _FastAck(self)
+            self._on_snapshot(msg, ack)
             if ack.state != 1:
                 with self._ack_lock:
                     if ack.state == 0:

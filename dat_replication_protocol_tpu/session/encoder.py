@@ -36,9 +36,9 @@ from ..obs.metrics import OBS as _OBS, counter as _counter, \
 from ..obs.tracing import trace_instant as _trace_instant
 from ..wire.change_codec import Change, _check_uint32, \
     _encode_change_with, _fastpath_mod, encode_change
-from ..wire.framing import CAP_CHANGE_BATCH, CAP_RECONCILE, TYPE_BLOB, \
-    TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_RECONCILE, frame_header, \
-    frame_wire_len
+from ..wire.framing import CAP_CHANGE_BATCH, CAP_RECONCILE, CAP_SNAPSHOT, \
+    TYPE_BLOB, TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_RECONCILE, \
+    TYPE_SNAPSHOT, frame_header, frame_wire_len
 
 OnDone = Optional[Callable[[], None]]
 
@@ -64,6 +64,9 @@ _M_BATCH_SAVED = _counter("wire.batch.bytes_saved")
 # anti-entropy protocol's entire communication cost rides these
 _M_RC_FRAMES = _counter("reconcile.frames")
 _M_RC_WIRE = _counter("reconcile.wire_bytes")
+# snapshot protocol frames emitted (OBSERVABILITY.md "snapshot.*")
+_M_SN_FRAMES = _counter("snapshot.frames")
+_M_SN_WIRE = _counter("snapshot.wire_bytes")
 
 DEFAULT_HIGH_WATER = 64 * 1024
 
@@ -603,6 +606,41 @@ class Encoder:
             _M_RC_WIRE.inc(len(header) + len(payload))
             _trace_instant("encoder.frame", offset=self.bytes,
                            kind="reconcile",
+                           wire_len=len(header) + len(payload))
+        return self._push(header + payload, on_flush)
+
+    def snapshot_frame(self, payload, on_flush: OnDone = None) -> bool:
+        """Frame one snapshot protocol message (``TYPE_SNAPSHOT``;
+        payload built by :mod:`..wire.snapshot_codec`).
+
+        Strictly negotiated: raises unless the receiving peer advertised
+        ``CAP_SNAPSHOT`` — an un-negotiated encoder therefore emits the
+        reference wire byte-exactly (same golden contract as ChangeBatch
+        and Reconcile).  Pending batch rows flush first (frame order is
+        submission order); an open blob is an API error — the snapshot
+        driver never interleaves the two."""
+        if self.destroyed:
+            raise EncoderDestroyedError("snapshot_frame after destroy")
+        if self.finalized:
+            raise EncoderDestroyedError("snapshot_frame after finalize")
+        if not (self.peer_caps & CAP_SNAPSHOT):
+            raise ValueError(
+                "peer did not advertise CAP_SNAPSHOT; snapshot frames "
+                "cannot be emitted to it (WIRE.md capability negotiation)"
+            )
+        if self._open_blobs:
+            raise ValueError(
+                "snapshot_frame with a blob open is unsupported"
+            )
+        if self._batch_rows:
+            self.flush_batch()
+        payload = bytes(payload)
+        header = frame_header(len(payload), TYPE_SNAPSHOT)
+        if _OBS.on:
+            _M_SN_FRAMES.inc()
+            _M_SN_WIRE.inc(len(header) + len(payload))
+            _trace_instant("encoder.frame", offset=self.bytes,
+                           kind="snapshot",
                            wire_len=len(header) + len(payload))
         return self._push(header + payload, on_flush)
 

@@ -311,7 +311,10 @@ def run_subscriber(conn: socket.socket, fanout, key: str) -> dict:
 
     A joiner asking below the retained window gets a structured
     ``{"snapshot_needed": true, "retained": [start, end]}`` record and
-    EOF; admission rejection gets ``{"rejected": true}`` — bounded
+    EOF — plus a ``"hint"`` naming the snapshot bootstrap port when
+    the deployment serves it (``--snapshot``, ISSUE 12), so the joiner
+    redirects without out-of-band config; admission rejection gets
+    ``{"rejected": true}`` — bounded
     state, never queue growth (the hub's contract, restated for peers).
     A subscriber that SENDS data is a misrouted source (it raced a
     connection holding the source claim): it gets a structured
@@ -327,6 +330,11 @@ def run_subscriber(conn: socket.socket, fanout, key: str) -> dict:
     except SnapshotNeeded as e:
         out = {"fanout_peer": key, "ok": False, "snapshot_needed": True,
                "retained": list(e.retained)}
+        if e.hint is not None:
+            # the deployment serves the snapshot bootstrap (ISSUE 12):
+            # the refusal record carries the redirect — port +
+            # capability — so the joiner needs no out-of-band config
+            out["hint"] = dict(e.hint)
         try:
             conn.sendall((json.dumps(out) + "\n").encode())
             conn.shutdown(socket.SHUT_WR)
@@ -446,6 +454,99 @@ def load_reconcile_replica(path: str):
         return RatelessReplica(f.read())
 
 
+def run_snapshot_session(conn_read, conn_write, close_write,
+                         source, peer: str = "?") -> dict:
+    """Serve one snapshot bootstrap session (ISSUE 12): the client is a
+    *joiner* — it receives the manifest, reconciles its chunk set (or
+    WANTs everything when cold), and is streamed exactly the chunks it
+    is missing from the shared :class:`~.runtime.snapshot_driver.
+    SnapshotSource` (hashed ONCE, however many joiners connect).
+    Connecting to a ``--snapshot`` sidecar IS the out-of-band
+    capability advertisement (WIRE.md): both directions speak
+    ``CAP_SNAPSHOT``.
+
+    A failed session (corrupt stream, chunk budget, byzantine WANT)
+    surfaces as the driver's ONE structured ProtocolError; the client
+    observes the FAIL frame + EOF, never a hang."""
+    from .runtime.snapshot_driver import run_snapshot_responder
+    from .wire.framing import ProtocolError
+
+    try:
+        stats = run_snapshot_responder(source, conn_read, conn_write,
+                                       close_write=close_write)
+        out = {"snapshot": True, "ok": stats["ok"],
+               "cold": stats["cold"], "chunks_sent": stats["chunks_sent"],
+               "chunk_bytes_sent": stats["chunk_bytes_sent"],
+               "symbols": stats["symbols"], "rounds": stats["rounds"]}
+    except (ProtocolError, OSError) as e:
+        out = {"snapshot": True, "ok": False, "peer": peer,
+               "error": f"{type(e).__name__}: {e}"}
+    if _OBS.on:
+        _M_SESSIONS.inc()
+        _emit("sidecar.session", **out)
+    return out
+
+
+def load_snapshot_source(path: str, wire_offset: int = 0):
+    """Materialize the ``--snapshot DATAFILE`` dataset once: CDC cuts +
+    fused digests + manifest, shared by every responder session
+    (hash-once across the whole flash crowd)."""
+    from .runtime.snapshot_driver import SnapshotSource
+
+    with open(path, "rb") as f:
+        return SnapshotSource(f.read(), wire_offset=wire_offset)
+
+
+class SnapshotListener:
+    """The dedicated snapshot bootstrap port (the ``--fanout`` +
+    ``--snapshot`` composition): a tiny accept loop serving each
+    connection as one responder session off the shared source.  The
+    bound ``port`` rides the fan-out's ``snapshot_hint``, so the
+    structured snapshot-needed record a trimmed-past subscriber gets
+    names exactly where to bootstrap from."""
+
+    def __init__(self, source, host: str, port: int = 0):
+        self.source = source
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._served = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="sidecar-snapshot", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                conn, peer = self._srv.accept()
+            except OSError:
+                return  # closed: the daemon is shutting down
+            self._served += 1
+            n = self._served
+
+            def _one(conn=conn, peer=peer, n=n):
+                try:
+                    stats = run_snapshot_session(
+                        conn.recv, conn.sendall,
+                        lambda: conn.shutdown(socket.SHUT_WR),
+                        self.source, peer=f"{peer[0]}:{peer[1]}")
+                    print(f"sidecar: snapshot {peer} {stats}",
+                          file=sys.stderr, flush=True)
+                finally:
+                    conn.close()
+
+            threading.Thread(target=_one, name=f"sidecar-snap-{n}",
+                             daemon=True).start()
+
+    def close(self) -> None:
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
 def serve_stdio(drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
     """One session over stdin/stdout (logs go to stderr only)."""
     # close_write can fire from the session thread (drain-timeout
@@ -491,7 +592,7 @@ def serve_tcp(host: str, port: int,
               ready_cb=None,
               drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT,
               retry_policy=None, hub=None, fanout=None,
-              reconcile_replica=None) -> None:
+              reconcile_replica=None, snapshot_source=None) -> None:
     """Accept loop: one concurrent session per connection.
 
     ``max_sessions`` bounds the loop for tests; ``ready_cb(port)`` fires
@@ -565,6 +666,23 @@ def serve_tcp(host: str, port: int,
 
             def _one(conn=conn, peer=peer, n=served):
                 try:
+                    if snapshot_source is not None:
+                        # bootstrap mode (ISSUE 12): every connection is
+                        # one joiner served off the shared materialized
+                        # source (read-only after construction: sessions
+                        # never step on each other, hashing happened
+                        # once).  The --fanout composition does NOT pass
+                        # this — there the snapshot protocol lives on
+                        # its own SnapshotListener port and this loop
+                        # keeps serving the broadcast.
+                        stats = run_snapshot_session(
+                            conn.recv, conn.sendall,
+                            lambda: conn.shutdown(socket.SHUT_WR),
+                            snapshot_source,
+                            peer=f"{peer[0]}:{peer[1]}")
+                        print(f"sidecar: {peer} {stats}", file=sys.stderr,
+                              flush=True)
+                        return
                     if reconcile_replica is not None:
                         # anti-entropy mode (ISSUE 10): every connection
                         # is one reconcile initiator against the shared
@@ -883,6 +1001,28 @@ def main(argv=None) -> int:
                         "exactly the differing records (O(diff) wire "
                         "bytes; see DESIGN.md anti-entropy, WIRE.md "
                         "Reconcile)")
+    p.add_argument("--snapshot", metavar="DATAFILE", default=None,
+                   help="snapshot bootstrap mode (ISSUE 12): materialize "
+                        "DATAFILE once as content-addressed CDC chunks "
+                        "and serve every connection as a snapshot "
+                        "responder — a stale joiner reconciles its chunk "
+                        "set first and moves O(diff) bytes, a cold one "
+                        "streams the shared full-manifest log.  With "
+                        "--fanout the protocol is served on its own "
+                        "--snapshot-port and the structured "
+                        "snapshot-needed record carries the redirect "
+                        "hint (see WIRE.md Snapshot, DESIGN.md "
+                        "bootstrap)")
+    p.add_argument("--snapshot-port", type=int, default=0, metavar="PORT",
+                   help="dedicated snapshot listener port for the "
+                        "--fanout composition (default: 0 = ephemeral; "
+                        "the bound port rides the snapshot-needed "
+                        "hint)")
+    p.add_argument("--snapshot-offset", type=int, default=0,
+                   metavar="BYTES",
+                   help="live-log wire offset the --snapshot dataset "
+                        "materializes — where an assembled joiner "
+                        "attaches its live session (default: 0)")
     p.add_argument("--max-retries", type=int, default=5, metavar="N",
                    help="transient-failure budget: bind/accept errors are "
                         "retried with backoff at most N times before the "
@@ -947,6 +1087,10 @@ def main(argv=None) -> int:
     if args.backend == "host":
         os.environ["DAT_DEVICE_HASH"] = "0"  # routing-layer override:
         # force the host digest engine for this daemon's lifetime
+    if args.snapshot and (args.hub or args.reconcile):
+        p.error("--snapshot cannot combine with --hub/--reconcile "
+                "(it composes with --fanout, where it answers the "
+                "broadcast's snapshot-needed refusals)")
     hub = None
     if args.hub:
         if args.stdio:
@@ -978,6 +1122,10 @@ def main(argv=None) -> int:
             p.error("--reconcile is its own session mode; it cannot "
                     "combine with --hub/--fanout")
         replica = load_reconcile_replica(args.reconcile)
+    snapshot_source = None
+    if args.snapshot:
+        snapshot_source = load_snapshot_source(
+            args.snapshot, wire_offset=args.snapshot_offset)
     obs_srv = None
     if args.obs_http is not None:
         obs_metrics.enable()  # a dark endpoint would serve zeros
@@ -986,8 +1134,25 @@ def main(argv=None) -> int:
             admission_fn=_active_admission_fn()).start()
         print(f"sidecar: obs endpoint on {obs_srv.url}",
               file=sys.stderr, flush=True)
+    snap_listener = None
     try:
         if args.stdio:
+            if snapshot_source is not None:
+                from .session.transport import once
+
+                def _swap_stdout_snap() -> None:
+                    devnull = os.open(os.devnull, os.O_WRONLY)
+                    os.dup2(devnull, 1)
+                    os.close(devnull)
+
+                stats = run_snapshot_session(
+                    lambda n: os.read(0, n),
+                    lambda d: _write_all(1, d),
+                    once(_swap_stdout_snap), snapshot_source,
+                    peer="stdio")
+                print(f"sidecar: stdio session {stats}", file=sys.stderr,
+                      flush=True)
+                return 0 if stats["ok"] else 1
             if replica is not None:
                 from .session.transport import once
 
@@ -1006,11 +1171,29 @@ def main(argv=None) -> int:
             stats = serve_stdio(drain_timeout=drain)
             return 0 if stats["ok"] else 1
         host, _, port = args.tcp.rpartition(":")
-        serve_tcp(host or "127.0.0.1", int(port), drain_timeout=drain,
+        host = host or "127.0.0.1"
+        if fanout is not None and snapshot_source is not None:
+            # the composition (ISSUE 12): snapshot sessions get their
+            # own port; the broadcast's snapshot-needed refusals carry
+            # the redirect hint to it
+            from .wire.framing import CAP_SNAPSHOT
+
+            snap_listener = SnapshotListener(
+                snapshot_source, host, args.snapshot_port)
+            fanout.snapshot_hint = {"port": snap_listener.port,
+                                    "cap": CAP_SNAPSHOT}
+            print(f"sidecar: snapshot bootstrap on "
+                  f"{host}:{snap_listener.port}",
+                  file=sys.stderr, flush=True)
+            snapshot_source = None  # the main loop keeps broadcasting
+        serve_tcp(host, int(port), drain_timeout=drain,
                   retry_policy=policy, hub=hub, fanout=fanout,
-                  reconcile_replica=replica)
+                  reconcile_replica=replica,
+                  snapshot_source=snapshot_source)
         return 0
     finally:
+        if snap_listener is not None:
+            snap_listener.close()
         if obs_srv is not None:
             obs_srv.close()
         if fanout is not None:

@@ -4,6 +4,7 @@ from .change_codec import Change, decode_change, encode_change
 from .framing import (
     CAP_CHANGE_BATCH,
     CAP_RECONCILE,
+    CAP_SNAPSHOT,
     KNOWN_TYPES,
     LOCAL_CAPS,
     MAX_HEADER_LEN,
@@ -12,6 +13,7 @@ from .framing import (
     TYPE_CHANGE_BATCH,
     TYPE_HEADER,
     TYPE_RECONCILE,
+    TYPE_SNAPSHOT,
     ProtocolError,
     frame,
     frame_header,
@@ -28,6 +30,7 @@ __all__ = [
     "encode_change",
     "CAP_CHANGE_BATCH",
     "CAP_RECONCILE",
+    "CAP_SNAPSHOT",
     "KNOWN_TYPES",
     "LOCAL_CAPS",
     "MAX_HEADER_LEN",
@@ -35,6 +38,7 @@ __all__ = [
     "TYPE_CHANGE",
     "TYPE_CHANGE_BATCH",
     "TYPE_RECONCILE",
+    "TYPE_SNAPSHOT",
     "TYPE_HEADER",
     "ProtocolError",
     "frame",

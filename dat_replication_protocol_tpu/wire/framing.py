@@ -13,7 +13,7 @@ Type ids (reference: encode.js:112 / decode.js:151,155; 0 is reserved for
 
 from __future__ import annotations
 
-from .varint import MAX_VARINT_LEN, encode_uvarint
+from .varint import MAX_VARINT_LEN, decode_uvarint, encode_uvarint
 
 TYPE_HEADER = 0  # parser state only; never a valid frame id
 TYPE_CHANGE = 1
@@ -30,8 +30,17 @@ TYPE_CHANGE_BATCH = 3
 # Same old-peer story as ChangeBatch: never emitted without
 # CAP_RECONCILE, unknown-type error otherwise.
 TYPE_RECONCILE = 4
+# Content-addressed snapshot frame (negotiated extension, WIRE.md
+# "Snapshot"): the bootstrap protocol for joiners trimmed past the
+# broadcast retention window — manifest, weighted coded-symbol chunk
+# reconciliation, and verified chunk transfer
+# (wire/snapshot_codec.py).  Same old-peer story as ChangeBatch /
+# Reconcile: never emitted without CAP_SNAPSHOT, unknown-type error
+# otherwise.
+TYPE_SNAPSHOT = 5
 
-KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB, TYPE_CHANGE_BATCH, TYPE_RECONCILE)
+KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB, TYPE_CHANGE_BATCH, TYPE_RECONCILE,
+               TYPE_SNAPSHOT)
 
 # -- capability negotiation (WIRE.md "Capability negotiation") --------------
 #
@@ -42,10 +51,11 @@ KNOWN_TYPES = (TYPE_CHANGE, TYPE_BLOB, TYPE_CHANGE_BATCH, TYPE_RECONCILE)
 # was never told anything assumes 0 — the reference wire, byte-exact.
 CAP_CHANGE_BATCH = 1  # peer parses TYPE_CHANGE_BATCH frames
 CAP_RECONCILE = 2  # peer parses TYPE_RECONCILE frames
+CAP_SNAPSHOT = 4  # peer parses TYPE_SNAPSHOT frames
 
 # Everything this package's Decoder can parse (the mask a receiver
 # advertises during session setup).
-LOCAL_CAPS = CAP_CHANGE_BATCH | CAP_RECONCILE
+LOCAL_CAPS = CAP_CHANGE_BATCH | CAP_RECONCILE | CAP_SNAPSHOT
 
 # Upper bound on header size: 10 varint bytes + 1 id byte.
 MAX_HEADER_LEN = MAX_VARINT_LEN + 1
@@ -89,6 +99,23 @@ def header_len(payload_len: int) -> int:
 def frame_wire_len(payload_len: int) -> int:
     """Total wire bytes of a frame with ``payload_len`` payload bytes."""
     return header_len(payload_len) + payload_len
+
+
+def iter_frames(wire):
+    """Walk a complete recorded frame stream: yields ``(start, type_id,
+    payload_start, end)`` per frame, where ``wire[payload_start:end]``
+    is the payload and ``wire[start:end]`` the whole frame.  The ONE
+    owner of the header walk over recorded wire (cold-log replay, the
+    bench's chaos-arm frame scan) — every hand-rolled copy of the
+    varint/id-byte slicing is a layout fork that must track header
+    changes in lockstep."""
+    at = 0
+    total = len(wire)
+    while at < total:
+        flen, used = decode_uvarint(wire[at:at + MAX_VARINT_LEN])
+        end = at + used + flen
+        yield at, wire[at + used], at + used + 1, end
+        at = end
 
 
 class ProtocolError(Exception):

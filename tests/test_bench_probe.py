@@ -267,3 +267,32 @@ def test_cpu_fallback_child_inherits_metrics_flag(monkeypatch):
     finally:
         bench._METRICS["on"] = False
     assert "--metrics" in captured["argv"]
+
+
+# ---------------------------------------------------------------------------
+# config 12 (ISSUE 12): the snapshot bootstrap's acceptance criteria run
+# LIVE at reduced size — the tier-1 budget-gated face of the bench
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_bootstrap_live_gate(monkeypatch):
+    """Bytes-on-wire scale with staleness (2% stale => <= 5% of the
+    cold transfer), a cold flash crowd of 8 leaves source digest work
+    constant (hash_ratio 1.0 — ZERO marginal hash bytes), and the
+    chaos arm's torn-wire resume is exactly-once."""
+    monkeypatch.setenv("BENCH_SNAPSHOT_MIB", "4")
+    monkeypatch.setenv("BENCH_SNAPSHOT_JOINERS", "8")
+    res = bench.bench_snapshot_bootstrap(quick=True, backend="host")
+    assert res["metric"] == "snapshot_bootstrap_stale_wire_ratio"
+    assert res["value"] <= 0.05, res  # staleness, not dataset size
+    assert res["crowd_hash_bytes"] == 0  # hash once, serve 8
+    assert res["hash_ratio"] == 1.0
+    assert res["chaos"]["resumed"] is True
+    assert res["chaos"]["exactly_once"] is True
+    assert res["chunks_reused"] > 0 and res["symbols"] > 0
+
+
+def test_snapshot_bootstrap_registered_in_host_group():
+    # config 12 needs no device: it must be in BENCHES and NOT in the
+    # device leg (the TPU watch script drives the device side)
+    assert bench.BENCHES["12"][0] == "snapshot_bootstrap"

@@ -44,10 +44,13 @@ def test_multiblock_chaining():
     ]
 
 
+@pytest.mark.slow
 def test_vmem_state_variant_matches_hashlib():
     # the register-pressure experiment: working-vector lanes in VMEM
     # scratch, per-G load/store.  Tiny shapes: this variant has no
-    # scanned form, so interpret compiles the unrolled chain
+    # scanned form, so interpret compiles the unrolled chain (~30 s of
+    # pure compile — slow-marked; the vmem_state COMPOSITIONS stay
+    # tier-1 in the state_loads/bps/g_interleave parity tests below)
     from dat_replication_protocol_tpu.ops.blake2b_pallas import (
         blake2b_native,
         from_native,
@@ -66,10 +69,17 @@ def test_vmem_state_variant_matches_hashlib():
     ]
 
 
+@pytest.mark.slow
 def test_state_loads_variants_byte_exact():
     """The lazy chaining-state view (state_loads) must be byte-exact in
     every composition with msg_loads/vmem_state (mixed lengths so the
-    active/final masks take both values)."""
+    active/final masks take both values).
+
+    slow-marked (tier-1 runtime audit, ISSUE 12): ~30 s of interpret
+    COMPILE for a non-default experiment variant no production route
+    sets — the default-path parity stays tier-1 in the fast tests, the
+    variant parity runs in the slow tier and on-device via
+    _when_tpu_returns.sh."""
     import hashlib
 
     import jax.numpy as jnp
@@ -109,10 +119,16 @@ def test_state_loads_variants_byte_exact():
         assert digs[i] == exp, (kw, i)
 
 
+@pytest.mark.slow
 def test_blocks_per_step_byte_exact():
     """Multi-block grid steps (chaining state in registers between
     sub-blocks) must match hashlib with mixed lengths, so every item
-    finishes at a different sub-block position within a step."""
+    finishes at a different sub-block position within a step.
+
+    slow-marked (tier-1 runtime audit, ISSUE 12): ~55 s of interpret
+    COMPILE for the bps experiment flag no production route sets (the
+    real bps A/B runs on-device via _bps_experiment.py); shrinking the
+    batch does not help — the cost is the unroll, not the data."""
     import hashlib
 
     import jax.numpy as jnp

@@ -1059,3 +1059,48 @@ def test_fanout_hot_path_suppression(tmp_path):
                     peer.prime()
     ''')
     assert "fanout-hot-path" not in _rules_fired(findings)
+
+
+# Snapshot bootstrap constants (ISSUE 12): the negotiation trio (frame
+# type / capability bit / payload version) plus the weighted-
+# participation constants written down independently in ops/rateless.py
+# and the native dat_rateless_build_w twin — a participation fork is a
+# route fork (two engines mapping the same chunk to different cells, a
+# chunk-set reconcile that silently never decodes).
+SNAPSHOT_PY = '''
+TYPE_SNAPSHOT = 5
+CAP_SNAPSHOT = 4
+SNAPSHOT_VERSION = 1
+RATELESS_W_SHIFT = 12
+RATELESS_W_CAP = 8
+'''
+
+SNAPSHOT_C_GOOD = '''
+// wire: TYPE_SNAPSHOT = 5
+// wire: SNAPSHOT_VERSION = 1
+// wire: RATELESS_W_SHIFT = 12
+// wire: RATELESS_W_CAP = 8
+'''
+
+
+def test_wire_parity_covers_snapshot_constants(tmp_path):
+    bad = SNAPSHOT_C_GOOD.replace(
+        "TYPE_SNAPSHOT = 5", "TYPE_SNAPSHOT = 6").replace(
+        "RATELESS_W_SHIFT = 12", "RATELESS_W_SHIFT = 13")
+    findings = _lint(tmp_path, ("snapshot.py", SNAPSHOT_PY),
+                     ("native.cpp", bad))
+    drift = [f for f in findings if f.rule == "wire-constant-parity"]
+    assert {m.split("wire constant ")[1].split(" ")[0] for m in
+            (f.message for f in drift)} == {"TYPE_SNAPSHOT",
+                                            "RATELESS_W_SHIFT"}
+
+
+def test_wire_parity_snapshot_constants_clean_when_agreeing(tmp_path):
+    assert _lint(tmp_path, ("snapshot.py", SNAPSHOT_PY),
+                 ("native.cpp", SNAPSHOT_C_GOOD)) == []
+
+
+def test_wire_parity_weighted_cap_python_python_drift(tmp_path):
+    findings = _lint(tmp_path, ("a.py", "RATELESS_W_CAP = 8\n"),
+                     ("b.py", "RATELESS_W_CAP = 9\n"))
+    assert _rules_fired(findings) == {"wire-constant-parity"}

@@ -502,3 +502,32 @@ def test_invalidated_laggard_honest_ack_sheds_as_retention():
         assert lag.shed_reason == "retention"
     finally:
         srv.close()
+
+
+def test_attach_past_retention_carries_snapshot_hint():
+    """ISSUE 12: a server configured with a snapshot bootstrap hint
+    attaches it to the SnapshotNeeded an attach refusal raises — the
+    joiner learns the redirect IN the refusal.  Without a hint the
+    field is None (the pre-bootstrap deployment, unchanged)."""
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    hint = {"port": 4711, "cap": 4}
+    srv = FanoutServer(retention_budget=64, snapshot_hint=hint)
+    try:
+        srv.publish(b"x" * 400)
+        srv.log.enforce_retention()
+        with pytest.raises(SnapshotNeeded) as ei:
+            srv.attach_peer("late", sink=lambda vs: 0, offset=0)
+        assert ei.value.hint == hint
+        assert ei.value.retained == (400 - 64, 400)
+    finally:
+        srv.close()
+    bare = FanoutServer(retention_budget=64)
+    try:
+        bare.publish(b"x" * 400)
+        bare.log.enforce_retention()
+        with pytest.raises(SnapshotNeeded) as ei:
+            bare.attach_peer("late", sink=lambda vs: 0, offset=0)
+        assert ei.value.hint is None
+    finally:
+        bare.close()

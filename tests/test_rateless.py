@@ -427,3 +427,127 @@ def test_responder_state_rejects_symbols_before_begin():
     assert rc.decode_reconcile(replies[0]).kind == rc.RC_FAIL
     with pytest.raises(ProtocolError):
         state.result()
+
+
+# -- weighted (variable-size element) extension (ISSUE 12) -------------------
+#
+# The snapshot bootstrap reconciles CDC chunk SETS: elements carry a
+# byte length, the cell grows a length word, and participation density
+# scales with the weight class.  Same contract as above: exact
+# symmetric difference, byte-identical engines, deterministic cursor.
+
+
+def _wparity_inputs(n: int = 257, seed: int = 2):
+    rng = np.random.default_rng(seed)
+    d = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    # lengths spanning every weight class: 0 bytes up to ~16 MiB
+    lens = (rng.integers(0, 1 << 24, n)
+            * rng.integers(0, 2, n)).astype(np.int64)
+    return d, lens
+
+
+def test_weight_classes_match_the_definition():
+    lens = np.array([0, 1, 4096, 8191, 8192, 1 << 20, 1 << 30], np.int64)
+    got = rl.weight_classes(lens).tolist()
+    want = [min(rl.RATELESS_W_CAP, int(ln) >> rl.RATELESS_W_SHIFT and
+                (int(ln) >> rl.RATELESS_W_SHIFT).bit_length())
+            for ln in lens]
+    assert got == want
+    # heavy chunks participate more densely than light ones
+    heavy = rl.WeightedIndexCursor(
+        _wparity_inputs(1)[0][:1], np.array([1 << 23]))
+    light = rl.WeightedIndexCursor(
+        _wparity_inputs(1)[0][:1], np.array([16]))
+    assert len(heavy.advance(4096)[0]) > len(light.advance(4096)[0])
+
+
+@pytest.mark.parametrize("seed,k", [(0, 0), (1, 1), (2, 17), (3, 100)])
+def test_weighted_peeling_recovers_diff_with_lengths(seed, k):
+    rng = np.random.default_rng(seed + 40)
+    n = 400
+    d = rng.integers(0, 256, (n + k, 32), dtype=np.uint8)
+    lens = rng.integers(0, 1 << 22, n + k).astype(np.int64)
+    # A = rows [0, n), B = rows [k, n+k): k only-in-A, k only-in-B,
+    # n-k shared (identical lengths on shared rows)
+    da, la = d[:n], lens[:n]
+    db, lb = d[k:], lens[k:]
+    syms = rl.WeightedSymbols(da, la)
+    dec = rl.WeightedPeelDecoder(db, lb)
+    m, sent = 16, 0
+    while True:
+        dec.add_symbols(sent, syms.extend(m)[sent:])
+        sent = m
+        out = dec.try_decode()
+        if out is not None:
+            break
+        m *= 2
+        assert m <= 1 << 20, "decode never completed"
+    digests, rec_lens, signs = out
+    assert len(digests) == 2 * k
+    want = {bytes(d[i]): int(lens[i]) for i in range(k)}
+    want.update({bytes(d[n + i]): int(lens[n + i]) for i in range(k)})
+    got = {bytes(digests[i]): int(rec_lens[i]) for i in range(len(digests))}
+    assert got == want  # every element's LENGTH recovered exactly
+    # sign +1 = remote(A)-only, -1 = local(B)-only
+    a_only = {bytes(digests[i]) for i in range(len(digests))
+              if signs[i] == 1}
+    assert a_only == {bytes(d[i]) for i in range(k)}
+
+
+def test_weighted_identical_sets_decode_empty():
+    d, lens = _wparity_inputs(64, seed=7)
+    syms = rl.WeightedSymbols(d, lens)
+    dec = rl.WeightedPeelDecoder(d, lens)
+    dec.add_symbols(0, syms.extend(16))
+    out = dec.try_decode()
+    assert out is not None and len(out[0]) == 0
+
+
+def test_weighted_engines_byte_identical():
+    d, lens = _wparity_inputs()
+    for schedule in [(64,), (16, 64, 192)]:
+        out = {}
+        for eng in ("numpy", "device") + (
+                ("host",) if native.available() else ()):
+            cs = rl.WeightedSymbols(d, lens, engine=eng)
+            for m in schedule:
+                cells = cs.extend(m)
+            out[eng] = np.asarray(cells).tobytes()
+        assert out["numpy"] == out["device"], schedule
+        if "host" in out:
+            assert out["numpy"] == out["host"], schedule
+
+
+def test_weighted_cursor_is_incremental_and_deterministic():
+    d, lens = _wparity_inputs(64, seed=9)
+    c1 = rl.WeightedIndexCursor(d, lens)
+    e1, i1 = c1.advance(256)
+    c2 = rl.WeightedIndexCursor(d, lens)
+    parts = [c2.advance(16), c2.advance(64), c2.advance(256)]
+    e2 = np.concatenate([p[0] for p in parts])
+    i2 = np.concatenate([p[1] for p in parts])
+    assert sorted(zip(e1.tolist(), i1.tolist())) == \
+        sorted(zip(e2.tolist(), i2.tolist()))
+    # every element still participates at index 0 (weighting divides
+    # the GAPS, it never skips the first cell)
+    assert set(e1[i1 == 0].tolist()) == set(range(64))
+
+
+def test_weighted_checksum_covers_the_length_word():
+    d, lens = _wparity_inputs(8, seed=3)
+    rows = rl.weighted_element_rows(d, lens)
+    # perturb ONE length word: the checksum chain must notice
+    bad = rows.copy()
+    bad[0, 11] ^= 1
+    w = rl.weighted_checksum_words(bad[:1, 3:11], bad[:1, 11])
+    assert not (w == bad[:1, 1:3]).all()
+
+
+def test_weighted_rows_reject_misaligned_or_oversize_lengths():
+    d, _ = _wparity_inputs(4, seed=5)
+    with pytest.raises(ValueError, match="align"):
+        rl.weighted_element_rows(d, np.array([1, 2], np.int64))
+    with pytest.raises(ValueError, match="u32"):
+        rl.weighted_element_rows(d, np.array([1, 2, 3, 1 << 33]))
+    with pytest.raises(ValueError, match=">= 0"):
+        rl.weighted_element_rows(d, np.array([1, 2, 3, -1]))

@@ -1056,3 +1056,122 @@ def test_sidecar_reconcile_corrupt_stream_fails_structured():
     assert "error" in out
     a.close()
     b.close()
+
+
+# -- snapshot bootstrap mode (ISSUE 12) --------------------------------------
+
+
+def _snapshot_dataset(n=1 << 18, seed=0):
+    import numpy as np
+
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def test_tcp_sidecar_snapshot_serves_cold_and_stale_joiners(tmp_path):
+    """--snapshot shape: the daemon materializes DATAFILE once and
+    every connection is an independent joiner session — a cold joiner
+    streams the shared full-manifest log, a stale one reconciles and
+    moves O(diff) bytes."""
+    import numpy as np
+
+    from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+        run_snapshot_joiner,
+    )
+
+    data = _snapshot_dataset()
+    datafile = tmp_path / "dataset.bin"
+    datafile.write_bytes(data.tobytes())
+    source = sidecar.load_snapshot_source(str(datafile), wire_offset=99)
+    stale = data.copy()
+    stale[:: len(data) // 8] ^= 0x5A  # a few divergent chunks
+
+    ready = threading.Event()
+    port_box = {}
+    t = threading.Thread(
+        target=sidecar.serve_tcp,
+        args=("127.0.0.1", 0),
+        kwargs=dict(max_sessions=2, snapshot_source=source,
+                    ready_cb=lambda p: (port_box.__setitem__("p", p),
+                                        ready.set())),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    addr = ("127.0.0.1", port_box["p"])
+
+    c = socket.create_connection(addr, timeout=10)
+    cold = run_snapshot_joiner(
+        c.recv, c.sendall, lambda: c.shutdown(socket.SHUT_WR))
+    c.close()
+    assert cold["data"] == data.tobytes()
+    assert cold["wire_offset"] == 99  # where the live session attaches
+
+    c = socket.create_connection(addr, timeout=10)
+    out = run_snapshot_joiner(
+        c.recv, c.sendall, lambda: c.shutdown(socket.SHUT_WR),
+        have=stale.tobytes())
+    c.close()
+    assert out["data"] == data.tobytes()
+    assert out["chunks_reused"] > 0
+    assert out["bytes_received"] < len(data) // 2  # O(diff), not O(n)
+    t.join(timeout=10)
+    assert np.array_equal(source._buf, data)  # source untouched
+
+
+def test_fanout_snapshot_needed_record_carries_hint_and_redirect_works(
+        tmp_path):
+    """The composition aha (ISSUE 12): a subscriber trimmed past the
+    broadcast window gets the structured snapshot-needed record WITH
+    the bootstrap hint, dials the hinted port, and assembles the
+    dataset — no out-of-band config anywhere."""
+    import json as _json
+
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+    from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+        run_snapshot_joiner,
+    )
+    from dat_replication_protocol_tpu.wire.framing import CAP_SNAPSHOT
+
+    data = _snapshot_dataset(1 << 16, seed=3)
+    datafile = tmp_path / "dataset.bin"
+    datafile.write_bytes(data.tobytes())
+    source = sidecar.load_snapshot_source(str(datafile))
+
+    listener = sidecar.SnapshotListener(source, "127.0.0.1", 0)
+    fanout = FanoutServer(retention_budget=64, stall_timeout=5.0,
+                          snapshot_hint={"port": listener.port,
+                                         "cap": CAP_SNAPSHOT})
+    try:
+        fanout.publish(b"x" * 400)  # budget-trims the head immediately
+        fanout.log.enforce_retention()
+        a, b = socket.socketpair()
+        out = sidecar.run_subscriber(a, fanout, key="late")
+        assert out["ok"] is False and out["snapshot_needed"] is True
+        assert out["hint"] == {"port": listener.port, "cap": CAP_SNAPSHOT}
+        rec = _json.loads(_recv_all(b).decode())
+        a.close()
+        b.close()
+        assert rec["snapshot_needed"] is True
+        assert rec["hint"]["cap"] == CAP_SNAPSHOT
+
+        # ... and the hint WORKS: dial it, bootstrap, done
+        c = socket.create_connection(("127.0.0.1", rec["hint"]["port"]),
+                                     timeout=10)
+        got = run_snapshot_joiner(
+            c.recv, c.sendall, lambda: c.shutdown(socket.SHUT_WR))
+        c.close()
+        assert got["data"] == data.tobytes()
+    finally:
+        listener.close()
+        fanout.close()
+
+
+def test_sidecar_snapshot_cli_flags(capsys):
+    """--snapshot refuses the modes it cannot compose with, keeping the
+    CLI contract explicit."""
+    import pytest
+
+    with pytest.raises(SystemExit):
+        sidecar.main(["--stdio", "--snapshot", "x.bin", "--hub"])
+    err = capsys.readouterr().err
+    assert "--snapshot cannot combine" in err

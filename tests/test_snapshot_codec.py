@@ -1,0 +1,202 @@
+"""TYPE_SNAPSHOT wire layer (ISSUE 12): payload codec round-trips,
+structural-corruption rejection, and the session-layer capability
+contract — an un-negotiated encoder cannot emit snapshot frames at all
+(the golden byte-exact doctrine ChangeBatch and Reconcile established),
+and a corrupt snapshot payload destroys the session with ONE structured
+ProtocolError."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.wire import snapshot_codec as sn
+from dat_replication_protocol_tpu.wire.framing import (
+    CAP_SNAPSHOT,
+    TYPE_SNAPSHOT,
+    ProtocolError,
+    frame,
+)
+
+_MAN = sn.SnapshotManifest(
+    n_positions=5, n_chunks=4, total_bytes=12345,
+    root=bytes(range(32)), wire_offset=777,
+    avg_bits=13, min_size=2048, max_size=32768)
+
+
+# -- payload codec -----------------------------------------------------------
+
+
+def test_codec_roundtrips():
+    cells = np.arange(36, dtype=np.uint32).reshape(3, 12)
+    digs = np.arange(64, dtype=np.uint8).reshape(2, 32)
+    chunks = [(bytes(range(32)), b"hello"), (bytes(32), b"")]
+    ranks = np.array([3, 0, 2, 1, 3], dtype=np.int64)
+    for payload, checks in [
+        (sn.encode_begin(_MAN), dict(kind=sn.SN_BEGIN)),
+        (sn.encode_symbols(7, cells), dict(kind=sn.SN_SYMBOLS, start=7)),
+        (sn.encode_want_more(9), dict(kind=sn.SN_WANT, mode=sn.WANT_MORE,
+                                      n=9)),
+        (sn.encode_want_digests(digs), dict(kind=sn.SN_WANT,
+                                            mode=sn.WANT_DIGESTS, n=2)),
+        (sn.encode_want_all(), dict(kind=sn.SN_WANT, mode=sn.WANT_ALL)),
+        (sn.encode_chunks(chunks), dict(kind=sn.SN_CHUNKS, n=2)),
+        (sn.encode_done(11, ranks), dict(kind=sn.SN_DONE, n=11)),
+        (sn.encode_fail(3, "why"), dict(kind=sn.SN_FAIL, n=3,
+                                        reason="why")),
+    ]:
+        msg = sn.decode_snapshot(payload)
+        for k, v in checks.items():
+            assert getattr(msg, k) == v, (k, payload)
+    man = sn.decode_snapshot(sn.encode_begin(_MAN)).manifest
+    assert man == _MAN
+    msg = sn.decode_snapshot(sn.encode_symbols(7, cells))
+    assert np.array_equal(msg.cells, cells)
+    msg = sn.decode_snapshot(sn.encode_want_digests(digs))
+    assert np.array_equal(msg.digests, digs)
+    msg = sn.decode_snapshot(sn.encode_chunks(chunks))
+    assert [(bytes(d), bytes(c)) for d, c in msg.chunks] == chunks
+    msg = sn.decode_snapshot(sn.encode_done(11, ranks))
+    assert np.array_equal(msg.ranks, ranks)
+
+
+@pytest.mark.parametrize("payload", [
+    b"",                                            # empty
+    bytes([9]),                                     # unknown subtype
+    bytes([sn.SN_BEGIN, 99]),                       # bad version
+    sn.encode_begin(_MAN)[:-1],                     # torn params
+    sn.encode_begin(_MAN) + b"x",                   # trailing bytes
+    sn.encode_symbols(0, np.zeros((2, 12), np.uint32))[:-3],  # torn cells
+    bytes([sn.SN_WANT]),                            # no mode
+    bytes([sn.SN_WANT, 7]),                         # unknown mode
+    sn.encode_want_all() + b"\x00",                 # trailing bytes
+    sn.encode_want_digests(np.zeros((2, 32), np.uint8))[:-1],  # torn digest
+    sn.encode_chunks([(bytes(32), b"abc")])[:-1],   # torn chunk body
+    sn.encode_chunks([(bytes(32), b"abc")]) + b"z",  # trailing bytes
+    sn.encode_done(1, np.array([0, 1]))[:-1],       # torn rank varint
+    sn.encode_done(1, np.array([0, 1])) + b"q",     # trailing bytes
+    # byzantine DONE: a 2^40-position claim in a tiny payload must fail
+    # structured BEFORE any allocation, not MemoryError/OOM
+    bytes([sn.SN_DONE]) + b"\x00" + b"\x80\x80\x80\x80\x80\x80\x80\x80\x80\x02",
+])
+def test_codec_rejects_structural_corruption(payload):
+    with pytest.raises(ValueError):
+        sn.decode_snapshot(payload)
+
+
+def test_encode_done_tail_matches_encode_done():
+    # the cacheable ranks blob (SnapshotSource.done_payload) must stay
+    # byte-identical to the direct encode — one layout, two call shapes
+    ranks = np.array([0, 5, 2, 700, 1], np.int64)
+    tail = sn.encode_done_tail(ranks)
+    assert sn.encode_done(7, ranks) == \
+        bytes((sn.SN_DONE,)) + b"\x07" + tail
+    assert sn.encode_done(7, tail=tail) == sn.encode_done(7, ranks)
+    with pytest.raises(ValueError, match="1-D"):
+        sn.encode_done_tail(np.array([[1]], np.int64))
+
+
+def test_iter_frames_walks_a_recorded_stream():
+    # the shared frame walker (framing.iter_frames) is the one owner of
+    # the header walk: every (start, type, payload, end) must tile the
+    # wire exactly, large-payload (multi-byte varint) frames included
+    from dat_replication_protocol_tpu.wire.framing import iter_frames
+    payloads = [sn.encode_want_all(), b"\x05" + b"x" * 300,
+                sn.encode_want_more(9)]
+    wire = b"".join(frame(TYPE_SNAPSHOT, p) for p in payloads)
+    seen = list(iter_frames(wire))
+    assert [wire[p0:end] for _s, _t, p0, end in seen] == payloads
+    assert all(t == TYPE_SNAPSHOT for _s, t, _p0, _e in seen)
+    assert seen[0][0] == 0 and seen[-1][3] == len(wire)
+    assert [s for s, _t, _p0, _e in seen[1:]] == \
+        [e for _s, _t, _p0, e in seen[:-1]]  # frames tile, no gaps
+
+
+def test_begin_rejects_more_unique_chunks_than_positions():
+    bad = sn.SnapshotManifest(
+        n_positions=2, n_chunks=3, total_bytes=10, root=bytes(32),
+        wire_offset=0, avg_bits=13, min_size=1, max_size=10)
+    with pytest.raises(ValueError, match="unique chunks"):
+        sn.decode_snapshot(sn.encode_begin(bad))
+
+
+def test_begin_golden_bytes_are_stable():
+    # the manifest layout is wire contract (WIRE.md "Snapshot"): any
+    # byte-level change is a protocol fork and must be deliberate
+    assert sn.encode_begin(_MAN).hex() == (
+        "0001" + "05" + "04" + "b960"
+        + bytes(range(32)).hex()
+        + "8906" + "0d" + "8010" + "808002")
+
+
+# -- session-layer integration ----------------------------------------------
+
+
+def test_unnegotiated_encoder_refuses_snapshot_frames_and_stays_golden():
+    e = protocol.encode()
+    with pytest.raises(ValueError, match="CAP_SNAPSHOT"):
+        e.snapshot_frame(sn.encode_want_all())
+    e.change({"key": "a", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    wire = e.read()
+    ref = protocol.encode()
+    ref.change({"key": "a", "change": 1, "from": 0, "to": 1})
+    ref.finalize()
+    assert wire == ref.read()  # byte-exact: the refusal left no residue
+
+
+def test_decoder_advertises_cap_snapshot():
+    assert protocol.Decoder.capabilities() & CAP_SNAPSHOT
+
+
+def test_snapshot_frames_count_in_frame_accounting():
+    e = protocol.encode(peer_caps=CAP_SNAPSHOT)
+    d = protocol.decode()
+    seen = []
+    d.snapshot(lambda m, done: (seen.append(m), done()))
+    e.change({"key": "x", "change": 1, "from": 0, "to": 1})
+    e.snapshot_frame(sn.encode_want_more(1))
+    e.change({"key": "y", "change": 2, "from": 0, "to": 1})
+    e.finalize()
+    wire = e.read()
+    for off in range(0, len(wire), 5):
+        d.write(wire[off:off + 5])
+    d.end()
+    assert d.finished and len(seen) == 1
+    assert seen[0].kind == sn.SN_WANT and seen[0].mode == sn.WANT_MORE
+    assert d.snapshot_frames == 1
+    assert d._frames_delivered() == 3
+    ckpt = d.checkpoint()
+    assert ckpt.frame == 3 and ckpt.wire_offset == len(wire)
+
+
+def test_unhandled_snapshot_frames_drop_without_deadlock():
+    e = protocol.encode(peer_caps=CAP_SNAPSHOT)
+    d = protocol.decode()  # no snapshot handler registered
+    e.snapshot_frame(sn.encode_want_all())
+    e.change({"key": "x", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    d.write(e.read())
+    d.end()
+    assert d.finished and d.changes == 1 and d.snapshot_frames == 1
+
+
+def test_corrupt_snapshot_payload_is_structured_protocol_error():
+    d = protocol.decode()
+    errs = []
+    d.on_error(errs.append)
+    d.write(frame(TYPE_SNAPSHOT, bytes([250, 1])))
+    assert d.destroyed
+    assert isinstance(errs[0], ProtocolError)
+    assert errs[0].offset is not None and errs[0].frame == 0
+
+
+def test_snapshot_frame_refused_with_open_blob():
+    e = protocol.encode(peer_caps=CAP_SNAPSHOT)
+    b = e.blob(4)
+    b.write(b"ab")
+    with pytest.raises(ValueError, match="blob open"):
+        e.snapshot_frame(sn.encode_want_all())
+    b.end(b"cd")
+    e.finalize()

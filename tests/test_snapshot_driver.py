@@ -1,0 +1,521 @@
+"""Snapshot bootstrap driver (ISSUE 12): the protocol cores and live
+duplex drivers.  The claims under test are the tentpole's economics and
+failure contract:
+
+* a 2% stale joiner moves ~2% of the bytes (O(diff) wire via the
+  weighted rateless reconcile), a cold joiner takes the full-manifest
+  fallback, an identical joiner moves almost nothing;
+* a flash crowd of cold joiners shares ONE hash+read+encode pass
+  (hash-once counters: ``cdc.fused.bytes`` flat as joiners grow);
+* every chunk digest is verified on receipt; a wrong chunk, an
+  unsolicited chunk, a bad assembly plan, or an over-budget session is
+  ONE structured ProtocolError — never a silently wrong dataset.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+    SnapshotJoiner,
+    SnapshotResponder,
+    SnapshotSource,
+    run_snapshot_joiner,
+    run_snapshot_responder,
+    snapshot_local,
+    symbol_cap,
+)
+from dat_replication_protocol_tpu.wire import snapshot_codec as sn
+from dat_replication_protocol_tpu.wire.framing import ProtocolError
+
+
+def _dataset(n: int, seed: int = 0) -> np.ndarray:
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def _stale_copy(data: np.ndarray, frac: float, seed: int = 1) -> bytes:
+    """Corrupt ~frac of the CHUNKS by flipping one byte in each: the
+    divergence is chunk-count-shaped, like a real stale replica."""
+    src = SnapshotSource(data)
+    rng = np.random.default_rng(seed)
+    n = len(src.offs)
+    pick = rng.choice(n, size=max(1, int(n * frac)), replace=False)
+    out = data.copy()
+    out[src.offs[pick]] ^= 0x5A
+    return out.tobytes()
+
+
+DATA = _dataset(1 << 20)
+SRC = SnapshotSource(DATA, wire_offset=4242)
+
+
+def test_cold_joiner_full_manifest_fallback():
+    out = snapshot_local(SRC, None)
+    assert out["data"] == DATA.tobytes()
+    assert out["cold"] is True
+    assert out["symbols"] == 0  # no symbol stream on the cold path
+    assert out["chunks_received"] == SRC.manifest.n_chunks
+    assert out["wire_offset"] == 4242  # where the live session attaches
+
+
+def test_stale_joiner_wire_scales_with_staleness():
+    stale = _stale_copy(DATA, 0.02)
+    cold = snapshot_local(SRC, None)
+    out = snapshot_local(SRC, stale)
+    assert out["data"] == DATA.tobytes()
+    assert not out["cold"]
+    assert out["chunks_reused"] > 0
+    # the acceptance shape: 2% stale moves <= 5% of the cold transfer
+    assert out["wire_bytes"] <= 0.05 * cold["wire_bytes"], (
+        out["wire_bytes"], cold["wire_bytes"])
+
+
+def test_identical_joiner_moves_no_chunk_bytes():
+    out = snapshot_local(SRC, DATA.tobytes())
+    assert out["data"] == DATA.tobytes()
+    assert out["bytes_received"] == 0
+    assert out["chunks_received"] == 0
+    # manifest + symbols + empty WANT/DONE only: well under 1% of data
+    assert out["wire_bytes"] < len(DATA) // 100
+
+
+def test_repeating_content_dedupes_positions():
+    # 64 copies of one 16 KiB block: many positions, few unique chunks,
+    # and the DONE assembly plan must reconstruct the repetition
+    block = _dataset(16 << 10, seed=3)
+    data = np.tile(block, 64)
+    src = SnapshotSource(data)
+    assert src.manifest.n_chunks < src.manifest.n_positions
+    out = snapshot_local(src, None)
+    assert out["data"] == data.tobytes()
+    assert out["chunks_received"] == src.manifest.n_chunks  # each once
+
+
+def test_empty_dataset_roundtrips():
+    src = SnapshotSource(np.empty(0, np.uint8))
+    out = snapshot_local(src, None)
+    assert out["data"] == b""
+
+
+def test_flash_crowd_shares_one_hash_pass(obs_enabled):
+    from dat_replication_protocol_tpu.obs.metrics import REGISTRY
+
+    data = _dataset(1 << 19, seed=5)
+    src = SnapshotSource(data)  # the hash pass (counted)
+    hashed_once = REGISTRY.counter("cdc.fused.bytes").value
+    sent0 = REGISTRY.counter("snapshot.chunks.sent_bytes").value
+    for _ in range(4):  # the crowd
+        out = snapshot_local(src, None)
+        assert out["data"] == data.tobytes()
+    # digest work did NOT grow with joiners (hash_ratio 1.0) ...
+    assert REGISTRY.counter("cdc.fused.bytes").value == hashed_once
+    # ... while the bytes served DID
+    sent = REGISTRY.counter("snapshot.chunks.sent_bytes").value - sent0
+    assert sent >= 4 * len(data)
+    # and the shared cold log was framed once, not per joiner
+    assert src._cold_log is not None
+
+
+def test_chunk_budget_fails_structured():
+    resp = SnapshotResponder(SRC, chunk_budget=1024)
+    [begin] = resp.begin_payloads()
+    replies = resp.handle(sn.decode_snapshot(sn.encode_want_all()))
+    assert len(replies) == 1
+    msg = sn.decode_snapshot(replies[0])
+    assert msg.kind == sn.SN_FAIL and "budget" in msg.reason
+    assert isinstance(resp.failed, ProtocolError)
+    # and the joiner surfaces it as ITS one structured error
+    joiner = SnapshotJoiner(None)
+    joiner.handle(sn.decode_snapshot(sn.encode_begin(SRC.manifest)))
+    joiner.handle(msg)
+    with pytest.raises(ProtocolError, match="budget"):
+        joiner.result()
+
+
+def test_flipped_chunk_is_one_structured_error():
+    joiner = SnapshotJoiner(None)
+    joiner.handle(sn.decode_snapshot(sn.encode_begin(SRC.manifest)))
+    good = SRC.chunk_view(0).tobytes()
+    bad = bytes([good[0] ^ 1]) + good[1:]
+    replies = joiner.handle(sn.decode_snapshot(sn.encode_chunks(
+        [(SRC.uniq_digests[0].tobytes(), bad)])))
+    assert sn.decode_snapshot(replies[0]).kind == sn.SN_FAIL
+    with pytest.raises(ProtocolError, match="digest mismatch"):
+        joiner.result()
+
+
+def test_unsolicited_chunk_outside_want_set_errors():
+    stale = _stale_copy(DATA, 0.02)
+    # drive the joiner through reconcile so it HAS a WANT set, then
+    # deliver a chunk it never asked for (valid digest, wrong session)
+    resp = SnapshotResponder(SRC)
+    joiner = SnapshotJoiner(stale)
+    pending = [p for p in resp.begin_payloads()]
+    for _ in range(100):
+        replies = []
+        for p in pending:
+            replies.extend(joiner.handle(sn.decode_snapshot(p)))
+        if joiner._wanted is not None:
+            break
+        pending = []
+        for r in replies:
+            pending.extend(resp.handle(sn.decode_snapshot(r)))
+    assert joiner._wanted is not None
+    outside = [u for u in range(SRC.manifest.n_chunks)
+               if SRC.uniq_digests[u].tobytes() not in joiner._wanted]
+    u = outside[0]
+    joiner.handle(sn.decode_snapshot(sn.encode_chunks(
+        [(SRC.uniq_digests[u].tobytes(), SRC.chunk_view(u).tobytes())])))
+    with pytest.raises(ProtocolError, match="unsolicited"):
+        joiner.result()
+
+
+def test_done_with_undelivered_chunks_errors():
+    joiner = SnapshotJoiner(_stale_copy(DATA, 0.02))
+    joiner.handle(sn.decode_snapshot(sn.encode_begin(SRC.manifest)))
+    # skip straight to DONE without delivering the wanted chunks
+    joiner._wanted = {SRC.uniq_digests[0].tobytes(): 1}
+    joiner.handle(sn.decode_snapshot(SRC.done_payload(0)))
+    with pytest.raises(ProtocolError, match="undelivered"):
+        joiner.result()
+
+
+def test_bad_assembly_plan_fails_root_check():
+    # cold transfer with a shuffled DONE plan: every chunk verifies,
+    # but the ROOT over the per-position digests must refuse the order
+    src = SnapshotSource(_dataset(1 << 17, seed=9))
+    if src.manifest.n_positions < 2:
+        pytest.skip("dataset chunked to fewer than 2 positions")
+    joiner = SnapshotJoiner(None)
+    joiner.handle(sn.decode_snapshot(sn.encode_begin(src.manifest)))
+    chunks = [(src.uniq_digests[u].tobytes(), src.chunk_view(u).tobytes())
+              for u in range(src.manifest.n_chunks)]
+    joiner.handle(sn.decode_snapshot(sn.encode_chunks(chunks)))
+    ranks = src.ranks.copy()
+    ranks[0], ranks[-1] = ranks[-1], ranks[0]
+    if ranks[0] == ranks[-1]:
+        pytest.skip("degenerate: swapped positions share a chunk")
+    joiner.handle(sn.decode_snapshot(sn.encode_done(0, ranks)))
+    with pytest.raises(ProtocolError, match="root"):
+        joiner.result()
+
+
+def test_stream_ending_before_assembly_is_structured():
+    joiner = SnapshotJoiner(None)
+    joiner.handle(sn.decode_snapshot(sn.encode_begin(SRC.manifest)))
+    with pytest.raises(ProtocolError, match="before assembly"):
+        joiner.result()
+
+
+def test_redelivered_chunk_absorbed_exactly_once():
+    # the exactly-once contract's unit face: the same CHUNKS frame
+    # twice verifies (and counts) each chunk once
+    src = SnapshotSource(_dataset(1 << 16, seed=11))
+    joiner = SnapshotJoiner(None)
+    joiner.handle(sn.decode_snapshot(sn.encode_begin(src.manifest)))
+    payload = sn.encode_chunks(
+        [(src.uniq_digests[u].tobytes(), src.chunk_view(u).tobytes())
+         for u in range(src.manifest.n_chunks)])
+    joiner.handle(sn.decode_snapshot(payload))
+    before = joiner.chunks_verified
+    joiner.handle(sn.decode_snapshot(payload))  # the replay
+    assert joiner.chunks_verified == before  # absorbed, not re-counted
+    joiner.handle(sn.decode_snapshot(src.done_payload(0)))
+    assert joiner.result()["data"] == src._buf.tobytes()
+
+
+def _divergent_pair():
+    """A small manifest vs a joiner whose local set dwarfs it: the
+    symmetric difference (~1k chunks) cannot decode under the manifest
+    cap (512 symbols for ~32 source chunks)."""
+    small = _dataset(1 << 18, seed=21)
+    have = _dataset(1 << 23, seed=22).tobytes()  # unrelated content
+    return SnapshotSource(small), small, have
+
+
+def test_heavily_divergent_joiner_degrades_to_want_all():
+    # the joiner mirrors symbol_cap(n_chunks) and degrades to the
+    # full-manifest WANT before the responder refuses a batch; the
+    # pre-fix joiner waited for its own max_symbols (1<<20), which the
+    # responder's smaller cap always preempted with FAIL — the
+    # documented degrade path was unreachable and the session stranded
+    src, small, have = _divergent_pair()
+    assert symbol_cap(src.manifest.n_chunks) < SnapshotJoiner(None).max_symbols
+    out = snapshot_local(src, have)
+    assert out["data"] == small.tobytes()
+    assert out["cold"] is True  # degraded to the full-manifest path
+    assert out["symbols"] > 0  # only after the reconcile was tried
+
+
+def test_divergent_joiner_without_fallback_fails_structured():
+    # fallback_all=False keeps the strict contract: the same exhaustion
+    # is ONE structured error originated by the JOINER, not a responder
+    # refusal racing it
+    src, _small, have = _divergent_pair()
+    resp = SnapshotResponder(src)
+    joiner = SnapshotJoiner(have, fallback_all=False)
+    pending = list(resp.begin_payloads())
+    while pending and not joiner.done:
+        replies = []
+        for p in pending:
+            replies.extend(joiner.handle(sn.decode_snapshot(p)))
+        pending = []
+        for r in replies:
+            pending.extend(resp.handle(sn.decode_snapshot(r)))
+    with pytest.raises(ProtocolError, match="no decode after"):
+        joiner.result()
+    # the responder learned of it from the joiner's FAIL — it never
+    # originated a cap refusal of its own
+    assert "at joiner" in str(resp.failed)
+
+
+def test_want_digests_repeats_served_once():
+    # WANT is semantically a set: a byzantine joiner repeating one
+    # digest k times must not amplify the reply (pre-fix each repeat
+    # shipped another copy of the chunk, unbounded on the sidecar path
+    # where chunk_budget is never set)
+    resp = SnapshotResponder(SRC)
+    resp.begin_payloads()
+    d = SRC.uniq_digests[0].tobytes()
+    want = np.frombuffer(d * 64, np.uint8).reshape(64, 32).copy()
+    replies = resp.handle(sn.decode_snapshot(sn.encode_want_digests(want)))
+    msgs = [sn.decode_snapshot(r) for r in replies]
+    chunks = [c for m in msgs if m.kind == sn.SN_CHUNKS for c in m.chunks]
+    assert len(chunks) == 1  # the chunk once, then DONE
+    assert resp.chunks_sent == 1
+    assert resp.chunk_bytes_sent == int(SRC.uniq_lens[0])
+
+
+def test_done_payload_caches_the_ranks_tail():
+    # the ranks blob is constant per manifest: encoded once, shared by
+    # every session's DONE (only the symbols_used prefix varies)
+    src = SnapshotSource(_dataset(1 << 17, seed=13))
+    a = src.done_payload(3)
+    assert src._done_tail is not None
+    b = src.done_payload(9)
+    assert a == sn.encode_done(3, src.ranks)
+    assert b == sn.encode_done(9, src.ranks)
+
+
+# -- live duplex drivers -----------------------------------------------------
+
+
+def _run_live(have, *, chunk_budget=None):
+    a, b = socket.socketpair()
+    res: dict = {}
+
+    def respond():
+        try:
+            res["resp"] = run_snapshot_responder(
+                SRC, a.recv, a.sendall,
+                lambda: a.shutdown(socket.SHUT_WR),
+                chunk_budget=chunk_budget)
+        except ProtocolError as e:
+            res["resp_err"] = e
+
+    t = threading.Thread(target=respond, daemon=True)
+    t.start()
+    try:
+        out = run_snapshot_joiner(
+            b.recv, b.sendall, lambda: b.shutdown(socket.SHUT_WR),
+            have=have)
+    finally:
+        t.join(timeout=30)
+        a.close()
+        b.close()
+    assert not t.is_alive()
+    return out, res
+
+
+def test_live_cold_join_over_socketpair():
+    out, res = _run_live(None)
+    assert out["data"] == DATA.tobytes()
+    assert out["wire_offset"] == 4242
+    assert res["resp"]["cold"] is True
+
+
+def test_live_stale_join_over_socketpair():
+    out, res = _run_live(_stale_copy(DATA, 0.02))
+    assert out["data"] == DATA.tobytes()
+    assert out["chunks_reused"] > 0
+    assert res["resp"]["ok"] is True
+    # chunk bytes on the wire tracked the diff, not the dataset
+    assert out["bytes_received"] < len(DATA) // 4
+
+
+def test_live_budget_fail_is_structured_on_both_sides():
+    out_err = None
+    a, b = socket.socketpair()
+    res: dict = {}
+
+    def respond():
+        try:
+            run_snapshot_responder(
+                SRC, a.recv, a.sendall,
+                lambda: a.shutdown(socket.SHUT_WR), chunk_budget=1024)
+        except ProtocolError as e:
+            res["err"] = e
+
+    t = threading.Thread(target=respond, daemon=True)
+    t.start()
+    try:
+        run_snapshot_joiner(b.recv, b.sendall,
+                            lambda: b.shutdown(socket.SHUT_WR), have=None)
+    except ProtocolError as e:
+        out_err = e
+    finally:
+        t.join(timeout=30)
+        a.close()
+        b.close()
+    assert out_err is not None and "budget" in str(out_err)
+    assert isinstance(res.get("err"), ProtocolError)
+
+
+def test_watermark_roles_ride_the_fleet_plane(obs_enabled):
+    from dat_replication_protocol_tpu.obs.watermarks import WATERMARKS
+
+    a, b = socket.socketpair()
+
+    def respond():
+        run_snapshot_responder(
+            SRC, a.recv, a.sendall, lambda: a.shutdown(socket.SHUT_WR),
+            link="snap-test-resp")
+
+    t = threading.Thread(target=respond, daemon=True)
+    t.start()
+    try:
+        out = run_snapshot_joiner(
+            b.recv, b.sendall, lambda: b.shutdown(socket.SHUT_WR),
+            have=None, link="snap-test-join")
+    finally:
+        t.join(timeout=30)
+        a.close()
+        b.close()
+    assert out["data"] == DATA.tobytes()
+    # roles untracked after the sessions closed (no leaked links)
+    snap = WATERMARKS.snapshot()
+    assert "snap-test-resp" not in snap and "snap-test-join" not in snap
+
+
+def test_assembly_ranks_match_lex_order_reference():
+    """The vectorized rank build (np.unique inverse over the V32 void
+    view) must equal the definitional reference: each position's rank
+    in the byte-lexicographically sorted unique digest set."""
+    from dat_replication_protocol_tpu.ops.rateless import dedupe_digests
+    from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+        _lex_order,
+    )
+
+    block = _dataset(16 << 10, seed=21)
+    src = SnapshotSource(np.tile(block, 16))  # repeats => duplicates
+    uniq, _ = dedupe_digests(src.digests)
+    order = _lex_order(uniq)
+    rank_of = np.empty(len(order), np.int64)
+    rank_of[order] = np.arange(len(order), dtype=np.int64)
+    by = {uniq[i].tobytes(): i for i in range(len(uniq))}
+    ref = np.array([rank_of[by[src.digests[p].tobytes()]]
+                    for p in range(len(src.digests))], dtype=np.int64)
+    assert np.array_equal(src.ranks, ref)
+
+
+def test_shared_weighted_symbols_concurrent_extend_is_exact():
+    """The per-manifest symbol prefix is SHARED across concurrent
+    responder sessions: racing extend() calls must serialize on the
+    in-place cursor and every thread must observe exactly the
+    single-threaded prefix (a torn cursor builds cells that never
+    peel — the route-fork failure class)."""
+    from dat_replication_protocol_tpu.ops import rateless
+
+    d = _dataset(1 << 16, seed=23)
+    src = SnapshotSource(d)
+    ref = rateless.WeightedSymbols(
+        src.uniq_digests, src.uniq_lens).extend(512).copy()
+    ws = src.weighted_symbols()
+    out, errs = {}, []
+
+    def worker(i):
+        try:
+            out[i] = np.asarray(ws.extend(512)).copy()
+        except Exception as e:  # noqa: BLE001 — relayed to the assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+    for i, cells in out.items():
+        assert cells.tobytes() == ref.tobytes(), f"thread {i} diverged"
+
+
+# -- review round 2: budget accounting + cold-pump pacing ---------------------
+
+
+def test_want_all_budget_bills_unique_bytes_not_position_total():
+    """The cold log ships each UNIQUE chunk once; the budget guard and
+    the sent counters must bill what actually moves.  A tiled dataset
+    (total_bytes ~64x the unique bytes) whose unique set fits the
+    budget must NOT be spuriously FAILed."""
+    block = _dataset(16 << 10, seed=11)
+    data = np.tile(block, 64)
+    src = SnapshotSource(data)
+    uniq = int(src.uniq_lens.sum())
+    total = int(src.manifest.total_bytes)
+    assert uniq < total // 8  # the premise: heavy duplication
+    resp = SnapshotResponder(src, chunk_budget=(uniq + total) // 2)
+    resp.begin_payloads()
+    replies = resp.handle(sn.decode_snapshot(sn.encode_want_all()))
+    assert resp.failed is None, resp.failed
+    assert resp.finished and resp.cold
+    assert resp.chunk_bytes_sent == uniq  # bills unique, not positions
+    assert len(replies) == 1  # the LogSlice
+    # ... and a budget below the unique bytes still fails structured
+    resp2 = SnapshotResponder(src, chunk_budget=uniq - 1)
+    resp2.begin_payloads()
+    [fail] = resp2.handle(sn.decode_snapshot(sn.encode_want_all()))
+    assert sn.decode_snapshot(fail).kind == sn.SN_FAIL
+    assert isinstance(resp2.failed, ProtocolError)
+
+
+def test_cold_pump_is_paced_by_encoder_high_water():
+    """_send_replies must NOT queue a whole cold dataset at once: the
+    LogSlice pump parks at the encoder's high-water mark and resumes on
+    drain, so responder memory stays ~high_water while the wire bytes
+    still arrive complete and in order (on_done strictly last)."""
+    from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+        LogSlice,
+        _send_replies,
+    )
+    from dat_replication_protocol_tpu.session.encoder import Encoder
+    from dat_replication_protocol_tpu.wire.framing import CAP_SNAPSHOT
+
+    data = _dataset(1 << 20, seed=13)
+    src = SnapshotSource(data)
+    log = src.cold_log()
+    hw = 64 * 1024
+    enc = Encoder(high_water=hw, peer_caps=CAP_SNAPSHOT)
+    done = []
+    _send_replies(enc, [LogSlice(log, log.start, log.end)], 16 * 1024,
+                  on_done=lambda: done.append(enc.buffered_bytes))
+    total = log.end - log.start
+    # the queue parked at the mark instead of swallowing the dataset
+    assert enc.buffered_bytes < total // 2
+    assert enc.buffered_bytes <= hw + 16 * 1024
+    assert not done  # a parked pump has not finished
+    got = bytearray()
+    peak = enc.buffered_bytes
+    while len(got) < total:
+        chunk = enc.read(8 * 1024)
+        assert chunk, (len(got), total)
+        got += chunk
+        peak = max(peak, enc.buffered_bytes)
+    assert bytes(got) == log.read_from(log.start)  # complete, in order
+    assert peak <= hw + 16 * 1024  # paced throughout, not just at start
+    assert done  # ... and on_done fired exactly once, after the last push
+    assert len(done) == 1

@@ -1,0 +1,282 @@
+"""Snapshot bootstrap chaos (ISSUE 12): the joiner wire under faults.
+
+The responder->joiner stream of one stale-joiner bootstrap session
+(BEGIN, SYMBOLS rounds, CHUNKS, DONE) is recorded once through the real
+encoder + journal, then replayed into a fresh joiner through the
+deterministic fault injector (session/faults.py) and the resumable
+reconnect driver.  The contract (the exactly-once-resume face of
+ROBUSTNESS.md's snapshot section): for every seed, a disconnect-class
+fault (drop / truncation / stall / re-segmentation) ends in the
+byte-exact assembled dataset with every wanted chunk verified EXACTLY
+once — never a re-verified chunk, never a gap — and a corruption-class
+fault (flip) yields ONE structured ProtocolError, never a silently
+wrong dataset.  Tier-1 sweeps seeds 0..19; the ``slow`` soak covers
+100 more.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.runtime.snapshot_driver import (
+    SnapshotJoiner,
+    SnapshotResponder,
+    SnapshotSource,
+)
+from dat_replication_protocol_tpu.session.faults import (
+    FaultPlan,
+    FaultyReader,
+    bytes_reader,
+)
+from dat_replication_protocol_tpu.session.reconnect import (
+    BackoffPolicy,
+    run_resumable,
+)
+from dat_replication_protocol_tpu.session.resume import WireJournal
+from dat_replication_protocol_tpu.wire import snapshot_codec as sn
+from dat_replication_protocol_tpu.wire.framing import (
+    CAP_SNAPSHOT,
+    ProtocolError,
+    iter_frames,
+)
+
+HARD_TIMEOUT = 30.0  # per-case watchdog: "never a hang", enforced
+
+
+def _build_wire():
+    """Record the responder->joiner stream of one stale bootstrap: the
+    driving joiner's replies steer the responder (symbol rounds, the
+    WANT set, the chunk stream), but only the responder's direction is
+    journaled — the replay side reconstructs everything from it."""
+    rng = np.random.default_rng(0)
+    # small on purpose: the sweep's re-segmentation arm delivers this
+    # wire BYTE AT A TIME, so its length prices every seed
+    data = rng.integers(0, 256, 48 << 10, dtype=np.uint8)
+    src = SnapshotSource(data, avg_bits=9, wire_offset=1234)
+    stale = data.copy()
+    stale[src.offs[:: max(1, len(src.offs) // 6)]] ^= 0x5A
+    resp = SnapshotResponder(src)
+    pilot = SnapshotJoiner(stale.tobytes())
+    e = protocol.encode(peer_caps=CAP_SNAPSHOT)
+    j = WireJournal()
+    e.attach_journal(j)
+    pending = list(resp.begin_payloads())
+    guard = 0
+    while pending and not pilot.done:
+        replies = []
+        for payload in pending:
+            e.snapshot_frame(payload)
+            replies.extend(pilot.handle(sn.decode_snapshot(payload)))
+        pending = []
+        for r in replies:
+            pending.extend(resp.handle(sn.decode_snapshot(r)))
+        guard += 1
+        assert guard < 1000
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    assert pilot.result()["data"] == data.tobytes()
+    wanted = pilot.chunks_verified
+    assert wanted > 0  # the stream really carries chunk frames
+    return j.read_from(0), data.tobytes(), stale.tobytes(), wanted
+
+
+_WIRE, _DATA, _STALE, _WANTED = _build_wire()
+
+
+def _frames(wire: bytes):
+    """(start, payload_start, end, subtype) per TYPE_SNAPSHOT frame."""
+    return [(start, p0, end, wire[p0])
+            for start, _tid, p0, end in iter_frames(wire)]
+
+
+def _fresh_joiner():
+    joiner = SnapshotJoiner(_STALE)
+    dec = protocol.decode()
+    dec.snapshot(lambda msg, done: (joiner.handle(msg), done()))
+    return dec, joiner
+
+
+def _with_watchdog(fn):
+    box: dict = {}
+
+    def run():
+        try:
+            box["ret"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the test
+            box["err"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(HARD_TIMEOUT)
+    assert not t.is_alive(), f"HANG: case still running after {HARD_TIMEOUT}s"
+    if "err" in box:
+        raise box["err"]
+    return box["ret"]
+
+
+def _replay(seed=None, plan=None, max_retries=8):
+    """Replay the recorded wire through a fault plan; returns
+    (stats_or_None, joiner).  ``seed`` uses the sweep generator per
+    attempt; ``plan`` pins one plan on attempt 0 and runs clean
+    reconnects after."""
+    dec, joiner = _fresh_joiner()
+
+    def source(ckpt, failures):
+        remaining = _WIRE[ckpt.wire_offset:]
+        if plan is not None:
+            p = plan if failures == 0 else FaultPlan(seed=failures)
+        else:
+            p = FaultPlan.for_sweep(seed, len(remaining), attempt=failures)
+        return FaultyReader(bytes_reader(remaining), p)
+
+    def drive():
+        return run_resumable(
+            source, dec,
+            BackoffPolicy(base=0.0005, cap=0.005,
+                          max_retries=max_retries, seed=seed or 1),
+            chunk_size=256,  # small chunks: faults land mid-frame
+            expected_total=len(_WIRE),
+            stall_timeout=HARD_TIMEOUT / 2,
+        )
+
+    try:
+        stats = _with_watchdog(drive)
+    except ProtocolError as e:
+        assert e.offset is not None, f"unstructured ProtocolError: {e}"
+        return None, joiner
+    return stats, joiner
+
+
+def _assert_exactly_once(joiner):
+    out = joiner.result()
+    assert out["data"] == _DATA  # byte-exact assembly
+    # exactly-once: every wanted chunk verified once — a resumed wire
+    # never re-verifies (or double-counts) a chunk a previous
+    # connection already delivered
+    assert joiner.chunks_verified == _WANTED
+    assert out["wire_offset"] == 1234
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_sweep_snapshot_resumes_exactly_once(seed):
+    """Disconnect-class faults anywhere in the bootstrap stream: every
+    seed must converge after resume to the byte-exact dataset with
+    exactly-once chunk verification — never an error, never a hang."""
+    stats, joiner = _replay(seed=seed)
+    assert stats is not None, "disconnect-class fault must resume, not error"
+    _assert_exactly_once(joiner)
+
+
+@pytest.mark.slow
+def test_sweep_snapshot_soak_100_seeds():
+    wrong = []
+    for seed in range(20, 120):
+        stats, joiner = _replay(seed=seed)
+        if stats is None:
+            continue  # structured-error arm: allowed for double faults
+        try:
+            out = joiner.result()
+        except ProtocolError:
+            continue
+        if out["data"] != _DATA or joiner.chunks_verified != _WANTED:
+            wrong.append(seed)  # the one outcome the contract forbids
+    assert not wrong, f"seeds {wrong} assembled a WRONG dataset"
+
+
+def _first_frame(subtype):
+    for start, p0, end, sub in _frames(_WIRE):
+        if sub == subtype:
+            return start, p0, end
+    raise AssertionError(f"no subtype-{subtype} frame in the wire")
+
+
+def test_truncate_mid_chunk_resumes_exactly_once():
+    """A clean EOF inside a CHUNKS frame body is the silent-truncation
+    fault: expected_total turns it into a reconnect, the torn frame was
+    never delivered (whole-frame doctrine), and the resumed connection
+    re-sends it without a single chunk verifying twice."""
+    start, p0, end = _first_frame(sn.SN_CHUNKS)
+    cut = p0 + (end - p0) // 2  # mid-body: digest+payload territory
+    stats, joiner = _replay(plan=FaultPlan(truncate_at=cut))
+    assert stats is not None and stats["reconnects"] >= 1
+    _assert_exactly_once(joiner)
+
+
+def test_drop_between_chunk_frames_resumes_exactly_once():
+    start, p0, end = _first_frame(sn.SN_CHUNKS)
+    stats, joiner = _replay(plan=FaultPlan(drop_at=end))
+    assert stats is not None and stats["reconnects"] >= 1
+    _assert_exactly_once(joiner)
+
+
+def test_flip_inside_chunk_body_is_one_structured_error():
+    """A flipped byte inside a chunk BODY passes the frame layer (the
+    structure is intact) and MUST die at the joiner's per-chunk digest
+    verification: one structured ProtocolError, never a silently wrong
+    dataset."""
+    start, p0, end = _first_frame(sn.SN_CHUNKS)
+    # skip subtype byte + count varint + the 32-byte digest: land in
+    # the first chunk's length/body region, far from frame headers
+    flip = p0 + 40
+    assert flip < end
+    stats, joiner = _replay(plan=FaultPlan(flip_at=flip),
+                            max_retries=0)
+    if stats is None:
+        # the flip landed structurally (length varint): the session
+        # decoder's ProtocolError arm — equally structured, also fine
+        assert joiner.data is None
+        return
+    with pytest.raises(ProtocolError) as ei:
+        joiner.result()
+    assert joiner.data is None  # nothing assembled
+    assert ei.value.offset is not None
+
+
+def test_flip_inside_symbols_never_yields_wrong_dataset():
+    """A flipped coded-symbol cell perturbs the reconcile: whatever
+    the peel concludes, the end state is either a correct dataset
+    (the flip peeled into a spurious WANT the responder answered) or
+    ONE structured error — never silent corruption."""
+    start, p0, end = _first_frame(sn.SN_SYMBOLS)
+    stats, joiner = _replay(plan=FaultPlan(flip_at=p0 + 16),
+                            max_retries=0)
+    if stats is None:
+        return  # structured at the wire layer
+    try:
+        out = joiner.result()
+    except ProtocolError as e:
+        assert e.offset is not None
+        return
+    assert out["data"] == _DATA  # assembled => must be byte-exact
+
+
+def test_stall_during_want_window_completes():
+    """A long read stall at the symbols/chunks boundary — the window
+    where the live joiner would be sending its WANT — must ride the
+    bounded waits to completion, not hang and not error."""
+    start, p0, end = _first_frame(sn.SN_CHUNKS)
+    stats, joiner = _replay(
+        plan=FaultPlan(stall_at=start, stall_s=1.5))
+    assert stats is not None and stats["reconnects"] == 0
+    _assert_exactly_once(joiner)
+
+
+def test_chaos_ground_truth_counters_agree(obs_enabled):
+    """The injector's ground-truth counters vs the snapshot session's
+    own story: a truncate-then-resume run fires exactly one injected
+    truncation and the joiner's verified-chunk counter matches its
+    stats (the conformance-oracle face of OBSERVABILITY.md)."""
+    from dat_replication_protocol_tpu.obs.metrics import REGISTRY
+
+    start, p0, end = _first_frame(sn.SN_CHUNKS)
+    stats, joiner = _replay(plan=FaultPlan(truncate_at=p0 + 8))
+    assert stats is not None
+    _assert_exactly_once(joiner)
+    assert REGISTRY.counter("fault.injected.truncate").value == 1
+    assert REGISTRY.counter(
+        "snapshot.chunks.verified").value == joiner.chunks_verified
