@@ -3,16 +3,47 @@
 Exits 0 when clean, 1 on findings, 2 on usage errors — shaped so the
 tier-1 suite (tests/test_datlint_repo_clean.py) and any pre-merge hook
 can gate on it directly.
+
+Structured surfaces (ISSUE 13 satellites):
+
+* ``--json`` — machine-readable output: one document with ``findings``
+  (each ``{rule, path, line, message, chains}``), counts, and (with
+  ``--stats``) per-rule wall seconds, so CI can ANNOTATE diffs instead
+  of parsing the human lines.
+* ``--baseline FILE`` — accept-list: findings whose stable key (rule +
+  trailing path + first message sentence, no line numbers) appears in
+  FILE are reported as ``accepted`` and do not fail the run; only NEW
+  findings exit 1.  ``--write-baseline FILE`` records the current
+  findings as that accept-list.
+* ``--stats`` — per-rule wall time (the tier-1 budget gate's input:
+  a whole-program pass must not blow the suite's runtime budget).
+* ``--lock-graph PATH`` — write the machine-readable lock-acquisition
+  graph (deterministic, byte-stable on an unchanged tree) so the
+  event-loop refactor (ROADMAP item 2) can diff the thread web it
+  inherits; ``artifacts/lock_graph.json`` is the checked-in copy.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
 from .engine import Project, run_project
 from .rules import ALL_RULES, rule_by_name
+
+
+def write_lock_graph(project: Project, out_path: str | Path) -> dict:
+    """Render and write the lock graph for ``project``; returns the
+    document.  Sorted keys + fixed indent + trailing newline: the
+    bytes are a pure function of the analyzed tree."""
+    from .concurrency import ProgramIndex, render_lock_graph
+
+    doc = render_lock_graph(ProgramIndex.get(project))
+    text = json.dumps(doc, indent=2, sort_keys=True) + "\n"
+    Path(out_path).write_text(text, encoding="utf-8")
+    return doc
 
 
 def main(argv=None) -> int:
@@ -30,6 +61,24 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule names and one-line descriptions, then exit")
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit one JSON document instead of human-readable lines")
+    parser.add_argument(
+        "--baseline", metavar="FILE",
+        help="accept-list of known findings (see --write-baseline); "
+             "only findings NOT in it fail the run")
+    parser.add_argument(
+        "--write-baseline", metavar="FILE",
+        help="write the current findings' keys as a baseline "
+             "accept-list, then exit 0")
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="report per-rule wall time")
+    parser.add_argument(
+        "--lock-graph", metavar="PATH",
+        help="also write the machine-readable lock-acquisition graph "
+             "(artifacts/lock_graph.json is the checked-in copy)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -53,13 +102,81 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    baseline: set[str] = set()
+    if args.baseline:
+        try:
+            doc = json.loads(Path(args.baseline).read_text("utf-8"))
+            baseline = set(doc["accept"])
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # a broken baseline must fail LOUDLY: silently accepting
+            # nothing (or everything) would flip the gate's meaning
+            print(f"datlint: unreadable baseline {args.baseline!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
     project = Project.from_paths(paths)
-    findings = run_project(project, rules)
-    for f in findings:
-        print(f.render())
+    stats: dict = {}
+    findings = run_project(project, rules, stats if args.stats else None)
+    if args.lock_graph:
+        write_lock_graph(project, args.lock_graph)
+
     n_files = len(project.sources)
-    if findings:
-        print(f"datlint: {len(findings)} finding(s) in {n_files} file(s)")
+
+    def print_stats() -> None:
+        total = sum(stats.values())
+        for name, secs in sorted(stats.items(), key=lambda kv: -kv[1]):
+            print(f"datlint: stats: {name}: {secs * 1e3:.1f} ms")
+        print(f"datlint: stats: TOTAL: {total * 1e3:.1f} ms "
+              f"({n_files} files)")
+
+    if args.write_baseline:
+        doc = {"version": 1,
+               "accept": sorted({f.key() for f in findings})}
+        Path(args.write_baseline).write_text(
+            json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+        if args.as_json:
+            # --json callers parse stdout as ONE document on every
+            # invocation, the baseline-refresh run included
+            out = {"version": 1, "files": n_files,
+                   "wrote_baseline": args.write_baseline,
+                   "accepted_keys": len(doc["accept"])}
+            if args.stats:
+                out["stats_s"] = {k: round(v, 4)
+                                  for k, v in sorted(stats.items())}
+            print(json.dumps(out, indent=2))
+            return 0
+        if args.stats:
+            print_stats()
+        print(f"datlint: wrote {len(doc['accept'])} accepted key(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    new = [f for f in findings if f.key() not in baseline]
+    accepted = [f for f in findings if f.key() in baseline]
+
+    if args.as_json:
+        doc = {
+            "version": 1,
+            "files": n_files,
+            "rules": [r.name for r in rules],
+            "findings": [f.to_json() for f in new],
+            "accepted": [f.to_json() for f in accepted],
+        }
+        if args.stats:
+            doc["stats_s"] = {k: round(v, 4)
+                              for k, v in sorted(stats.items())}
+        print(json.dumps(doc, indent=2))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if args.stats:
+        print_stats()
+    if accepted:
+        print(f"datlint: {len(accepted)} baseline-accepted finding(s) "
+              f"not shown")
+    if new:
+        print(f"datlint: {len(new)} finding(s) in {n_files} file(s)")
         return 1
     print(f"datlint: clean ({n_files} files, {len(rules)} rules)")
     return 0

@@ -37,15 +37,47 @@ _C_COMMENT_RE = re.compile(r"//.*$|/\*.*?\*/")
 
 @dataclasses.dataclass(frozen=True, order=True)
 class Finding:
-    """One rule violation at a specific source location."""
+    """One rule violation at a specific source location.
+
+    ``chains`` is optional evidence: for whole-program rules (the
+    concurrency pass) each chain is a tuple of ``file:line who does
+    what`` steps tracing one path from a thread entry to the violation
+    — the human message folds them in, and ``--json`` emits them
+    structured so CI annotations can cite both sides of an inversion.
+    """
 
     path: str
     line: int
     rule: str
     message: str
+    chains: tuple = ()
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "chains": [list(c) for c in self.chains],
+        }
+
+    def key(self) -> str:
+        """Location-stable identity for ``--baseline`` accept-lists:
+        rule + the path's LAST TWO components (checkout-independent) +
+        first message sentence, NO line number — a baseline must
+        survive unrelated edits shifting lines and must not embed the
+        runner's absolute checkout path.  The path is RESOLVED first so
+        'm.py' and '/abs/dir/m.py' spell the same key (a CI job and a
+        local run must not flip the gate on invocation style)."""
+        try:
+            tail = "/".join(Path(self.path).resolve().parts[-2:])
+        except OSError:
+            tail = "/".join(Path(self.path).parts[-2:])
+        head = self.message.split(" — ")[0].split(".  ")[0]
+        return f"{self.rule}:{tail}:{head}"
 
 
 class SourceFile:
@@ -169,17 +201,27 @@ class Project:
         return cls(py, cc)
 
 
-def run_project(project: Project, rules: Iterable) -> list[Finding]:
+def run_project(project: Project, rules: Iterable,
+                stats: dict | None = None) -> list[Finding]:
     """Run ``rules`` over ``project``; returns unsuppressed findings,
-    sorted by (path, line)."""
+    sorted by (path, line).  Pass a dict as ``stats`` to collect
+    per-rule wall seconds (``--stats`` / the tier-1 runtime budget);
+    whichever rule runs first pays any shared-index build, so the
+    registry keeps index-sharing rules adjacent."""
+    import time as _time
+
     by_path = {str(s.path): s for s in project.sources}
     out: list[Finding] = []
     for rule in rules:
+        t0 = _time.perf_counter()
         for f in rule.check(project):
             src = by_path.get(f.path)
             if src is not None and src.suppressed(f.rule, f.line):
                 continue
             out.append(f)
+        if stats is not None:
+            stats[rule.name] = stats.get(rule.name, 0.0) \
+                + _time.perf_counter() - t0
     # a Python file the analyzer cannot parse hides every AST rule: that
     # is itself a finding, not a silent skip
     for s in project.py_sources:

@@ -8,6 +8,7 @@ module here plus a known-bad/known-good fixture pair in
 
 from __future__ import annotations
 
+from ..concurrency import BlockingUnderLock, GuardedState, LockOrder
 from .bounded_wait import BoundedWait
 from .cursor_coherence import CursorCoherence
 from .env_cache import EnvCachePolicy
@@ -17,6 +18,7 @@ from .jit_purity import JitPurity
 from .obs_discipline import ObsDiscipline
 from .unbounded_join import UnboundedJoin
 from .wire_constants import WireConstantParity
+from .wire_dispatch import WireDispatchParity
 
 ALL_RULES = (
     CursorCoherence(),
@@ -25,9 +27,17 @@ ALL_RULES = (
     BoundedWait(),
     JitPurity(),
     WireConstantParity(),
+    WireDispatchParity(),
     ObsDiscipline(),
     HubIsolation(),
     FanoutHotPath(),
+    # whole-program concurrency pass (analysis/concurrency/): these
+    # three share one ProgramIndex per run — keep them adjacent so the
+    # --stats attribution reads sensibly (the first of them pays the
+    # index build)
+    LockOrder(),
+    BlockingUnderLock(),
+    GuardedState(),
 )
 
 

@@ -99,6 +99,10 @@ class BroadcastLog:
             raise ValueError("retention_budget must be > 0")
         self.retention_budget = int(retention_budget)
         self._lock = threading.Lock()
+        # the concurrency pass enforces these (ANALYSIS.md):
+        # datlint: guarded-by(self._lock): self._segs, self._seg_offs, self._cursors
+        # datlint: guarded-by(self._lock): self._start, self._end, self._sealed
+        # datlint: guarded-by(self._lock): self._tail, self._tail_off
         # immutable segments as parallel arrays: _seg_offs[i] is the
         # absolute wire offset of _segs[i][0]; bisect finds the segment
         # containing any retained offset in O(log n)
@@ -199,28 +203,43 @@ class BroadcastLog:
         with self._lock:
             off = self._start if offset is None else int(offset)
             if off < self._start:
-                if _OBS.on:
-                    _M_SNAPSHOT_NEEDED.inc()
-                    _emit("fanout.snapshot_needed", key=key, offset=off,
-                          start=self._start, end=self._end)
-                raise SnapshotNeeded(
+                # built under the lock (consistent range), emitted and
+                # raised by _snapshot_refusal OUTSIDE it: the event
+                # sink can block, and every appender/reader contends
+                # on this lock (blocking-under-lock contract)
+                snap = SnapshotNeeded(
                     f"peer {key!r} asked for byte {off} below the "
                     f"retained range [{self._start}, {self._end}); a "
                     "snapshot (or restart) is required",
                     offset=off, retained=(self._start, self._end))
-            if off > self._end:
-                raise ResumeError(
-                    f"peer {key!r} asked for byte {off} ahead of "
-                    f"everything produced (retained range "
-                    f"[{self._start}, {self._end}))",
-                    offset=off)
-            if key in self._cursors:
-                raise ValueError(f"cursor key {key!r} already attached")
-            cur = BroadcastCursor(key, off)
-            self._cursors[key] = cur
-            if _OBS.on:
-                _M_CURSORS.set(len(self._cursors))
-            return cur
+            else:
+                if off > self._end:
+                    raise ResumeError(
+                        f"peer {key!r} asked for byte {off} ahead of "
+                        f"everything produced (retained range "
+                        f"[{self._start}, {self._end}))",
+                        offset=off)
+                if key in self._cursors:
+                    raise ValueError(
+                        f"cursor key {key!r} already attached")
+                cur = BroadcastCursor(key, off)
+                self._cursors[key] = cur
+                if _OBS.on:
+                    _M_CURSORS.set(len(self._cursors))
+                return cur
+        raise self._snapshot_refusal(snap, key=key, offset=off)
+
+    @staticmethod
+    def _snapshot_refusal(snap: "SnapshotNeeded", **fields
+                          ) -> "SnapshotNeeded":
+        """Count + emit a SnapshotNeeded refusal — called with the log
+        lock RELEASED (the structured error was built under it)."""
+        if _OBS.on:
+            _M_SNAPSHOT_NEEDED.inc()
+            start, end = snap.retained
+            _emit("fanout.snapshot_needed", start=start, end=end,
+                  **fields)
+        return snap
 
     def detach(self, cursor: BroadcastCursor) -> None:
         """Remove a reader; its acked offset stops constraining the
@@ -234,7 +253,8 @@ class BroadcastLog:
                 del self._cursors[cursor.key]
             if _OBS.on:
                 _M_CURSORS.set(len(self._cursors))
-            self._maybe_trim_locked()
+            trim = self._maybe_trim_locked()
+        self._emit_trim(trim)
 
     def ack(self, cursor: BroadcastCursor, offset: int) -> None:
         """The reader confirmed delivery below ``offset``.  Acks feed
@@ -257,7 +277,8 @@ class BroadcastLog:
                     f"byzantine ack from {cursor.key!r}: offset {offset} "
                     f"outside [{cursor.acked}, {self._end}]")
             cursor.acked = offset
-            self._maybe_trim_locked()
+            trim = self._maybe_trim_locked()
+        self._emit_trim(trim)
 
     def enforce_retention(self) -> None:
         """Apply the retention budget now.  The write path stays O(1) in
@@ -265,7 +286,16 @@ class BroadcastLog:
         here — called by the fan-out dispatcher each turn (and by any
         caller with no dispatcher at all)."""
         with self._lock:
-            self._maybe_trim_locked()
+            trim = self._maybe_trim_locked()
+        self._emit_trim(trim)
+
+    @staticmethod
+    def _emit_trim(trim) -> None:
+        """Emit the trim event with the log lock RELEASED (the fields
+        were captured under it by :meth:`_maybe_trim_locked`)."""
+        if trim is not None:
+            start, end, trimmed = trim
+            _emit("fanout.trim", start=start, end=end, trimmed=trimmed)
 
     def cursors_snapshot(self) -> dict:
         """{key: acked offset} for live cursors (telemetry/debugging)."""
@@ -288,31 +318,33 @@ class BroadcastLog:
         out: list = []
         with self._lock:
             if offset < self._start:
-                if _OBS.on:
-                    _M_SNAPSHOT_NEEDED.inc()
-                    _emit("fanout.snapshot_needed", offset=offset,
-                          start=self._start, end=self._end)
-                raise SnapshotNeeded(
+                # built under the lock, emitted + raised AFTER it is
+                # released via _snapshot_refusal
+                # (blocking-under-lock contract)
+                snap = SnapshotNeeded(
                     f"byte {offset} is below the retained range "
                     f"[{self._start}, {self._end})",
                     offset=offset, retained=(self._start, self._end))
-            if offset >= self._end or max_bytes <= 0:
+            else:
+                if offset >= self._end or max_bytes <= 0:
+                    return out
+                self._freeze_tail_locked()
+                want = min(max_bytes, self._end - offset)
+                i = bisect.bisect_right(self._seg_offs, offset) - 1
+                while want > 0 and i < len(self._segs) \
+                        and len(out) < max_iov:
+                    seg_off = self._seg_offs[i]
+                    seg = self._segs[i]
+                    lo = offset - seg_off
+                    hi = min(len(seg), lo + want)
+                    view = memoryview(seg)[lo:hi]
+                    out.append(view)
+                    taken = hi - lo
+                    want -= taken
+                    offset += taken
+                    i += 1
                 return out
-            self._freeze_tail_locked()
-            want = min(max_bytes, self._end - offset)
-            i = bisect.bisect_right(self._seg_offs, offset) - 1
-            while want > 0 and i < len(self._segs) and len(out) < max_iov:
-                seg_off = self._seg_offs[i]
-                seg = self._segs[i]
-                lo = offset - seg_off
-                hi = min(len(seg), lo + want)
-                view = memoryview(seg)[lo:hi]
-                out.append(view)
-                taken = hi - lo
-                want -= taken
-                offset += taken
-                i += 1
-        return out
+        raise self._snapshot_refusal(snap, offset=snap.offset)
 
     def read_from(self, offset: int) -> bytes:
         """WireJournal-compatible copy read: every retained byte at
@@ -324,7 +356,11 @@ class BroadcastLog:
 
     # -- trim ---------------------------------------------------------------
 
-    def _maybe_trim_locked(self) -> None:
+    def _maybe_trim_locked(self) -> Optional[tuple]:
+        # Returns (start, end, trimmed) when a trim happened with the
+        # obs gate on — the CALLER must pass it to _emit_trim once the
+        # lock releases (the return value IS the deferred fanout.trim
+        # event; dropping it loses the event), else None.
         # Lazy, budget-driven trim: the log retains a full
         # ``retention_budget`` of history even once every live cursor
         # acked past it — that window is what late joiners attach into.
@@ -354,8 +390,10 @@ class BroadcastLog:
         if _OBS.on:
             _M_TRIMMED.inc(trimmed)
             _M_RETAINED.set(self._end - self._start)
-            _emit("fanout.trim", start=self._start, end=self._end,
-                  trimmed=trimmed)
+            # the EVENT is the caller's to emit once the lock releases
+            # (blocking-under-lock contract): return the fields
+            return (self._start, self._end, trimmed)
+        return None
 
     def _freeze_tail_locked(self) -> None:
         """Promote the mutable coalescing tail to an immutable segment.

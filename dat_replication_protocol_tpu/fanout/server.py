@@ -45,6 +45,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..obs.events import DeferredEmitQueue as _DeferredEmitQueue
 from ..obs.events import emit as _emit
 from ..obs.metrics import (
     OBS as _OBS,
@@ -229,6 +230,12 @@ class FanoutServer:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._peers: dict[str, _PeerState] = {}
+        # shed events queued under the lock, emitted by
+        # _drain_shed_events once the holder releases (the event sink
+        # can block; blocking under the server lock stalls everyone)
+        self._shed_events = _DeferredEmitQueue("fanout.shed", self._lock)
+        # the concurrency pass enforces these (ANALYSIS.md):
+        # datlint: guarded-by(self._lock): self._peers
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         # owned fds of gone/shed peers, parked for the dispatcher to
@@ -310,69 +317,131 @@ class FanoutServer:
             raise ValueError(
                 f"peer key {key!r} must be a non-empty string containing "
                 'none of {},=" or newlines')
-        with self._lock:
-            if self._closed:
-                raise RuntimeError("fan-out server is closed")
-            if key in self._peers:
-                raise ValueError(f"peer key {key!r} already attached")
-            if len(self._peers) >= self.max_peers:
-                if _OBS.on:
-                    _M_REJECTED.inc()
-                    _emit("fanout.reject", key=key, peers=len(self._peers),
-                          max_peers=self.max_peers)
-                raise FanoutBusy(
-                    f"fan-out at capacity ({len(self._peers)}/"
-                    f"{self.max_peers} peers)",
-                    peers=len(self._peers), max_peers=self.max_peers)
+        if offset is not None:
+            # coerce HERE so log.attach's only remaining ValueError is
+            # the duplicate-cursor refusal (translated below) — a bad
+            # offset type must surface as itself, not as duplicate-key
+            offset = int(offset)
+        if self._closed:
+            # racy fast-fail (the in-lock check below is authoritative):
+            # a closed server must refuse BEFORE the log can answer a
+            # stale offset with SnapshotNeeded + hint — misdirecting a
+            # joiner into a snapshot fetch it cannot use
+            raise RuntimeError("fan-out server is closed")
+        peers_seen = len(self._peers)
+        if peers_seen >= self.max_peers and key not in self._peers:
+            # (duplicate keys fall through to the duplicate-cursor
+            # refusal below — a caller bug outranks the capacity
+            # verdict, as the pre-fast-fail contract had it)
+            # same racy fast-fail for admission: at capacity, refusal
+            # must stay the CHEAP first gate — before the cursor
+            # attach, the fd dup, and before a stale offset can be
+            # answered with SnapshotNeeded + hint (amplifying load
+            # with a snapshot fetch the full server would then reject)
+            busy = FanoutBusy(
+                f"fan-out at capacity ({peers_seen}/"
+                f"{self.max_peers} peers)",
+                peers=peers_seen, max_peers=self.max_peers)
+            if _OBS.on:
+                _M_REJECTED.inc()
+                _emit("fanout.reject", key=key, peers=busy.peers,
+                      max_peers=self.max_peers)
+            raise busy
+        # register the log cursor FIRST, outside the server lock: the
+        # log serializes on its own lock, and its SnapshotNeeded
+        # refusal path emits — neither may run under the server lock
+        # (blocking-under-lock contract, ANALYSIS.md).  A duplicate key
+        # fails here too (every peer owns a same-keyed cursor).
+        try:
+            cursor = self.log.attach(key, offset)
+        except SnapshotNeeded as e:
+            # the one refusal the stack can now ANSWER: attach the
+            # bootstrap hint so the joiner redirects to the snapshot
+            # protocol instead of being stranded
+            e.hint = self.snapshot_hint
+            raise
+        except ValueError:
+            # every attached peer owns a same-keyed log cursor, so the
+            # log's duplicate-cursor refusal IS the duplicate-peer
+            # check — restate it at this API's level
+            raise ValueError(
+                f"peer key {key!r} already attached") from None
+        busy = None
+        admitted = False
+        owned_fd = None
+        try:
             if fd is not None:
                 # the server OWNS a duplicate: the caller may close its
                 # fd at any time (teardown races the dispatcher's
                 # writev), and a closed number can be reused by the
-                # kernel for an unrelated connection — the dup keeps
-                # our writes pointed at THIS peer's socket until the
-                # dispatcher itself reaps it (_reap_dead_fds)
-                fd = os.dup(fd)
-                os.set_blocking(fd, False)
-            try:
-                cursor = self.log.attach(key, offset)
-            except SnapshotNeeded as e:
-                # the one refusal the stack can now ANSWER: attach the
-                # bootstrap hint so the joiner redirects to the
-                # snapshot protocol instead of being stranded
-                if fd is not None:
-                    os.close(fd)
-                e.hint = self.snapshot_hint
-                raise
-            except BaseException:
-                if fd is not None:
-                    os.close(fd)
-                raise
-            st = _PeerState(
-                key, cursor,
-                window_bytes=(self.window_bytes if window_bytes is None
-                              else int(window_bytes)),
-                max_iov=(self.max_iov if max_iov is None
-                         else int(max_iov)),
-                fd=fd, sink=sink, explicit_ack=explicit_ack,
-                lock=self._lock)
-            # skip latency marks already fully delivered before attach
-            st.mark_seq = self._mark_base + len(self._marks)
-            self._peers[key] = st
-            self._work.notify_all()
-            # fleet-plane watermarks: this peer's wire is one link —
-            # append is the shared log's frontier, delivered is the
-            # peer's transport position; seconds come from the shared
-            # publish marks ring (marks_from)
-            log = self.log
-            _WATERMARKS.track("append", f"fanout/{key}",
-                              lambda: log.end, marks_from=_WM_LINK)
-            _WATERMARKS.track("delivered", f"fanout/{key}",
-                              lambda st=st: st.sent)
+                # kernel for an unrelated connection — the dup keeps our
+                # writes pointed at THIS peer's socket until the
+                # dispatcher itself reaps it (_reap_dead_fds).  Inside
+                # the rollback scope: an EMFILE here must detach the
+                # provisional cursor, or the key is unusable forever.
+                owned_fd = os.dup(fd)
+                os.set_blocking(owned_fd, False)
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("fan-out server is closed")
+                if len(self._peers) >= self.max_peers:
+                    # built under the lock (consistent count), emitted
+                    # and raised OUTSIDE it
+                    busy = FanoutBusy(
+                        f"fan-out at capacity ({len(self._peers)}/"
+                        f"{self.max_peers} peers)",
+                        peers=len(self._peers), max_peers=self.max_peers)
+                else:
+                    st = _PeerState(
+                        key, cursor,
+                        window_bytes=(self.window_bytes
+                                      if window_bytes is None
+                                      else int(window_bytes)),
+                        max_iov=(self.max_iov if max_iov is None
+                                 else int(max_iov)),
+                        fd=owned_fd, sink=sink,
+                        explicit_ack=explicit_ack,
+                        lock=self._lock)
+                    # skip latency marks fully delivered pre-attach
+                    st.mark_seq = self._mark_base + len(self._marks)
+                    self._peers[key] = st
+                    peers_now = len(self._peers)
+                    attach_offset = cursor.acked
+                    if _OBS.on:
+                        # gauge set under the lock: concurrent
+                        # attach/detach post-lock sets interleave out
+                        # of order and latch a stale count (the EVENT
+                        # still emits outside — only it can block)
+                        _M_PEERS.set(peers_now)
+                    self._work.notify_all()
+                    # fleet-plane watermarks: this peer's wire is one
+                    # link — append is the shared log's frontier,
+                    # delivered is the peer's transport position;
+                    # seconds come from the shared publish marks ring
+                    # (marks_from)
+                    log = self.log
+                    _WATERMARKS.track("append", f"fanout/{key}",
+                                      lambda: log.end,
+                                      marks_from=_WM_LINK)
+                    _WATERMARKS.track("delivered", f"fanout/{key}",
+                                      lambda st=st: st.sent)
+                    admitted = True
+        finally:
+            if not admitted:
+                # roll the provisional cursor (and owned fd) back out
+                if owned_fd is not None:
+                    os.close(owned_fd)
+                self.log.detach(cursor)
+        if busy is not None:
             if _OBS.on:
-                _M_ATTACHED.inc()
-                _M_PEERS.set(len(self._peers))
-                _emit("fanout.attach", key=key, offset=cursor.acked,
-                      peers=len(self._peers))
+                _M_REJECTED.inc()
+                _emit("fanout.reject", key=key, peers=busy.peers,
+                      max_peers=self.max_peers)
+            raise busy
+        if _OBS.on:
+            _M_ATTACHED.inc()
+            _emit("fanout.attach", key=key, offset=attach_offset,
+                  peers=peers_now)
         return FanoutPeer(self, st)
 
     def _peer_state(self, key: str) -> _PeerState:
@@ -388,17 +457,23 @@ class FanoutServer:
             self._park_fd_locked(st)
             if self._peers.get(st.key) is st:
                 del self._peers[st.key]
+            if _OBS.on:
+                # under the lock for the same stale-interleaving reason
+                # as the attach-side set
+                _M_PEERS.set(len(self._peers))
             st.cv.notify_all()
             self._work.notify_all()
-            if _OBS.on:
-                _M_DETACHED.inc()
-                _M_PEERS.set(len(self._peers))
-                _emit("fanout.detach", key=st.key, sent=st.sent,
-                      shed=st.shed)
+        # emit outside the lock (the event sink can block); st.gone
+        # above makes this path single-shot, so the event fires once
+        if _OBS.on:
+            _M_DETACHED.inc()
+            _emit("fanout.detach", key=st.key, sent=st.sent,
+                  shed=st.shed)
         _WATERMARKS.untrack(f"fanout/{st.key}")
         self.log.detach(st.cursor)
 
     def _ack_peer(self, st: _PeerState, offset: int) -> None:
+        shed_reason = None
         with self._lock:
             if st.gone or st.shed is not None:
                 return
@@ -406,20 +481,30 @@ class FanoutServer:
                 # acking bytes never sent is byzantine even when the
                 # log (which only knows production) would accept it
                 self._shed_locked(st, "byzantine")
-                raise PeerShed(st.key, "byzantine", st.sent)
+                shed_reason = "byzantine"
+        if shed_reason is None:
+            # the log serializes on its own lock (and its refusal/trim
+            # paths emit) — call it with the server lock RELEASED;
+            # racing acks were already byzantine-on-regression before
             try:
                 self.log.ack(st.cursor, offset)
             except SnapshotNeeded:
                 # an honest ack from a cursor the retention budget
                 # already trimmed past: a laggard, not an attacker
-                self._shed_locked(st, "retention")
-                raise PeerShed(st.key, "retention", st.sent) from None
+                shed_reason = "retention"
             except ValueError:
                 # a regressing ack is byzantine
-                self._shed_locked(st, "byzantine")
-                raise PeerShed(st.key, "byzantine", st.sent) from None
-            st.last_progress = time.monotonic()
-            self._work.notify_all()
+                shed_reason = "byzantine"
+            if shed_reason is None:
+                with self._lock:
+                    st.last_progress = time.monotonic()
+                    self._work.notify_all()
+            else:
+                with self._lock:
+                    self._shed_locked(st, shed_reason)
+        self._drain_shed_events()
+        if shed_reason is not None:
+            raise PeerShed(st.key, shed_reason, st.sent)
 
     def _wait_peer_done(self, st: _PeerState,
                         timeout: Optional[float]) -> bool:
@@ -454,6 +539,7 @@ class FanoutServer:
                 self.log.enforce_retention()
                 self._scan_stalls()
                 self._reap_dead_fds()
+                self._drain_shed_events()  # per-turn catch-all
                 if not progressed:
                     # every serveable peer would-blocked (or there was
                     # nothing to serve): back off instead of spinning —
@@ -461,8 +547,10 @@ class FanoutServer:
                     time.sleep(max(self._linger_s, 0.002)
                                if turn else self._linger_s)
         except BaseException as exc:  # noqa: BLE001 — fanned out below
+            # emit BEFORE taking the lock: the event sink can block,
+            # and the peers notified below contend on this lock
+            _emit("fanout.error", error=f"{type(exc).__name__}: {exc}")
             with self._lock:
-                _emit("fanout.error", error=f"{type(exc).__name__}: {exc}")
                 for key in list(self._peers):
                     st = self._peer_state(key)
                     if st.shed is None:
@@ -519,6 +607,7 @@ class FanoutServer:
         except SnapshotNeeded:
             with self._lock:
                 self._shed_locked(st, "retention")
+            self._drain_shed_events()
             return 0
         if not views:
             return 0
@@ -540,6 +629,7 @@ class FanoutServer:
             # it as a disconnect; nobody else notices
             with self._lock:
                 self._shed_locked(st, "disconnect")
+            self._drain_shed_events()
             return 0
         finally:
             for v in views:
@@ -553,11 +643,20 @@ class FanoutServer:
             st.writev_calls += 1
             st.last_progress = now
             self._consume_marks_locked(st, now)
-            if not st.explicit_ack and st.shed is None and not st.gone:
-                try:
-                    self.log.ack(st.cursor, st.sent)
-                except SnapshotNeeded:
+            do_ack = (not st.explicit_ack and st.shed is None
+                      and not st.gone)
+            ack_to = st.sent
+        if do_ack:
+            # the log serializes on its own lock (and its trim path
+            # emits) — ack with the server lock RELEASED; only this
+            # dispatcher thread acks implicit-ack peers, so ack_to is
+            # monotone
+            try:
+                self.log.ack(st.cursor, ack_to)
+            except SnapshotNeeded:
+                with self._lock:
                     self._shed_locked(st, "retention")
+                self._drain_shed_events()
         if _OBS.on:
             _M_SENT.inc(accepted)
             _M_WRITEV.inc()
@@ -592,6 +691,7 @@ class FanoutServer:
                     continue
                 if now - st.last_progress > self.stall_timeout:
                     self._shed_locked(st, "stall")
+        self._drain_shed_events()
 
     def _park_fd_locked(self, st: _PeerState) -> None:
         """Hand a dead peer's owned fd to the dispatcher for closing.
@@ -622,8 +722,17 @@ class FanoutServer:
         st.cv.notify_all()
         if _OBS.on:
             _M_SHED.inc()
-        _emit("fanout.shed", key=st.key, reason=reason, sent=st.sent,
-              peers=len(self._peers))
+        # the EVENT is deferred: queued here (fields captured while
+        # consistent), emitted by _drain_shed_events after release
+        self._shed_events.queue_locked(
+            key=st.key, reason=reason, sent=st.sent,
+            peers=len(self._peers))
+
+    def _drain_shed_events(self) -> None:
+        """Emit queued shed events with the server lock RELEASED.
+        Called by every path that can shed, plus once per dispatcher
+        turn as the catch-all."""
+        self._shed_events.flush()
 
     # -- snapshots / lifecycle ----------------------------------------------
 

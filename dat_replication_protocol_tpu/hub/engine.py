@@ -42,6 +42,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..obs.events import DeferredEmitQueue as _DeferredEmitQueue
 from ..obs.events import emit as _emit
 from ..obs.metrics import (
     OBS as _OBS,
@@ -308,6 +309,12 @@ class ReplicationHub:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._sessions: dict[str, _SessionState] = {}
+        # shed events queued under the lock, emitted by
+        # _drain_shed_events once the holder releases (the event sink
+        # can block; blocking under the hub lock convoys every session)
+        self._shed_events = _DeferredEmitQueue("hub.shed", self._lock)
+        # the concurrency pass enforces these (ANALYSIS.md):
+        # datlint: guarded-by(self._lock): self._sessions
         self._next_id = 0
         self._rr = 0
         self._q_items = 0            # global queued (not yet in pipeline)
@@ -345,6 +352,7 @@ class ReplicationHub:
             raise ValueError(
                 f"session key {key!r} must be non-empty and contain "
                 'none of {},=" or newlines')
+        busy = None
         with self._lock:
             self._check_alive_locked()
             if key is None:
@@ -359,14 +367,11 @@ class ReplicationHub:
             # latter (ROBUSTNESS.md overload behavior)
             if len(self._sessions) >= self.max_sessions or \
                     self._parked_bytes >= self.parked_budget // 2:
-                if _OBS.on:
-                    _M_REJECTED.inc()
-                    _emit("hub.reject", key=key,
-                          sessions=len(self._sessions),
-                          max_sessions=self.max_sessions,
-                          parked_bytes=self._parked_bytes,
-                          parked_budget=self.parked_budget)
-                raise HubBusy(
+                # built under the lock (consistent counts), emitted and
+                # raised OUTSIDE it: the event sink can block, and
+                # blocking under the hub lock convoys every session
+                # (blocking-under-lock contract, ANALYSIS.md)
+                busy = HubBusy(
                     f"hub at capacity ({len(self._sessions)}/"
                     f"{self.max_sessions} sessions, "
                     f"{self._parked_bytes}/{self.parked_budget} parked "
@@ -376,18 +381,32 @@ class ReplicationHub:
                     parked_bytes=self._parked_bytes,
                     parked_budget=self.parked_budget,
                 )
-            st = _SessionState(key, float(weight), self._lock)
-            self._sessions[key] = st
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._dispatch_loop, name="hub-dispatch",
-                    daemon=True)
-                self._thread.start()
+            else:
+                st = _SessionState(key, float(weight), self._lock)
+                self._sessions[key] = st
+                sessions_now = len(self._sessions)
+                if _OBS.on:
+                    # gauge set under the lock: a concurrent
+                    # unregister's set would otherwise interleave out
+                    # of order and latch a stale session count
+                    _M_SESSIONS.set(sessions_now)
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._dispatch_loop, name="hub-dispatch",
+                        daemon=True)
+                    self._thread.start()
+        if busy is not None:
             if _OBS.on:
-                _M_ADMITTED.inc()
-                _M_SESSIONS.set(len(self._sessions))
-                _emit("hub.admit", key=key, weight=float(weight),
-                      sessions=len(self._sessions))
+                _M_REJECTED.inc()
+                _emit("hub.reject", key=key, sessions=busy.sessions,
+                      max_sessions=self.max_sessions,
+                      parked_bytes=busy.parked_bytes,
+                      parked_budget=self.parked_budget)
+            raise busy
+        if _OBS.on:
+            _M_ADMITTED.inc()
+            _emit("hub.admit", key=key, weight=float(weight),
+                  sessions=sessions_now)
         return HubSession(self, st)
 
     def _session_state(self, key: str) -> _SessionState:
@@ -432,6 +451,15 @@ class ReplicationHub:
         granularity.  Blocks (delivering ready completions meanwhile)
         while the session's window is full."""
         n = len(entries)
+        try:
+            self._submit_run_inner(st, entries, run_bytes, n)
+        finally:
+            # emit any shed this submit triggered (possibly our own
+            # SessionShed unwinding) with the lock released
+            self._drain_shed_events()
+
+    def _submit_run_inner(self, st: _SessionState, entries,
+                          run_bytes: int, n: int) -> None:
         while True:
             with self._lock:
                 self._check_session_alive_locked(st)
@@ -584,10 +612,13 @@ class ReplicationHub:
                                       int(0.99 * len(ordered)))]
                     with self._lock:
                         self._maybe_shed_locked(latency_p99=p99)
+                self._drain_shed_events()  # per-turn catch-all
         except BaseException as exc:  # noqa: BLE001 — fanned out below
+            # emit BEFORE taking the lock: the event sink can block,
+            # and the waiters notified below contend on this lock
+            _emit("hub.error", error=f"{type(exc).__name__}: {exc}")
             with self._lock:
                 self._failed = exc
-                _emit("hub.error", error=f"{type(exc).__name__}: {exc}")
                 for key in list(self._sessions):
                     self._session_state(key).cv.notify_all()
                 self._work.notify_all()
@@ -739,8 +770,16 @@ class ReplicationHub:
         if _OBS.on:
             _M_SHED.inc()
             _M_PARKED.set(self._parked_bytes)
-        _emit("hub.shed", key=st.key, reason=reason, parked_bytes=held,
-              sessions=len(self._sessions))
+        # the EVENT is deferred: queued here (fields captured while
+        # consistent), emitted by _drain_shed_events after release
+        self._shed_events.queue_locked(
+            key=st.key, reason=reason, parked_bytes=held,
+            sessions=len(self._sessions))
+
+    def _drain_shed_events(self) -> None:
+        """Emit queued shed events with the hub lock RELEASED.  Called
+        by the submit path and once per dispatcher turn."""
+        self._shed_events.flush()
 
     # -- snapshots / lifecycle ----------------------------------------------
 

@@ -64,6 +64,10 @@ class EventLog:
         # _lock; the (possibly slow) sink I/O serializes on its own lock
         # so concurrent emits cannot interleave characters of two records
         self._sink_lock = threading.Lock()
+        # the concurrency pass enforces these (ANALYSIS.md guarded-state):
+        # datlint: guarded-by(self._lock): self._ring, self._seq, self.dropped
+        # datlint: guarded-by(self._lock): self._sink, self._sink_dead
+        # datlint: guarded-by(self._sink_lock): self.sink_dropped
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0
         self.dropped = 0  # records overwritten by ring wraparound
@@ -106,6 +110,14 @@ class EventLog:
                     # whole (and counted), never appended to the tear
                     self.sink_dropped += 1
                 else:
+                    # _sink_lock exists precisely to serialize this
+                    # I/O: one record = one uninterleaved JSONL line.
+                    # The lock is a LEAF (lock_graph.json: nothing is
+                    # acquired inside except the _latch_dead hop), and
+                    # only emitters that attached a sink pay the cost.
+                    # Callers holding OTHER locks are NOT excused —
+                    # the allow covers this lock alone (lexical-only
+                    # contract).  datlint: allow-blocking-under-lock
                     self._write_sink(sink, rec)
 
     def _latch_dead(self, sink) -> None:
@@ -211,6 +223,11 @@ class EventLog:
         with self._lock:
             self._ring.clear()
             self.dropped = 0
+        # sink_dropped is guarded by _sink_lock (guarded-state decl
+        # below): resetting it under _lock alone raced a concurrent
+        # sink write's increment — a lost update the concurrency pass
+        # caught.  Sequential, never nested, so no new lock-order edge.
+        with self._sink_lock:
             self.sink_dropped = 0
 
 
@@ -220,3 +237,37 @@ EVENTS = EventLog()
 def emit(event: str, **fields) -> None:
     """Emit to the process-global event log (gated, see EventLog.emit)."""
     EVENTS.emit(event, **fields)
+
+
+class DeferredEmitQueue:
+    """Events queued under a subsystem lock, emitted after release.
+
+    The hub and fan-out dispatchers may never emit while holding their
+    lock (the event sink can block — blocking-under-lock contract,
+    ANALYSIS.md), so shed-style events capture their fields while the
+    holder's view is consistent and drain once the lock releases.  The
+    subtle part lives HERE, once: the lock-free peek (a missed peek is
+    drained by the next turn's catch-all), the swap under the OWNER's
+    lock, and the emission strictly outside it.
+
+    ``queue_locked`` must be called with ``lock`` held; ``flush`` must
+    be called with it released (it never waits — the name avoids the
+    transport layer's ``.drain()`` vocabulary, which bounded-wait
+    polices).
+    """
+
+    def __init__(self, event: str, lock):
+        self._event = event
+        self._lock = lock
+        self._pending: list = []
+
+    def queue_locked(self, **fields) -> None:
+        self._pending.append(fields)
+
+    def flush(self) -> None:
+        if not self._pending:  # racy peek: a miss is drained later
+            return
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for fields in pending:
+            emit(self._event, **fields)

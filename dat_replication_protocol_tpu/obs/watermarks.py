@@ -153,6 +153,7 @@ class WatermarkBoard:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        # datlint: guarded-by(self._lock): self._links
         self._links: dict[str, _Link] = {}
         self._collector_fn = self._collect
 

@@ -651,6 +651,13 @@ class WeightedSymbols:
             if m <= have:
                 return self._cells[:m]
             with span("reconcile.build"):
+                # holding the prefix lock ACROSS the build is the
+                # design (see __init__): extension is a read-modify-
+                # write of shared cursor arrays, and every concurrent
+                # responder needs exactly this block's result — there
+                # is nothing useful to do but wait.  Includes the
+                # first caller's one-time native-engine build.
+                # datlint: allow-blocking-under-lock
                 block = self._extend_block(have, m)
             self._cells = np.concatenate([self._cells, block]) \
                 if have else block

@@ -88,6 +88,10 @@ def _load_once():
         if _tried:
             return _mod
         mod = None
+        # the ONE-TIME toolchain build runs under the load lock by
+        # design: every caller needs its result, and serializing here
+        # is what makes the load a process-wide once — audited escape:
+        # datlint: allow-blocking-under-lock
         so = _build()
         if so is not None:
             try:

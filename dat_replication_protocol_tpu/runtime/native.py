@@ -183,6 +183,10 @@ def _load_once() -> ctypes.CDLL | None:
         if _tried:
             return _lib
         lib = None
+        # the ONE-TIME toolchain build runs under the load lock by
+        # design: every caller needs its result, and serializing here
+        # is what makes the load a process-wide once — audited escape:
+        # datlint: allow-blocking-under-lock
         so = _build()
         if so is not None:
             try:
@@ -193,12 +197,13 @@ def _load_once() -> ctypes.CDLL | None:
                 lib = None
         _lib = lib
         _tried = True
-        if _OBS.on:
-            # once per process (the load is cached): which engine tier
-            # this host actually has — the first question when a bench
-            # number moves between runners
-            _emit("device.native.load", ok=lib is not None)
-        return _lib
+    if _OBS.on:
+        # once per process (only the winning builder reaches here —
+        # emitted AFTER the lock releases, the sink can block): which
+        # engine tier this host actually has, the first question when
+        # a bench number moves between runners
+        _emit("device.native.load", ok=lib is not None)
+    return _lib
 
 
 def reset_for_tests() -> None:
