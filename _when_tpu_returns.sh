@@ -311,3 +311,23 @@ EOF
     && touch "$OUT/.leg_snapshot_done"
   commit_out "r06 watch: snapshot-bootstrap manifest + weighted-build device capture ($STAMP)"
 fi
+
+# 10) ISSUE 14 wire-pump device leg: the pump->DigestPipeline device
+#     feed at dataset scale, plus the hub-aggregate scaling curve on a
+#     host with real cores (the 2-core CI box caps the curve at ~1.0x;
+#     the TPU host's CPU count is where "no longer GIL-flat" becomes a
+#     measured number instead of an argument).  Config 13 at full size
+#     with the 1/4/16/64 session ladder, native route, device backend
+#     alive so session digests ride the device pipeline.
+if [ ! -f "$OUT/.leg_pump_done" ]; then
+  DAT_PUMP=native BENCH_CONFIGS=13 BENCH_PUMP_MIB=256 \
+    BENCH_PUMP_SESSIONS=1,4,16,64 BENCH_PUMP_REPS=3 BENCH_DEADLINE=1200 \
+    timeout 1500 python bench.py --metrics \
+    >"$OUT/pump_dev_$STAMP.json" 2>"$OUT/pump_dev_$STAMP.log"
+  tail -c 16384 "$OUT/pump_dev_$STAMP.log" \
+    >"$OUT/pump_dev_$STAMP.log.tail" \
+    && rm -f "$OUT/pump_dev_$STAMP.log"
+  grep -q '"wire_pump"' "$OUT/pump_dev_$STAMP.json" \
+    && touch "$OUT/.leg_pump_done"
+  commit_out "r06 watch: wire-pump device feed + hub scaling ladder ($STAMP)"
+fi

@@ -806,11 +806,20 @@ class ReplicationHub:
                     for key in self._sessions}
 
     def snapshot(self) -> dict:
+        # the pump route resolves OUTSIDE the lock (env read + cached
+        # library check, but blocking-under-lock stays trivially clean)
+        from ..session.pump import effective_pump_route
+
+        pump_route = effective_pump_route()
         with self._lock:
             return {
                 "sessions": len(self._sessions),
                 "parked_bytes": self._parked_bytes,
                 "queued_items": self._q_items,
+                # which byte mover feeds the sessions multiplexed here
+                # (ISSUE 14): hub aggregate scaling is only legible next
+                # to the wire route that produced it
+                "pump_route": pump_route,
                 "failed": (None if self._failed is None
                            else f"{type(self._failed).__name__}: "
                                 f"{self._failed}"),

@@ -2128,3 +2128,331 @@ int64_t dat_cdc_hash(const uint8_t* buf, int64_t n, int64_t avg_bits,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Transport pump (ISSUE 14): batched-syscall socket loops.
+//
+// The Python wire pumps (session/transport.py) cost one interpreter
+// round-trip per 64 KiB chunk — at r06 that path, not the crypto, was
+// the host e2e floor (ROADMAP item 5).  These entry points move whole
+// BATCHES of wire bytes per ctypes call (the GIL is released for the
+// call's entire duration), so the interpreter sees one wakeup per
+// multi-megabyte slab instead of one per chunk:
+//
+//   dat_pump_probe      which batched syscalls this kernel serves
+//   dat_pump_recv_scan  blocking first read + MSG_DONTWAIT recvmmsg
+//                       drain + frame index over the received prefix
+//                       (the SAME dat_split_frames scanner — one
+//                       owner, so the pump cannot fork the framing)
+//   dat_pump_send       gather-send spans to a blocking fd
+//                       (sendmmsg batches; writev fallback)
+//   dat_pump_send_nb    gather-send until EAGAIN on a non-blocking fd
+//                       (the fan-out hot path: spans are BroadcastLog
+//                       segment memory, never Python-owned copies)
+//
+// Every path degrades: ENOSYS / ENOTSOCK / EOPNOTSUPP fall back to
+// plain read/writev batches, so pipes (sidecar --stdio) and kernels
+// without the mmsg syscalls serve the same byte stream.
+
+#include <errno.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+// geometry of one batched syscall: messages per mmsg call x iovecs per
+// message.  16 x 64 = up to 1024 spans (or 16 recv slices) per kernel
+// entry; past that the syscall itself stops being the bottleneck.
+constexpr int PUMP_MSGS = 16;
+constexpr int PUMP_IOV = 64;
+
+// errno says this fd/kernel cannot serve the mmsg syscall at all (the
+// fallback decision, distinct from transient EAGAIN/EINTR)
+inline bool mmsg_unsupported(int e) {
+  return e == ENOSYS || e == ENOTSOCK || e == EOPNOTSUPP || e == EINVAL;
+}
+
+struct SpanCursor {
+  const int64_t* addrs;
+  const int64_t* lens;
+  int64_t n;
+  int64_t si = 0;    // current span
+  int64_t off = 0;   // bytes of span si already sent
+  bool done() const { return si >= n; }
+  // fill up to `cap` iovecs from the cursor; returns the count
+  int fill(struct iovec* iov, int cap) const {
+    int k = 0;
+    int64_t s = si, o = off;
+    while (k < cap && s < n) {
+      if (lens[s] <= o) { ++s; o = 0; continue; }
+      iov[k].iov_base = reinterpret_cast<void*>(
+          static_cast<uintptr_t>(addrs[s]) + o);
+      iov[k].iov_len = static_cast<size_t>(lens[s] - o);
+      ++k; ++s; o = 0;
+    }
+    return k;
+  }
+  void advance(int64_t nbytes) {
+    while (nbytes > 0 && si < n) {
+      int64_t left = lens[si] - off;
+      if (nbytes < left) { off += nbytes; return; }
+      nbytes -= left;
+      ++si; off = 0;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Runtime probe: bit 0 = recvmmsg served, bit 1 = sendmmsg served.
+// A call on fd -1 distinguishes "syscall exists" (EBADF) from "kernel
+// does not serve it" (ENOSYS) without touching any real descriptor.
+int64_t dat_pump_probe(void) {
+  int64_t caps = 0;
+  errno = 0;
+  if (recvmmsg(-1, nullptr, 0, 0, nullptr) < 0 && errno != ENOSYS)
+    caps |= 1;
+  errno = 0;
+  if (sendmmsg(-1, nullptr, 0, 0) < 0 && errno != ENOSYS)
+    caps |= 2;
+  return caps;
+}
+
+// Batched receive + native frame scan, one GIL-released call:
+//
+//   1. ONE blocking read() (the wakeup — works on sockets and pipes);
+//   2. drain whatever the kernel already buffered with MSG_DONTWAIT
+//      recvmmsg batches (never blocks; pipes/old kernels skip this);
+//   3. index the received prefix's complete frames with
+//      dat_split_frames (same scanner, same error semantics — the
+//      Python side hands the index to the decoder's bulk entry).
+//
+// Returns total bytes received (0 = EOF before any byte, the caller
+// re-observes EOF on its next call after a mid-batch EOF), or -errno.
+// nframes/consumed/err are dat_split_frames' outputs over the prefix;
+// stats[0] counts syscalls made, stats[1] messages (reads) landed —
+// stats[1] - stats[0] is the syscalls the batching saved.
+//
+// cap must hold at least one maximal frame header or the scan could
+// never make progress:  // wire: MAX_HEADER_LEN = 11
+int64_t dat_pump_recv_scan(int64_t fd, uint8_t* dst, int64_t cap,
+                           int64_t slice, int64_t* starts, int64_t* lens,
+                           uint8_t* ids, int64_t icap, int64_t* nframes,
+                           int64_t* consumed, int64_t* err,
+                           int64_t* stats) {
+  *nframes = 0;
+  *consumed = 0;
+  *err = 0;
+  stats[0] = 0;
+  stats[1] = 0;
+  if (cap < 11 || slice < 1) return DAT_ERR_CAPACITY;
+  if (slice > cap) slice = cap;
+  int64_t total = 0;
+  for (;;) {  // the blocking wakeup read
+    ssize_t r = read(static_cast<int>(fd), dst, static_cast<size_t>(slice));
+    ++stats[0];
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -static_cast<int64_t>(errno);
+    }
+    total = r;
+    break;
+  }
+  if (total == 0) return 0;  // EOF
+  ++stats[1];
+  // drain only when the wakeup read filled its slice: a short first
+  // read means the kernel buffer is (momentarily) empty, and probing
+  // it with recvmmsg would just buy an EAGAIN — the exact per-batch
+  // syscall this pump exists to save
+  bool more = total >= slice;
+  while (more && cap - total > 0) {
+    struct mmsghdr hdrs[PUMP_MSGS];
+    struct iovec iov[PUMP_MSGS];
+    int k = 0;
+    int64_t off = total;
+    while (k < PUMP_MSGS && off < cap) {
+      int64_t take = cap - off < slice ? cap - off : slice;
+      iov[k].iov_base = dst + off;
+      iov[k].iov_len = static_cast<size_t>(take);
+      std::memset(&hdrs[k].msg_hdr, 0, sizeof(hdrs[k].msg_hdr));
+      hdrs[k].msg_hdr.msg_iov = &iov[k];
+      hdrs[k].msg_hdr.msg_iovlen = 1;
+      hdrs[k].msg_len = 0;
+      off += take;
+      ++k;
+    }
+    int r = recvmmsg(static_cast<int>(fd), hdrs, static_cast<unsigned>(k),
+                     MSG_DONTWAIT, nullptr);
+    ++stats[0];
+    if (r < 0) {
+      // EAGAIN: drained.  unsupported (pipe / old kernel): the
+      // blocking read stands alone.  EINTR: just deliver what we have
+      // — the next pump call re-enters.  Hard errors too: the bytes
+      // already received must reach the decoder before the caller can
+      // surface anything.
+      break;
+    }
+    // STREAM semantics: each message is an independent recvmsg into a
+    // fixed-offset iovec, so a short message followed by a non-empty
+    // one (bytes that landed between the two) leaves a HOLE at the
+    // layout offsets.  Compact every message's bytes down to the
+    // running cursor — the wire must be contiguous in dst.
+    int64_t w = total;
+    for (int m2 = 0; m2 < r; ++m2) {
+      int64_t got = hdrs[m2].msg_len;
+      if (got == 0) { more = false; break; }  // EOF: deliver the prefix
+      if (dst + w != static_cast<uint8_t*>(iov[m2].iov_base))
+        std::memmove(dst + w, iov[m2].iov_base, static_cast<size_t>(got));
+      w += got;
+      ++stats[1];
+      if (got < static_cast<int64_t>(iov[m2].iov_len))
+        more = false;  // short message: kernel buffer drained
+    }
+    total = w;
+    if (r < k) more = false;
+  }
+  int64_t nf = dat_split_frames(dst, total, starts, lens, ids, icap,
+                                consumed, err);
+  if (nf == DAT_ERR_CAPACITY) {
+    // the filled prefix is a complete, valid index (dat_split_frames
+    // stores frames [0, icap) and leaves `consumed` one past the last
+    // stored frame): the unindexed tail simply re-enters the decoder's
+    // overflow, so callers can size the index for the TYPICAL frame
+    // density instead of the 2-byte worst case
+    nf = icap;
+    *err = 0;
+  } else if (nf < 0) {
+    nf = 0;
+    *consumed = 0;
+    *err = 0;
+  }
+  *nframes = nf;
+  return total;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Shared gather-send core.  Walks the span cursor with sendmmsg
+// batches (PUMP_MSGS messages x PUMP_IOV iovecs per syscall — a
+// stream socket concatenates them in order) and degrades to plain
+// writev batches when the fd/kernel cannot serve sendmmsg.  Partial
+// acceptance (short msg_len / short writev) resumes mid-span.
+// `stop_on_block`: return the accepted total at EAGAIN (non-blocking
+// fan-out peers) instead of treating it as an error.  Returns total
+// bytes the kernel accepted, or -errno on a hard error (the caller
+// surfaces it; bytes already accepted are gone either way — same
+// contract as a failed os.writev).
+int64_t pump_send_core(const int64_t* addrs, const int64_t* lens,
+                       int64_t n, int fd, bool stop_on_block,
+                       int64_t* stats) {
+  SpanCursor cur{addrs, lens, n};
+  int64_t total = 0;
+  bool use_mmsg = true;
+  while (!cur.done()) {
+    if (use_mmsg) {
+      struct mmsghdr hdrs[PUMP_MSGS];
+      struct iovec iov[PUMP_MSGS * PUMP_IOV];
+      SpanCursor peek = cur;
+      int m = 0;
+      int filled = 0;
+      while (m < PUMP_MSGS && !peek.done()) {
+        int k = peek.fill(iov + filled, PUMP_IOV);
+        if (k == 0) break;
+        std::memset(&hdrs[m].msg_hdr, 0, sizeof(hdrs[m].msg_hdr));
+        hdrs[m].msg_hdr.msg_iov = iov + filled;
+        hdrs[m].msg_hdr.msg_iovlen = static_cast<size_t>(k);
+        hdrs[m].msg_len = 0;
+        int64_t span_bytes = 0;
+        for (int i = 0; i < k; ++i)
+          span_bytes += static_cast<int64_t>(iov[filled + i].iov_len);
+        peek.advance(span_bytes);
+        filled += k;
+        ++m;
+      }
+      if (m == 0) break;
+      int r = sendmmsg(fd, hdrs, static_cast<unsigned>(m),
+                       stop_on_block ? MSG_DONTWAIT : 0);
+      ++stats[0];
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          if (stop_on_block) return total;
+          continue;  // blocking fd: spurious; retry
+        }
+        if (mmsg_unsupported(errno)) {
+          use_mmsg = false;  // degrade to the writev loop
+          continue;
+        }
+        return -static_cast<int64_t>(errno);
+      }
+      bool partial = false;
+      for (int i = 0; i < r; ++i) {
+        int64_t sent = hdrs[i].msg_len;
+        total += sent;
+        cur.advance(sent);
+        ++stats[1];
+        int64_t msg_total = 0;
+        for (size_t v = 0; v < hdrs[i].msg_hdr.msg_iovlen; ++v)
+          msg_total += static_cast<int64_t>(hdrs[i].msg_hdr.msg_iov[v].iov_len);
+        if (sent < msg_total) { partial = true; break; }
+      }
+      // a partial message (or fewer messages than requested) means the
+      // kernel stopped accepting: non-blocking callers return with the
+      // accepted total, blocking ones re-enter from the cursor
+      if ((partial || r < m) && stop_on_block) return total;
+      continue;
+    }
+    struct iovec iov[PUMP_IOV];
+    int k = cur.fill(iov, PUMP_IOV);
+    if (k == 0) break;
+    ssize_t w = writev(fd, iov, k);
+    ++stats[0];
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        if (stop_on_block) return total;
+        continue;
+      }
+      return -static_cast<int64_t>(errno);
+    }
+    ++stats[1];
+    total += w;
+    cur.advance(w);
+  }
+  return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather-send `n` (address, length) spans to a BLOCKING fd.  Returns
+// total bytes written (== sum of lens on success) or -errno.
+// stats[0] = syscalls, stats[1] = messages/writevs accepted.
+int64_t dat_pump_send(const int64_t* addrs, const int64_t* lens,
+                      int64_t n, int64_t fd, int64_t* stats) {
+  stats[0] = 0;
+  stats[1] = 0;
+  return pump_send_core(addrs, lens, n, static_cast<int>(fd), false,
+                        stats);
+}
+
+// Gather-send to a NON-BLOCKING fd: pushes batches until the kernel
+// stops accepting (EAGAIN / partial acceptance) and returns the bytes
+// accepted so far (>= 0) — the fan-out dispatcher's bookkeeping
+// contract, identical to a short os.writev.  Hard errors are -errno
+// (EPIPE/EBADF: the caller sheds the peer as a disconnect).
+int64_t dat_pump_send_nb(const int64_t* addrs, const int64_t* lens,
+                         int64_t n, int64_t fd, int64_t* stats) {
+  stats[0] = 0;
+  stats[1] = 0;
+  return pump_send_core(addrs, lens, n, static_cast<int>(fd), true,
+                        stats);
+}
+
+}  // extern "C"

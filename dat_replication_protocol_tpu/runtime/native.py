@@ -159,6 +159,24 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _U8P, _I64P, ctypes.c_int64, _U64P, _U64P,
         ctypes.c_int64, ctypes.c_int64, _U32P, ctypes.c_int64,
     ]
+    # transport pump (ISSUE 14): batched-syscall socket loops
+    lib.dat_pump_probe.restype = ctypes.c_int64
+    lib.dat_pump_probe.argtypes = []
+    lib.dat_pump_recv_scan.restype = ctypes.c_int64
+    lib.dat_pump_recv_scan.argtypes = [
+        ctypes.c_int64, _U8P, ctypes.c_int64, ctypes.c_int64,
+        _I64P, _I64P, _U8P, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), _I64P,
+    ]
+    lib.dat_pump_send.restype = ctypes.c_int64
+    lib.dat_pump_send.argtypes = [
+        _I64P, _I64P, ctypes.c_int64, ctypes.c_int64, _I64P,
+    ]
+    lib.dat_pump_send_nb.restype = ctypes.c_int64
+    lib.dat_pump_send_nb.argtypes = [
+        _I64P, _I64P, ctypes.c_int64, ctypes.c_int64, _I64P,
+    ]
     return lib
 
 
@@ -471,3 +489,59 @@ def gear_candidates(buf: np.ndarray, avg_bits: int, thin_bits: int = -1,
         if rc < 0:
             return None
         return out[:rc]
+
+
+# -- transport pump (ISSUE 14) ----------------------------------------------
+# Thin ctypes fronts for the batched-syscall socket loops; the policy
+# layer (route selection, decoder feeding, flow control, telemetry)
+# lives in session/pump.py.  All of these return ``None`` when the
+# native library is unavailable — callers take the Python pumps.
+
+
+def pump_probe() -> int | None:
+    """Bitmask of batched syscalls this kernel serves (bit 0 recvmmsg,
+    bit 1 sendmmsg), or ``None`` without the native library.  The pump
+    itself degrades per call (ENOSYS/ENOTSOCK fall back to plain
+    read/writev batches); this probe only feeds telemetry."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    return int(lib.dat_pump_probe())
+
+
+def pump_recv_scan(fd: int, buf: np.ndarray, slice_bytes: int,
+                   starts: np.ndarray, lens: np.ndarray, ids: np.ndarray,
+                   stats: np.ndarray):
+    """One batched receive into ``buf`` plus a native frame index over
+    the received prefix (``dat_pump_recv_scan``): returns
+    ``(nbytes, nframes, consumed, err)`` — ``nbytes`` 0 at EOF,
+    negative ``-errno`` on a transport error; ``nframes``/``consumed``
+    are ``dat_split_frames``' outputs (the decoder's bulk-index input).
+    ``stats`` (int64[2]) receives [syscalls, messages] for the call.
+    ``None`` when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    nf = ctypes.c_int64(0)
+    consumed = ctypes.c_int64(0)
+    err = ctypes.c_int64(0)
+    n = lib.dat_pump_recv_scan(fd, buf, len(buf), slice_bytes,
+                               starts, lens, ids, len(starts),
+                               ctypes.byref(nf), ctypes.byref(consumed),
+                               ctypes.byref(err), stats)
+    return int(n), int(nf.value), int(consumed.value), int(err.value)
+
+
+def pump_send_spans(fd: int, addrs: np.ndarray, lens: np.ndarray,
+                    n: int, stats: np.ndarray, nonblocking: bool = False):
+    """Gather-send ``n`` (address, length) spans (``dat_pump_send`` /
+    ``_nb``): the whole batch goes through sendmmsg/writev loops with
+    the GIL released; returns bytes the kernel accepted (the full sum
+    on a blocking fd) or ``-errno``.  The caller owns keeping every
+    span's backing buffer alive across the call.  ``None`` when the
+    native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    fn = lib.dat_pump_send_nb if nonblocking else lib.dat_pump_send
+    return int(fn(addrs, lens, n, fd, stats))

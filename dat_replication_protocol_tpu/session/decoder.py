@@ -812,11 +812,28 @@ class Decoder:
         # how the transport chunked its writes.
         if n <= 0:
             return False
+        self._install_index(buf, arr, starts, lens, ids, n,
+                            int(consumed.value))
+        return True
 
+    def _install_index(self, buf, arr, starts, lens, ids, n: int,
+                       consumed: int) -> None:
+        """Park a frame index over ``buf`` as the bulk cursor — the
+        shared installer behind :meth:`_start_indexed` (scan done here)
+        and :meth:`write_indexed` (scan done inside the native pump's
+        GIL-released receive call).  ``starts``/``lens``/``ids`` may be
+        over-allocated; only ``[:n]`` is the index."""
+        import ctypes
+
+        import numpy as np
+
+        from ..runtime import native
+
+        lib = native.get_lib()
         cols_np = None
         cidx = np.nonzero(ids[:n] == TYPE_CHANGE)[0]
         m = len(cidx)
-        if m >= 16:
+        if m >= 16 and lib is not None:
             chg = np.empty(m, np.uint32)
             frm = np.empty(m, np.uint32)
             tov = np.empty(m, np.uint32)
@@ -852,13 +869,54 @@ class Decoder:
             "starts_np": np.ascontiguousarray(starts[:n]),
             "lens_np": np.ascontiguousarray(lens[:n]),
             "n": n,
-            "consumed": int(consumed.value),
+            "consumed": consumed,
             "f": 0,
             "row": 0,
             "cols_np": cols_np,
             "blob_open": False,
         }
-        return True
+
+    def write_indexed(self, data, starts, lens, ids, n: int,
+                      consumed: int) -> bool:
+        """Feed wire bytes WITH a pre-computed native frame index — the
+        transport pump's bulk entry (session/pump.py): the pump's
+        GIL-released receive call already ran ``dat_split_frames`` over
+        ``data``, so the index installs directly instead of re-scanning.
+        Return contract matches :meth:`write` (True = fully consumed
+        synchronously).
+
+        Only valid at a clean frame boundary with nothing parked; any
+        other parser state falls back to :meth:`write` (the index is
+        then recomputed if the merged backlog qualifies) — byte-stream
+        semantics are identical either way, this entry only skips
+        redundant work."""
+        if (n <= 0 or self._overflow or self._bulk is not None
+                or self._pbatch is not None or self._state != TYPE_HEADER
+                or self._header or self._consuming or self._stalled()):
+            return self.write(data)
+        if self.destroyed:
+            raise DecoderDestroyedError("write after destroy")
+        if self.finished or self._end_queued:
+            raise DecoderDestroyedError("write after end")
+        import numpy as np
+
+        buf = memoryview(data)
+        self.bytes += len(buf)
+        self._install_index(buf, np.frombuffer(buf, dtype=np.uint8),
+                            starts, lens, ids, n, consumed)
+        if _OBS.on:
+            _M_DEC_BYTES.inc(len(buf))
+            t0 = _perf()
+            try:
+                self._consume()
+            finally:
+                _H_DEC_DISPATCH.observe(_perf() - t0)
+        else:
+            self._consume()
+        return not (
+            self._overflow or self._bulk is not None
+            or self._pbatch is not None or self._stalled()
+        )
 
     @staticmethod
     def _cols_lists(st: dict):

@@ -1,0 +1,434 @@
+"""Kernel-bypass wire pump: batched-syscall transport loops (ISSUE 14).
+
+The r06 capture located the host e2e floor in the Python wire path, not
+the crypto: native hashing runs GiB/s while the pump loops in
+:mod:`.transport` pay one interpreter round-trip per 64 KiB chunk —
+``read_bytes`` call, ``decoder.write``, wake bookkeeping — and hold the
+GIL for all of it.  Following the SmartNIC replication shape (PAPERS.md:
+move the replication data plane below the host CPU), this module routes
+the byte loops through the C extension instead:
+
+* **Receive** (:func:`recv_pump`): one ``dat_pump_recv_scan`` call per
+  slab — a blocking wakeup ``read``, a ``MSG_DONTWAIT`` ``recvmmsg``
+  drain of whatever the kernel already buffered, and the native frame
+  scan, all with the GIL released — then ONE
+  :meth:`~.decoder.Decoder.write_indexed` hands the decoder the bytes
+  plus the finished frame index.  Python sees only coalesced units:
+  columnar ChangeBatch runs, blob extents as memoryviews, control
+  frames individually (exactly what the decoder's bulk dispatch already
+  surfaces).
+* **Send** (:func:`send_pump`): megabyte pulls from the encoder pushed
+  through ``dat_pump_send``'s gather loop (sendmmsg batches, writev
+  fallback, partial acceptance resumed natively).
+* **Fan-out gather** (:func:`send_spans_nb`): the broadcast hot path —
+  BroadcastLog segment memoryviews go to the kernel as (address,
+  length) spans through one non-blocking sendmmsg/writev batch per
+  dispatcher turn.  Zero Python-owned payload bytes; the dispatcher
+  keeps every window/ack/shed decision (ROBUSTNESS.md: the overload
+  contract is unchanged, only the byte mover is).
+
+**Route selection** (the ``DAT_CDC_ROUTE`` pattern): ``DAT_PUMP=python``
+pins the portable reference pumps in :mod:`.transport`;
+``DAT_PUMP=native`` (and the default, when the native library is
+available) takes the batched loops.  Unrecognized values resolve to the
+default.  Both routes are byte-identical — deliveries, digests,
+checkpoints, and structured errors — enforced by the chaos parity
+sweep (tests/test_pump_parity.py); the Python pump stays the portable
+reference, never a second protocol.
+
+Backpressure is the transport module's contract verbatim: the receive
+pump stops calling into the kernel while the decoder stalls (the
+kernel socket buffer absorbs the window), the send pump stops pulling
+while the transport blocks.  PERF.md "Wire pump" has the syscall cost
+model and the batch-size sweep.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from time import perf_counter as _perf
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..obs.metrics import OBS as _OBS, counter as _counter, \
+    histogram as _histogram
+from ..runtime import native
+from .decoder import Decoder, DecoderDestroyedError
+from .encoder import Encoder, EncoderDestroyedError
+from .transport import WAKE_FALLBACK, recv_over, send_over, \
+    write_all as _write_all
+
+__all__ = [
+    "effective_pump_route", "recv_pump", "send_pump", "pump_reader",
+    "pump_writer", "io_for_socket", "send_spans_nb", "probe_caps",
+]
+
+# receive slab geometry: cap bounds one pump call's batch (and the
+# decoder's largest single bulk index); slice is the per-message recv
+# size inside the batch.  Measured on the dev box (PERF.md sweep):
+# 2 MiB / 1 MiB is ~1.3x the Python pump on the digest-session shape;
+# smaller slices re-enter the interpreter per ~kernel-buffer-full.
+PUMP_BUF = 2 << 20
+PUMP_SLICE = 1 << 20
+# send pull size: one encoder.read per native gather call
+PUMP_SEND_CHUNK = 1 << 20
+
+# transport.pump.* telemetry (OBSERVABILITY.md catalog), hoisted at
+# import so the disabled path is one attribute load
+_M_BATCHES = _counter("transport.pump.batches")
+_M_MSGS = _counter("transport.pump.msgs")
+_M_SYSCALLS = _counter("transport.pump.syscalls")
+_M_SAVED = _counter("transport.pump.syscalls_saved")
+_M_BYTES = _counter("transport.pump.bytes")
+_M_GATHER_BYTES = _counter("transport.pump.gather.bytes")
+_M_FALLBACK = _counter("transport.pump.route.python")
+# time spent inside one native pump call — the GIL is released for the
+# whole span, so this histogram IS the GIL-released time the batching
+# buys back from the interpreter
+_H_NATIVE = _histogram("transport.pump.native.seconds")
+
+
+def effective_pump_route() -> str:
+    """The ONE owner of pump-route resolution (the
+    ``DAT_CDC_ROUTE``/``effective_route`` pattern): consult ``DAT_PUMP``
+    (``native`` / ``python``), defaulting to ``native`` when the C
+    engine is loadable; unrecognized values resolve to the default, and
+    ``native`` silently degrades to ``python`` on toolchain-less hosts
+    — the route that runs is always a route that exists."""
+    route = os.environ.get("DAT_PUMP")
+    if route == "python":
+        return "python"
+    return "native" if native.available() else "python"
+
+
+def probe_caps() -> dict:
+    """Snapshot of the pump's runtime probe — what ``--stats-fd``
+    records carry so an operator can see which syscall tier a host
+    actually serves (the probe never gates the pump: each call
+    degrades per-fd)."""
+    caps = native.pump_probe()
+    return {
+        "route": effective_pump_route(),
+        "native_available": caps is not None,
+        "recvmmsg": bool(caps & 1) if caps is not None else False,
+        "sendmmsg": bool(caps & 2) if caps is not None else False,
+    }
+
+
+class _RecvState:
+    """Per-pump-loop native index buffers, allocated once per session.
+
+    The receive SLAB is not here: each batch lands in a fresh
+    allocation handed to the decoder as a zero-copy view (the decoder
+    may pin slices in its overflow/bulk cursors arbitrarily long, and
+    re-reading into a shared buffer under them would corrupt the wire
+    — while copying out of it, the alternative, costs a second pass
+    over every byte)."""
+
+    __slots__ = ("cap", "starts", "lens", "ids", "stats")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        # index capacity is sized for the TYPICAL frame density, not
+        # the 2-byte worst case (that would be ~17 bytes of index per
+        # 2 wire bytes, per session): a denser slab comes back as a
+        # valid partial index and its tail re-enters the decoder's
+        # overflow — correctness never depends on icap
+        icap = cap // 16 + 1
+        self.starts = np.empty(icap, dtype=np.int64)
+        self.lens = np.empty(icap, dtype=np.int64)
+        self.ids = np.empty(icap, dtype=np.uint8)
+        self.stats = np.zeros(2, dtype=np.int64)
+
+
+def _note_batch(nbytes: int, stats) -> None:
+    syscalls = int(stats[0])
+    msgs = int(stats[1])
+    _M_BATCHES.inc()
+    _M_MSGS.inc(msgs)
+    _M_SYSCALLS.inc(syscalls)
+    if msgs > syscalls:
+        _M_SAVED.inc(msgs - syscalls)
+    _M_BYTES.inc(nbytes)
+
+
+def recv_pump(decoder: Decoder, fd: int,
+              tap: Optional[Callable[[bytes], None]] = None,
+              cap: int = PUMP_BUF) -> None:
+    """Pump ``fd`` into ``decoder`` until EOF or destroy, batched.
+
+    The native twin of :func:`.transport.recv_over` (same flow-control
+    contract: reading suspends while the decoder stalls, resuming on
+    its drain watcher).  ``tap`` observes every received slab as the
+    exact ``bytes`` object the decoder is fed — the fan-out source's
+    publish hook, byte-identical to wrapping ``read_bytes``.  Falls
+    back to the Python pump when the route (or the library) says so.
+    """
+    if effective_pump_route() != "native":
+        if _OBS.on:
+            _M_FALLBACK.inc()
+        read_bytes = _tapped_reader(fd, tap)
+        recv_over(decoder, read_bytes)
+        return
+    st = _RecvState(cap)
+    wake = threading.Event()
+    decoder._add_drain_watcher(wake.set)
+    try:
+        while not decoder.destroyed:
+            buf = np.empty(st.cap, dtype=np.uint8)  # fresh: see _RecvState
+            t0 = _perf()
+            r = native.pump_recv_scan(fd, buf, PUMP_SLICE, st.starts,
+                                      st.lens, st.ids, st.stats)
+            if r is None:  # library vanished mid-session (tests reset)
+                recv_over(decoder, _tapped_reader(fd, tap))
+                return
+            nbytes, nframes, consumed, _err = r
+            if _OBS.on:
+                _H_NATIVE.observe(_perf() - t0)
+            if nbytes == 0:
+                if not decoder.destroyed and not decoder.finished:
+                    decoder.end()
+                return
+            if nbytes < 0:
+                raise OSError(-nbytes, os.strerror(-nbytes))
+            if _OBS.on:
+                _note_batch(nbytes, st.stats)
+            # zero-copy handoff: the decoder owns this slab's memory
+            # from here (its cursors may pin slices of it); the tap
+            # sees the same bytes as one read-only view
+            data = memoryview(buf)[:nbytes]
+            if tap is not None:
+                tap(data)
+            wake.clear()
+            try:
+                ok = decoder.write_indexed(data, st.starts, st.lens,
+                                           st.ids, nframes, consumed)
+            except DecoderDestroyedError:
+                return
+            if not ok:
+                while not (decoder.writable() or decoder.destroyed
+                           or decoder.finished):
+                    wake.wait(WAKE_FALLBACK)
+                    wake.clear()
+    finally:
+        decoder._remove_drain_watcher(wake.set)
+
+
+def _tapped_reader(fd: int, tap) -> Callable[[int], bytes]:
+    if tap is None:
+        return lambda n: os.read(fd, n)
+
+    def read_bytes(n: int) -> bytes:
+        data = os.read(fd, n)
+        if data:
+            tap(data)
+        return data
+
+    return read_bytes
+
+
+def send_pump(encoder: Encoder, fd: int,
+              close: Optional[Callable[[], None]] = None,
+              on_progress: Optional[Callable[[], None]] = None) -> None:
+    """Pump ``encoder`` to ``fd`` until EOF or destroy, batched.
+
+    The native twin of :func:`.transport.send_over`: megabyte pulls,
+    each pushed through one GIL-released native gather call that owns
+    the partial-write resume loop.  ``on_progress`` fires after every
+    accepted batch (the sidecar's reply-stall clock).  Falls back to
+    the Python pump when the route (or the library) says so."""
+    if effective_pump_route() != "native":
+        if _OBS.on:
+            _M_FALLBACK.inc()
+
+        def write_bytes(data) -> None:
+            _write_all(fd, data)
+            if on_progress is not None:
+                on_progress()
+
+        send_over(encoder, write_bytes, close=close)
+        return
+    addrs = np.zeros(1, dtype=np.int64)
+    lens = np.zeros(1, dtype=np.int64)
+    stats = np.zeros(2, dtype=np.int64)
+    readable = threading.Event()
+    encoder._attach_readable(readable.set)
+    encoder.on_error(lambda _e: readable.set())
+    try:
+        while True:
+            try:
+                data = encoder.read(PUMP_SEND_CHUNK)
+            except EncoderDestroyedError:
+                break
+            if data is None:  # finalized and drained
+                break
+            if not data:
+                readable.wait(WAKE_FALLBACK)
+                readable.clear()
+                continue
+            arr = np.frombuffer(data, dtype=np.uint8)
+            addrs[0] = arr.__array_interface__["data"][0]
+            lens[0] = len(data)
+            t0 = _perf()
+            # `data`/`arr` stay referenced (bytes pinned) for the call
+            w = native.pump_send_spans(fd, addrs, lens, 1, stats)
+            if _OBS.on:
+                _H_NATIVE.observe(_perf() - t0)
+            if w is None:  # library vanished mid-session: finish plain
+                _write_all(fd, data)
+                w = len(data)
+            elif w < 0:
+                raise OSError(-w, os.strerror(-w))
+            if _OBS.on:
+                _note_batch(int(w), stats)
+            if on_progress is not None:
+                on_progress()
+    finally:
+        encoder._detach_readable()
+        if close is not None:
+            try:
+                close()
+            except OSError:
+                pass
+
+
+def pump_reader(fd: int, cap: int = PUMP_BUF) -> Callable[[int], bytes]:
+    """A ``read_bytes`` drop-in serving batched native receives — the
+    pump selector for callers that feed decoders through callables
+    (the reconcile/snapshot drivers' ``recv_over`` surface).  May
+    return MORE than the requested hint (every call site feeds a
+    decoder, which takes any chunking); EOF is ``b""``, transport
+    errors raise ``OSError`` — the ``os.read`` contract."""
+    if effective_pump_route() != "native":
+        return lambda n: os.read(fd, n)
+    # reusable slab: unlike recv_pump's zero-copy handoff, this surface
+    # returns an owned bytes per call (the os.read contract), so the
+    # buffer can be recycled.  The index arrays are 1-element on
+    # purpose: this caller feeds a decoder through write() (the index
+    # would be thrown away), and a full index array would make the
+    # native call frame-scan every slab for nothing — capacity overflow
+    # stops the scan after one frame
+    buf = np.empty(cap, dtype=np.uint8)
+    starts = np.zeros(1, dtype=np.int64)
+    lens = np.zeros(1, dtype=np.int64)
+    ids = np.zeros(1, dtype=np.uint8)
+    stats = np.zeros(2, dtype=np.int64)
+
+    def read_bytes(_hint: int) -> bytes:
+        t0 = _perf()
+        r = native.pump_recv_scan(fd, buf, PUMP_SLICE, starts,
+                                  lens, ids, stats)
+        if r is None:
+            return os.read(fd, _hint)
+        nbytes = r[0]
+        if _OBS.on:
+            _H_NATIVE.observe(_perf() - t0)
+        if nbytes < 0:
+            raise OSError(-nbytes, os.strerror(-nbytes))
+        if nbytes == 0:
+            return b""
+        if _OBS.on:
+            _note_batch(nbytes, stats)
+        return buf[:nbytes].tobytes()
+
+    return read_bytes
+
+
+def pump_writer(fd: int) -> Callable[[bytes], None]:
+    """A ``write_bytes`` drop-in pushing through the native gather loop
+    (blocking; partial writes resumed natively) — the send-side twin of
+    :func:`pump_reader`."""
+    if effective_pump_route() != "native":
+        return lambda data: _write_all(fd, data)
+    addrs = np.zeros(1, dtype=np.int64)
+    lens = np.zeros(1, dtype=np.int64)
+    stats = np.zeros(2, dtype=np.int64)
+
+    def write_bytes(data) -> None:
+        if not len(data):
+            return
+        arr = np.frombuffer(data, dtype=np.uint8)
+        addrs[0] = arr.__array_interface__["data"][0]
+        lens[0] = len(arr)
+        t0 = _perf()
+        w = native.pump_send_spans(fd, addrs, lens, 1, stats)
+        if w is None:
+            _write_all(fd, data)
+            return
+        if _OBS.on:
+            _H_NATIVE.observe(_perf() - t0)
+        if w < 0:
+            raise OSError(-w, os.strerror(-w))
+        if _OBS.on:
+            _note_batch(int(w), stats)
+
+    return write_bytes
+
+
+def io_for_socket(conn) -> tuple:
+    """``(read_bytes, write_bytes)`` for a connected socket through the
+    pump selector: the batched native reader/writer when routed (the
+    reconcile/snapshot drivers' transports upgrade with zero new
+    flags), the plain socket calls otherwise."""
+    if effective_pump_route() != "native":
+        return conn.recv, conn.sendall
+    return pump_reader(conn.fileno()), pump_writer(conn.fileno())
+
+
+class SpanGather:
+    """Reusable (address, length) span arrays for the fan-out gather
+    path: one instance per dispatcher, refilled per serve turn —
+    payload bytes never become Python objects, only their addresses
+    do."""
+
+    __slots__ = ("addrs", "lens", "stats", "_arrs")
+
+    def __init__(self, cap: int = 1024):
+        self.addrs = np.zeros(cap, dtype=np.int64)
+        self.lens = np.zeros(cap, dtype=np.int64)
+        self.stats = np.zeros(2, dtype=np.int64)
+        self._arrs: list = []  # keeps span buffers pinned across a call
+
+    def fill(self, views) -> int:
+        """Load ``views`` (memoryviews/bytes) as spans; returns the
+        count.  The numpy wraps are zero-copy — addresses point into
+        the callers' buffers, which this object pins until the next
+        :meth:`fill`."""
+        n = len(views)
+        if n > len(self.addrs):
+            self.addrs = np.zeros(n, dtype=np.int64)
+            self.lens = np.zeros(n, dtype=np.int64)
+        arrs = []
+        for i, v in enumerate(views):
+            a = np.frombuffer(v, dtype=np.uint8)
+            arrs.append(a)
+            self.addrs[i] = a.__array_interface__["data"][0]
+            self.lens[i] = len(a)
+        self._arrs = arrs
+        return n
+
+    def release(self) -> None:
+        self._arrs = []
+
+
+def send_spans_nb(fd: int, gather: SpanGather, n: int) -> int:
+    """Push ``n`` loaded spans to non-blocking ``fd`` through one
+    native gather batch (sendmmsg/writev until EAGAIN).  Returns bytes
+    accepted (0 = would-block); raises ``OSError`` on a dead transport
+    — exactly the ``os.writev`` contract the fan-out dispatcher's
+    bookkeeping is written against."""
+    t0 = _perf()
+    w = native.pump_send_spans(fd, gather.addrs, gather.lens, n,
+                               gather.stats, nonblocking=True)
+    if w is None:
+        raise OSError(38, "native pump unavailable")  # ENOSYS
+    if _OBS.on:
+        _H_NATIVE.observe(_perf() - t0)
+    if w < 0:
+        raise OSError(-w, os.strerror(-w))
+    if _OBS.on and w:
+        _note_batch(w, gather.stats)
+        _M_GATHER_BYTES.inc(w)
+    return w

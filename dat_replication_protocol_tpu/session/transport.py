@@ -31,6 +31,15 @@ external serializer.  :func:`session_over_socketpair` wires two ends of
 an in-process socketpair for tests and examples; the conformance suite
 also runs the encoder in a *separate process* over a pipe
 (tests/test_transport.py), crossing a real process boundary.
+
+**These loops are the PORTABLE REFERENCE pumps** (ISSUE 14): the
+batched-syscall native twins live in :mod:`.pump` behind the
+``DAT_PUMP`` route selector — byte-identical deliveries, digests,
+checkpoints, and structured errors on every chaos seed
+(tests/test_pump_parity.py), an order less interpreter work per wire
+byte.  Callers with raw fds should go through the selector; callers
+with only callables (custom transports, fault injectors) use these
+directly and lose nothing but batching.
 """
 
 from __future__ import annotations
@@ -186,6 +195,17 @@ def once(close_fn: Callable[[], None]) -> Callable[[], None]:
     return _once
 
 
+def write_all(fd: int, data) -> None:
+    """Blocking write loop: every byte of ``data`` reaches ``fd`` or the
+    OSError propagates — the ONE owner of this shape (the sidecar's
+    stdio writer and the pump module's Python-route fallback both bind
+    it; independent copies would drift on the next partial-write
+    lesson)."""
+    view = memoryview(data)
+    while view:
+        view = view[os.write(fd, view):]
+
+
 def send_over_fd(encoder: Encoder, fd: int,
                  chunk_size: int = DEFAULT_CHUNK,
                  close: Callable[[], None] | None = None,
@@ -195,15 +215,10 @@ def send_over_fd(encoder: Encoder, fd: int,
     is returned either way, so error-path cleanup can safely invoke it
     again — the old ``close=lambda: os.close(fd)`` double-closed when the
     caller also closed the fd after a pump error)."""
-    def write_all(data: bytes) -> None:
-        view = memoryview(data)
-        while view:
-            n = os.write(fd, view)
-            view = view[n:]
-
     if close is None:
         close = once(lambda: os.close(fd))
-    send_over(encoder, write_all, close=close, chunk_size=chunk_size)
+    send_over(encoder, lambda data: write_all(fd, data), close=close,
+              chunk_size=chunk_size)
     return close
 
 

@@ -49,7 +49,11 @@ from .obs.events import emit as _emit
 from .obs.metrics import OBS as _OBS, counter as _counter
 from .obs.tracing import trace_span as _trace_span
 from .obs.watermarks import WATERMARKS as _WATERMARKS
+from .session import pump as session_pump
 from .session.transport import recv_over, send_over
+# one owner for the blocking write-all loop (session/transport.py; the
+# pump module's Python fallback binds the same function)
+from .session.transport import write_all as _write_all
 
 DIGEST_SUBSET_CHANGE = "digest:change"
 DIGEST_SUBSET_BLOB = "digest:blob"
@@ -90,13 +94,25 @@ def set_active_fanout(server) -> None:
 
 def run_session(read_bytes, write_bytes, close_write=None,
                 drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT,
-                hub=None, session_key: str | None = None) -> dict:
+                hub=None, session_key: str | None = None,
+                rx_fd: int | None = None, tx_fd: int | None = None,
+                publish=None) -> dict:
     """Serve one wire session over a blocking byte pair.
 
     ``read_bytes(n)`` / ``write_bytes(data)`` follow the
     :mod:`..session.transport` contract (block on congestion, ``b''``
     at EOF).  Returns counters for observability:
     ``{"changes": n, "blobs": n, "bytes": n, "digests": n, "ok": bool}``.
+
+    ``rx_fd`` / ``tx_fd`` (ISSUE 14): the raw descriptors behind the
+    byte pair, when the caller has them.  With the native pump routed
+    (``DAT_PUMP``, :func:`~..session.pump.effective_pump_route`) the
+    session's byte loops run through the C extension's batched-syscall
+    pumps instead of ``read_bytes``/``write_bytes`` — byte-identical
+    deliveries, digests, and errors, an order less interpreter work.
+    Callable-only callers (tests, custom transports) get the Python
+    pumps unchanged.  ``publish`` observes every received chunk on
+    EITHER route (the fan-out source's broadcast tap).
 
     ``drain_timeout`` bounds every reply-stall wait: when the reply
     stream makes no write progress for that many seconds — whether the
@@ -229,18 +245,39 @@ def run_session(read_bytes, write_bytes, close_write=None,
     dec.on_error(lambda _e: enc.destroy())
     enc.on_error(lambda _e: None if dec.destroyed else dec.destroy())
 
+    # pump route selection (ISSUE 14): fds + a native route take the
+    # batched-syscall loops; anything else is the Python reference pump
+    native_route = ((rx_fd is not None or tx_fd is not None)
+                    and session_pump.effective_pump_route() == "native")
+
     def _write(data) -> None:
         write_bytes(data)
         progress["t"] = time.monotonic()  # reply byte reached the client
 
+    def _mark_progress() -> None:
+        progress["t"] = time.monotonic()  # reply batch reached the client
+
     def _send() -> None:
         try:
-            send_over(enc, _write, close_write)
+            if native_route and tx_fd is not None:
+                session_pump.send_pump(enc, tx_fd, close=close_write,
+                                       on_progress=_mark_progress)
+            else:
+                send_over(enc, _write, close_write)
         except Exception as e:  # EPIPE/ECONNRESET from a vanished client
             if not enc.destroyed:
                 enc.destroy(e)
             if not dec.destroyed:
                 dec.destroy(e)
+
+    if publish is not None and not (native_route and rx_fd is not None):
+        # the Python route's broadcast tap: wrap the reader so the
+        # published stream is byte-identical to the native pump's tap
+        def read_bytes(n, _r=read_bytes):
+            data = _r(n)
+            if data:
+                publish(data)
+            return data
 
     sender = threading.Thread(target=_send, name="sidecar-send",
                               daemon=True)
@@ -249,7 +286,10 @@ def run_session(read_bytes, write_bytes, close_write=None,
         # span brackets the request-consumption phase; the per-frame
         # wire-offset instants the decoder records nest under it
         with _trace_span("sidecar.session.recv"):
-            recv_over(dec, read_bytes)
+            if native_route and rx_fd is not None:
+                session_pump.recv_pump(dec, rx_fd, tap=publish)
+            else:
+                recv_over(dec, read_bytes)
     except Exception as e:  # ECONNRESET etc.: transport died mid-read —
         # or, in hub mode, SessionShed/HubError surfacing from the
         # decoder's digest submits: session-fatal either way, and the
@@ -528,8 +568,9 @@ class SnapshotListener:
 
             def _one(conn=conn, peer=peer, n=n):
                 try:
+                    rd, wr = session_pump.io_for_socket(conn)
                     stats = run_snapshot_session(
-                        conn.recv, conn.sendall,
+                        rd, wr,
                         lambda: conn.shutdown(socket.SHUT_WR),
                         self.source, peer=f"{peer[0]}:{peer[1]}")
                     print(f"sidecar: snapshot {peer} {stats}",
@@ -576,15 +617,12 @@ def serve_stdio(drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT) -> dict:
         write_bytes=lambda d: _write_all(1, d),
         close_write=_close_stdout,
         drain_timeout=drain_timeout,
+        rx_fd=0, tx_fd=1,
     )
     print(f"sidecar: stdio session {stats}", file=sys.stderr, flush=True)
     return stats
 
 
-def _write_all(fd: int, data: bytes) -> None:
-    view = memoryview(data)
-    while view:
-        view = view[os.write(fd, view):]
 
 
 def serve_tcp(host: str, port: int,
@@ -675,8 +713,9 @@ def serve_tcp(host: str, port: int,
                         # this — there the snapshot protocol lives on
                         # its own SnapshotListener port and this loop
                         # keeps serving the broadcast.
+                        rd, wr = session_pump.io_for_socket(conn)
                         stats = run_snapshot_session(
-                            conn.recv, conn.sendall,
+                            rd, wr,
                             lambda: conn.shutdown(socket.SHUT_WR),
                             snapshot_source,
                             peer=f"{peer[0]}:{peer[1]}")
@@ -688,8 +727,9 @@ def serve_tcp(host: str, port: int,
                         # is one reconcile initiator against the shared
                         # replica (read-only state: sessions never step
                         # on each other)
+                        rd, wr = session_pump.io_for_socket(conn)
                         stats = run_reconcile_session(
-                            conn.recv, conn.sendall,
+                            rd, wr,
                             lambda: conn.shutdown(socket.SHUT_WR),
                             reconcile_replica,
                             peer=f"{peer[0]}:{peer[1]}")
@@ -708,23 +748,20 @@ def serve_tcp(host: str, port: int,
                     elif fanout is not None:
                         # the source session: every wire byte it sends
                         # is published into the broadcast log as it is
-                        # consumed; EOF (or teardown) seals the log so
+                        # consumed (the pump's tap on either route);
+                        # EOF (or teardown) seals the log so
                         # subscribers complete
-                        def _read_published(nbytes: int) -> bytes:
-                            data = conn.recv(nbytes)
-                            if data:
-                                fanout.publish(data)
-                            return data
-
                         try:
                             stats = run_session(
-                                read_bytes=_read_published,
+                                read_bytes=conn.recv,
                                 write_bytes=conn.sendall,
                                 close_write=lambda: conn.shutdown(
                                     socket.SHUT_WR),
                                 drain_timeout=drain_timeout,
                                 hub=hub,
                                 session_key=f"c{n}:{peer[0]}:{peer[1]}",
+                                rx_fd=conn.fileno(), tx_fd=conn.fileno(),
+                                publish=fanout.publish,
                             )
                         finally:
                             if fanout.log.end > fanout.log.start:
@@ -743,6 +780,7 @@ def serve_tcp(host: str, port: int,
                             drain_timeout=drain_timeout,
                             hub=hub,
                             session_key=f"c{n}:{peer[0]}:{peer[1]}",
+                            rx_fd=conn.fileno(), tx_fd=conn.fileno(),
                         )
                     print(f"sidecar: {peer} {stats}", file=sys.stderr,
                           flush=True)
@@ -875,6 +913,9 @@ def snapshot_stats() -> dict:
         # the fleet plane's join input (ISSUE 11): per-link wire
         # cursors + append marks — the SAME dict /snapshot serves
         "watermarks": _WATERMARKS.snapshot(),
+        # the active wire-pump route + syscall tier (ISSUE 14): which
+        # byte mover this daemon's sessions actually ride
+        "pump": session_pump.probe_caps(),
     }
     if _ACTIVE_HUB is not None:
         out["hub"] = _ACTIVE_HUB.snapshot()

@@ -296,3 +296,33 @@ def test_snapshot_bootstrap_registered_in_host_group():
     # config 12 needs no device: it must be in BENCHES and NOT in the
     # device leg (the TPU watch script drives the device side)
     assert bench.BENCHES["12"][0] == "snapshot_bootstrap"
+
+
+# ---------------------------------------------------------------------------
+# config 13 (ISSUE 14): the wire pump A/B's acceptance criteria run
+# LIVE at reduced size — the tier-1 budget-gated face of the bench
+# ---------------------------------------------------------------------------
+
+
+def test_wire_pump_live_gate(monkeypatch):
+    """Both pump routes complete the e2e digest session byte-for-byte
+    (the A/B is only meaningful if both sides finish), the native
+    route reports its probe, and the hub arm's aggregate exists for
+    every requested session count."""
+    monkeypatch.setenv("BENCH_PUMP_MIB", "8")
+    monkeypatch.setenv("BENCH_PUMP_SESSIONS", "1,2")
+    monkeypatch.setenv("BENCH_PUMP_REPS", "1")
+    res = bench.bench_wire_pump(quick=True, backend="host")
+    assert res["metric"] == "wire_pump_e2e_throughput"
+    assert res["value"] > 0 and res["python_pump_gib_s"] > 0
+    assert res["e2e_host_gib_s"] == res["value"]
+    assert set(res["hub_agg_gib_s"]) == {"1", "2"}
+    assert all(v > 0 for v in res["hub_agg_gib_s"].values())
+    assert res["probe"]["route"] in ("native", "python")
+    assert res["reduced_config"] is True
+
+
+def test_wire_pump_registered_in_host_group():
+    # config 13 needs no device: it must be in BENCHES and NOT in the
+    # device leg (the TPU watch script drives the device side)
+    assert bench.BENCHES["13"][0] == "wire_pump"

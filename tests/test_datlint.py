@@ -1104,3 +1104,37 @@ def test_wire_parity_weighted_cap_python_python_drift(tmp_path):
     findings = _lint(tmp_path, ("a.py", "RATELESS_W_CAP = 8\n"),
                      ("b.py", "RATELESS_W_CAP = 9\n"))
     assert _rules_fired(findings) == {"wire-constant-parity"}
+
+
+# Wire-pump scanner constants (ISSUE 14): the native pump shares
+# dat_split_frames itself (one scanner — no framing fork by
+# construction), but its receive entry restates the header-capacity
+# floor as a `// wire:` marker (a slab smaller than one maximal header
+# could never make progress at a frame boundary).  The pump-parity
+# fixture: a scanner fork is a route fork — a pump-side framing
+# constant drifting from wire/framing.py must be a finding, so the
+# Python reference pump cannot drift silently behind the native one.
+PUMP_PY = '''
+MAX_VARINT_LEN = 10
+MAX_HEADER_LEN = MAX_VARINT_LEN + 1
+'''
+
+PUMP_C_GOOD = '''
+// the pump's minimum slab capacity:  // wire: MAX_HEADER_LEN = 11
+if (cap < 11 || slice < 1) return DAT_ERR_CAPACITY;
+'''
+
+
+def test_wire_parity_covers_pump_scanner_constant(tmp_path):
+    bad = PUMP_C_GOOD.replace("MAX_HEADER_LEN = 11",
+                              "MAX_HEADER_LEN = 12")
+    findings = _lint(tmp_path, ("framing.py", PUMP_PY),
+                     ("native.cpp", bad))
+    drift = [f for f in findings if f.rule == "wire-constant-parity"]
+    assert {m.split("wire constant ")[1].split(" ")[0] for m in
+            (f.message for f in drift)} == {"MAX_HEADER_LEN"}
+
+
+def test_wire_parity_pump_scanner_clean_when_agreeing(tmp_path):
+    assert _lint(tmp_path, ("framing.py", PUMP_PY),
+                 ("native.cpp", PUMP_C_GOOD)) == []

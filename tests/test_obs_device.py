@@ -468,7 +468,8 @@ def test_perf_check_host_only_on_live_quick_bench(tmp_path, monkeypatch):
     import sys
 
     env = dict(os.environ)
-    env.update(BENCH_CONFIGS="1,2,6,7,8,9,10,11,12", BENCH_ROUNDTRIPS="50",
+    env.update(BENCH_CONFIGS="1,2,6,7,8,9,10,11,12,13",
+               BENCH_ROUNDTRIPS="50",
                BENCH_DECODE_ROWS="4000", BENCH_REPLAY_ROWS="4000",
                BENCH_RESUME_ROWS="300", BENCH_RESUME_REPS="3",
                BENCH_WIRE_BATCH_ROWS="12288", BENCH_FUSED_MIB="64",
@@ -477,7 +478,9 @@ def test_perf_check_host_only_on_live_quick_bench(tmp_path, monkeypatch):
                BENCH_FANOUT_BLOB_KIB="128", BENCH_FANOUT_PEERS="1,8",
                BENCH_FANOUT_STALL_S="0.3", BENCH_RECONCILE_N="6000",
                BENCH_RECONCILE_KS="10,100", BENCH_SNAPSHOT_MIB="4",
-               BENCH_SNAPSHOT_JOINERS="4", BENCH_DEADLINE="300")
+               BENCH_SNAPSHOT_JOINERS="4", BENCH_PUMP_MIB="16",
+               BENCH_PUMP_SESSIONS="1,4", BENCH_PUMP_REPS="2",
+               BENCH_DEADLINE="300")
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--quick",
          "--metrics"],

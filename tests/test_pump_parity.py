@@ -1,0 +1,269 @@
+"""Chaos parity for the kernel-bypass wire pump (ISSUE 14).
+
+The contract that makes DAT_PUMP a ROUTE and not a fork: for the same
+wire byte stream — including streams a FaultPlan has already mangled —
+the native batched-syscall pump and the Python reference pump produce
+BYTE-IDENTICAL sessions: deliveries (changes, blob contents), digest
+streams, checkpoints, and structured errors (same frame index, same
+wire offset, same message).  20-seed sweep in tier 1, 100-seed soak in
+the slow tier, plus a re-segmentation fuzz that forces batch frames to
+straddle pump-batch boundaries.
+
+Faults are materialized ONCE per seed (the FaultyReader applied to the
+source wire, segmentation preserved) and the identical segment
+sequence is then fed to both routes over a real socketpair — so any
+divergence is the pump's, not the fault injector's clock.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import socket
+import threading
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.session import pump
+from dat_replication_protocol_tpu.session.faults import (
+    FaultPlan,
+    FaultyReader,
+    TransportFault,
+)
+from dat_replication_protocol_tpu.wire.framing import CAP_CHANGE_BATCH
+
+SWEEP_SEEDS = 20
+SOAK_SEEDS = 100
+
+
+def _build_wire(seed: int) -> bytes:
+    """A mixed session wire: bulk per-record changes, columnar batch
+    frames on odd seeds (negotiated), a couple of blobs."""
+    caps = CAP_CHANGE_BATCH if seed % 2 else 0
+    e = protocol.encode(peer_caps=caps) if caps else protocol.encode()
+    rows = 400 + (seed * 37) % 300
+    e.change_many([
+        {"key": f"k{seed}-{j:05d}", "change": j, "from": j, "to": j + 1,
+         "value": bytes([j % 251]) * (j % 90)}
+        for j in range(rows)
+    ])
+    b = e.blob(30_000 + seed * 13)
+    b.write(bytes(30_000 + seed * 13))
+    b.end()
+    e.change({"key": f"tail-{seed}", "change": 1, "from": 0, "to": 1})
+    e.finalize()
+    parts = []
+    while True:
+        d = e.read(1 << 20)
+        if d is None:
+            break
+        parts.append(d)
+    return b"".join(parts)
+
+
+def _materialize_faulted(wire: bytes, plan: FaultPlan):
+    """Run the fault injector over ``wire`` once and keep the exact
+    segment sequence it delivered (plus whether the stream died on a
+    TransportFault instead of clean EOF).
+
+    Timing faults (stall/latency) are zeroed first: a kernel stream
+    erases segment boundaries anyway, so parity is about CONTENT — the
+    sleeps would only slow the sweep (tier-1 runtime budget)."""
+    plan.stall_s = 0.0
+    plan.latency_prob = 0.0
+    src = io.BytesIO(wire)
+    fr = FaultyReader(lambda n: src.read(n), plan)
+    segments = []
+    dropped = False
+    while True:
+        try:
+            d = fr.read(65536)
+        except TransportFault:
+            dropped = True
+            break
+        if not d:
+            break
+        segments.append(d)
+    # coalesce for the feeder: send() boundaries are invisible to the
+    # receiving pump (stream semantics), and one-byte sendalls at
+    # max_segment=1 would pay ~wire_len syscalls per route
+    whole = b"".join(segments)
+    return [whole[i:i + (256 << 10)]
+            for i in range(0, len(whole), 256 << 10)], dropped
+
+
+def _run_route(route: str, segments, monkeypatch_env) -> dict:
+    """One digest session over a socketpair on ``route``; returns the
+    full observable surface for comparison."""
+    monkeypatch_env.setenv("DAT_PUMP", route)
+    a, b = socket.socketpair()
+    try:
+        dec = protocol.decode(backend="tpu")
+        out = {"changes": [], "blobs": [], "digests": [], "errors": []}
+        dec.change(lambda c, done: (out["changes"].append(
+            (c.key, c.change, c.from_, c.to, c.value, c.subset)), done()))
+        dec.blob(lambda blob, done: blob.collect(
+            lambda data: (out["blobs"].append(data), done())))
+        dec.on_digest(lambda kind, seq, dig:
+                      out["digests"].append((kind, seq, dig)))
+        dec.on_error(lambda err: out["errors"].append(err))
+
+        def feed() -> None:
+            try:
+                for seg in segments:
+                    a.sendall(seg)
+            except OSError:
+                pass  # decoder destroyed mid-stream: receiver closed
+            try:
+                a.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        try:
+            pump.recv_pump(dec, b.fileno())
+        except OSError:
+            pass  # transport died under the pump: the destroy cascade
+        b.close()  # unblock a feeder parked on a full socket
+        t.join(30)
+        ck = dec.checkpoint(emit_event=False)
+        out["final"] = (dec.finished, dec.destroyed, dec.bytes,
+                        dec.changes, dec.blobs)
+        out["checkpoint"] = (ck.wire_offset, ck.frame, ck.row,
+                             ck.blob_offset)
+        out["errors"] = [
+            (type(err).__name__, getattr(err, "frame", None),
+             getattr(err, "offset", None), str(err))
+            for err in out["errors"]
+        ]
+        return out
+    finally:
+        a.close()
+        b.close()
+
+
+def _assert_routes_identical(seed: int, segments, monkeypatch) -> None:
+    py = _run_route("python", segments, monkeypatch)
+    nat = _run_route("native", segments, monkeypatch)
+    for field in ("changes", "blobs", "digests", "errors", "final",
+                  "checkpoint"):
+        assert py[field] == nat[field], (
+            f"seed {seed}: pump routes diverge on {field}: "
+            f"python={py[field]!r:.300} native={nat[field]!r:.300}")
+
+
+def _sweep(seed: int, monkeypatch) -> None:
+    wire = _build_wire(seed)
+    plan = FaultPlan.for_sweep(seed, len(wire))
+    segments, _dropped = _materialize_faulted(wire, plan)
+    _assert_routes_identical(seed, segments, monkeypatch)
+
+
+@pytest.mark.parametrize("seed", range(SWEEP_SEEDS))
+def test_pump_parity_under_faults(seed, monkeypatch):
+    _sweep(seed, monkeypatch)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(SWEEP_SEEDS, SOAK_SEEDS))
+def test_pump_parity_soak(seed, monkeypatch):
+    _sweep(seed, monkeypatch)
+
+
+def test_pump_parity_flip_is_one_structured_error(monkeypatch):
+    """A flipped byte must fail STRUCTURED — one ProtocolError with the
+    same (frame, offset) coordinates on both routes, never a hang and
+    never divergent content."""
+    wire = _build_wire(3)
+    plan = FaultPlan(seed=9, flip_at=len(wire) // 3, flip_mask=0x40,
+                     max_segment=1024)
+    segments, _ = _materialize_faulted(wire, plan)
+    py = _run_route("python", segments, monkeypatch)
+    nat = _run_route("native", segments, monkeypatch)
+    assert py["errors"] == nat["errors"]
+    # content before the corrupt frame still delivered identically
+    assert py["changes"] == nat["changes"]
+    assert py["digests"] == nat["digests"]
+
+
+def test_pump_parity_truncation_checkpoint(monkeypatch):
+    """A truncated stream ends both routes at the same checkpoint (the
+    resume point a reconnect would pay back to) with the same
+    mid-frame error."""
+    wire = _build_wire(5)
+    plan = FaultPlan(seed=2, truncate_at=(len(wire) * 2) // 3)
+    segments, _ = _materialize_faulted(wire, plan)
+    _assert_routes_identical(5, segments, monkeypatch)
+
+
+def test_pump_parity_resume_exactly_once(monkeypatch):
+    """Truncate mid-blob, then resume from the checkpoint through the
+    NATIVE pump: the reassembled session is byte-identical to an
+    unfaulted Python-pump run — every change and blob byte delivered
+    exactly once across the reconnect."""
+    wire = _build_wire(7)
+    clean = _run_route("python", [wire], monkeypatch)
+    assert clean["final"][0] and not clean["final"][1]
+
+    monkeypatch.setenv("DAT_PUMP", "native")
+    cut = (len(wire) * 3) // 5
+    dec = protocol.decode(backend="tpu")
+    out = {"changes": [], "blobs": [], "digests": []}
+    dec.change(lambda c, done: (out["changes"].append(
+        (c.key, c.change, c.from_, c.to, c.value, c.subset)), done()))
+    dec.blob(lambda blob, done: blob.collect(
+        lambda data: (out["blobs"].append(data), done())))
+    dec.on_digest(lambda kind, seq, dig:
+                  out["digests"].append((kind, seq, dig)))
+
+    def feed_conn(payload: bytes) -> None:
+        a, b = socket.socketpair()
+        try:
+            t = threading.Thread(
+                target=lambda: (a.sendall(payload),
+                                a.shutdown(socket.SHUT_WR)),
+                daemon=True)
+            t.start()
+            # a reconnecting transport: EOF here is connection loss,
+            # not session end — the driver (not the pump) owns end()
+            rd = pump.pump_reader(b.fileno())
+            while True:
+                d = rd(65536)
+                if not d:
+                    break
+                dec.write(d)
+            t.join(10)
+        finally:
+            a.close()
+            b.close()
+
+    feed_conn(wire[:cut])
+    ck = dec.checkpoint(emit_event=False)
+    assert 0 < ck.wire_offset <= cut
+    # the sender replays from the checkpoint (the journal contract)
+    feed_conn(wire[ck.wire_offset:])
+    dec.end()
+    assert dec.finished and not dec.destroyed
+    assert out["changes"] == clean["changes"]
+    assert out["blobs"] == clean["blobs"]
+    assert out["digests"] == clean["digests"]
+
+
+def test_pump_parity_resegmented_batch_frames(monkeypatch):
+    """Re-segmentation fuzz across columnar batch frames: split the
+    same wire at adversarial boundaries (1-byte tail, mid-header,
+    mid-column) and require identical sessions from both routes."""
+    import random
+
+    wire = _build_wire(9)  # odd seed: columnar ChangeBatch frames
+    for trial in range(6):
+        rng = random.Random(trial)
+        segments = []
+        i = 0
+        while i < len(wire):
+            step = rng.choice([1, 2, 3, 17, 1024, 65536, 1 << 20])
+            segments.append(wire[i:i + step])
+            i += step
+        _assert_routes_identical(900 + trial, segments, monkeypatch)
