@@ -37,6 +37,9 @@ The headline metric is config 3 (the 50 GiB/s north-star target);
                   over a real socket, native batched-syscall pump vs
                   the Python reference, plus hub aggregate vs session
                   count 1/4/16 (the GIL-flatness probe; ISSUE 14)
+  14 gossip_converge  N-replica epidemic anti-entropy: rounds/seconds
+                  to byte-identical replicas and total wire bytes vs
+                  divergence size at N in {4, 16, 64} (ISSUE 15)
 
 Robustness (round-1 failure was a backend-init crash that cost the round
 its only perf artifact): device-backend init is retried with backoff and
@@ -55,7 +58,8 @@ BENCH_HUB_MESH (config 9), BENCH_FANOUT_ROWS / BENCH_FANOUT_BLOB_KIB /
 BENCH_FANOUT_PEERS / BENCH_FANOUT_STALL_S (config 10),
 BENCH_SNAPSHOT_MIB / BENCH_SNAPSHOT_JOINERS / BENCH_SNAPSHOT_STALE
 (config 12), BENCH_PUMP_MIB / BENCH_PUMP_REPS / BENCH_PUMP_SESSIONS
-(config 13).
+(config 13), BENCH_GOSSIP_N / BENCH_GOSSIP_RECORDS /
+BENCH_GOSSIP_DIVERGENCE (config 14).
 """
 
 from __future__ import annotations
@@ -2463,6 +2467,72 @@ def bench_wire_pump(quick: bool, backend: str) -> dict:
     }
 
 
+# config 14: N-replica gossip convergence — the epidemic anti-entropy
+# mesh (ISSUE 15, ROADMAP item 4): rounds/time to byte-identical
+# replicas and total wire bytes vs the divergence actually moved, at
+# N in {4, 16, 64}
+
+
+def bench_gossip_converge(quick: bool, backend: str) -> dict:
+    import time as _time
+
+    from dat_replication_protocol_tpu.cluster import ClusterSim
+
+    ns_env = os.environ.get("BENCH_GOSSIP_N")
+    ns = [int(x) for x in ns_env.split(",")] if ns_env else (
+        [4, 8] if quick else [4, 16, 64])
+    records = int(os.environ.get("BENCH_GOSSIP_RECORDS",
+                                 "32" if quick else "192"))
+    divergence = int(os.environ.get("BENCH_GOSSIP_DIVERGENCE",
+                                    "8" if quick else "24"))
+    res: dict = {}
+    for n in ns:
+        # clean links: this config measures the protocol's cost, not
+        # its robustness (the chaos sweep in tests/ owns that); the
+        # fixed seed pins sampling so rounds are reproducible
+        sim = ClusterSim(n, seed=20_240, chaos=False,
+                         records_per=records, divergence=divergence)
+        t0 = _time.perf_counter()
+        out = sim.run()
+        dt = _time.perf_counter() - t0
+        if not out["converged"]:
+            return {"error": f"gossip mesh n={n} did not converge "
+                             f"within {out['bound']} rounds"}
+        # wire_x: total gossip wire over the divergence bytes that HAD
+        # to move — the O(diff) headline at mesh scale (1.0 would be a
+        # perfect oracle; rateless symbols + record framing ride on top)
+        wire_x = (sim.wire_bytes / sim.divergence_bytes
+                  if sim.divergence_bytes else 0.0)
+        res[n] = {"rounds": out["rounds"], "seconds": round(dt, 3),
+                  "wire_bytes": sim.wire_bytes,
+                  "divergence_bytes": sim.divergence_bytes,
+                  "wire_x": round(wire_x, 3)}
+        log(f"bench[gossip_converge]: n={n} rounds={out['rounds']} "
+            f"{dt:.2f}s wire={sim.wire_bytes} "
+            f"(divergence {sim.divergence_bytes}, x{wire_x:.2f})")
+    top = max(ns)
+    return {
+        "metric": "gossip_converge_seconds",
+        # the headline: wall seconds for the LARGEST mesh to reach
+        # byte-identical replicas from full divergence
+        "value": res[top]["seconds"],
+        "unit": "s",
+        "vs_baseline": None,
+        "ns": ns,
+        "records_per": records,
+        "divergence_per": divergence,
+        "rounds_top": res[top]["rounds"],
+        "wire_x_top": res[top]["wire_x"],
+        **{f"rounds_{n}": res[n]["rounds"] for n in ns},
+        **{f"seconds_{n}": res[n]["seconds"] for n in ns},
+        **{f"wire_bytes_{n}": res[n]["wire_bytes"] for n in ns},
+        **{f"wire_x_{n}": res[n]["wire_x"] for n in ns},
+        "reduced_config": top < 64 or records < 192 or divergence < 24,
+        "full_config": "N in {4,16,64}, 192 base + 24 unique records "
+                       "per replica, clean links, fixed seed",
+    }
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -2480,6 +2550,7 @@ BENCHES = {
     "11": ("reconcile_rateless", bench_reconcile_rateless),
     "12": ("snapshot_bootstrap", bench_snapshot_bootstrap),
     "13": ("wire_pump", bench_wire_pump),
+    "14": ("gossip_converge", bench_gossip_converge),
 }
 
 
@@ -2709,7 +2780,8 @@ def main() -> None:
     # (config 8's opt-in device leg initializes jax itself — it is for
     # the TPU watch script, which only fires when the tunnel answers)
     for key in which:
-        if key in ("1", "2", "6", "7", "8", "9", "10", "11", "12", "13"):
+        if key in ("1", "2", "6", "7", "8", "9", "10", "11", "12", "13",
+                   "14"):
             run_config(key, "host")
 
     # priority order for the device leg: the headline hash config first,
@@ -2719,7 +2791,7 @@ def main() -> None:
     device_keys = sorted(
         (k for k in which
          if k not in ("1", "2", "6", "7", "8", "9", "10", "11", "12",
-                      "13")),
+                      "13", "14")),
         key=lambda k: priority.get(k, 9)
     )
     if device_keys:

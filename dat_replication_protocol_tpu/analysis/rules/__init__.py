@@ -16,6 +16,7 @@ from .fanout_hot_path import FanoutHotPath
 from .hub_isolation import HubIsolation
 from .jit_purity import JitPurity
 from .obs_discipline import ObsDiscipline
+from .structured_errors import StructuredErrorParity
 from .unbounded_join import UnboundedJoin
 from .wire_constants import WireConstantParity
 from .wire_dispatch import WireDispatchParity
@@ -31,6 +32,7 @@ ALL_RULES = (
     ObsDiscipline(),
     HubIsolation(),
     FanoutHotPath(),
+    StructuredErrorParity(),
     # whole-program concurrency pass (analysis/concurrency/): these
     # three share one ProgramIndex per run — keep them adjacent so the
     # --stats attribution reads sensibly (the first of them pays the

@@ -1,0 +1,42 @@
+"""N-replica epidemic anti-entropy: the gossip mesh (ISSUE 15,
+ROADMAP item 4).
+
+``cluster`` composes the pairwise and one-to-many pieces the stack
+already proves — PR 10 rateless reconciliation, PR 9 broadcast
+fan-out, PR 12 snapshot bootstrap, PR 2 chaos transport, PR 11 fleet
+watermarks — into a replica-set runtime with no distinguished source:
+
+* :class:`~.node.ReplicaNode` — one replica's state machine;
+* :func:`~.node.gossip_exchange` — one chaos-capable anti-entropy
+  exchange (exact diff, ONE structured error, or a clean transport
+  failure — never a wrong diff, never a partial apply);
+* :class:`~.sim.ClusterSim` — the in-process acceptance harness
+  (partitions that heal, churn, flash crowds, byzantine replicas);
+* :class:`~.live.GossipDriver` — the sidecar ``--replica`` timer loop
+  dialing real peers over TCP.
+
+See ROBUSTNESS.md "Convergence contract" and DESIGN.md §10.
+"""
+
+from .live import GossipDriver, serve_responder_session
+from .node import (
+    ByzantineDivergence,
+    ByzantineReplicaNode,
+    PeerQuarantined,
+    ReplicaNode,
+    classify_error,
+    gossip_exchange,
+)
+from .sim import ClusterSim
+
+__all__ = [
+    "ByzantineDivergence",
+    "ByzantineReplicaNode",
+    "PeerQuarantined",
+    "ReplicaNode",
+    "ClusterSim",
+    "GossipDriver",
+    "classify_error",
+    "gossip_exchange",
+    "serve_responder_session",
+]

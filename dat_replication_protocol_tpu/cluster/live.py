@@ -1,0 +1,193 @@
+"""The live gossip leg: a :class:`~.node.ReplicaNode` dialing real
+peers over TCP (the sidecar ``--replica`` mode).
+
+One daemon sidecar serves inbound reconcile sessions against the
+node's CURRENT log (:func:`serve_responder_session`) while the
+:class:`GossipDriver` timer thread periodically — on a jittered
+:class:`~..session.reconnect.BackoffPolicy` schedule, so N replicas
+started together do not phase-lock their dials — samples a peer
+address, runs one PR 10 reconciliation as the initiator, and absorbs
+the records received.  Both directions mutate the same node under its
+lock; convergence needs no coordinator, only the timer.
+
+Failure taxonomy is the node's: connection errors are transport-class
+(the peer may be down or partitioned — retry later), structured
+protocol failures accrue suspicion, and ``byzantine_after``
+consecutive corrupt exchanges quarantine the address (gossip continues
+around it).  Counters ride the ``gossip.*`` registry names and the
+:meth:`GossipDriver.snapshot` record the sidecar's ``--stats-fd`` /
+``/snapshot`` lines carry — the fleet plane's rounds-behind input.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+from typing import Optional
+
+from ..obs.metrics import OBS as _OBS, counter as _counter
+from ..runtime.reconcile_driver import run_initiator, run_responder
+from ..session.reconnect import BackoffPolicy
+from ..wire.framing import ProtocolError
+from .node import ReplicaNode, classify_error
+
+__all__ = ["GossipDriver", "serve_responder_session"]
+
+_M_DIALS = _counter("gossip.dials")
+
+DEFAULT_INTERVAL = 1.0
+DIAL_TIMEOUT = 10.0
+
+
+def serve_responder_session(node: ReplicaNode, read_bytes, write_bytes,
+                            close_write=None) -> dict:
+    """Serve one inbound anti-entropy session against the node's
+    current replica state and absorb whatever the initiator shipped.
+    Returns the responder stats dict (``run_responder``'s, plus
+    ``applied``); raises the session's ONE structured ProtocolError on
+    a failed decode."""
+    stats = run_responder(node.replica, read_bytes, write_bytes,
+                          close_write=close_write)
+    applied = node.absorb(stats["received"]) if stats["received"] else 0
+    stats["applied"] = applied
+    if stats.get("records_sent"):
+        node.stats["repairs_sent"] += stats["records_sent"]
+    return stats
+
+
+class GossipDriver:
+    """See module docstring.  ``peers`` is a list of ``host:port``
+    strings (other ``--replica`` sidecars)."""
+
+    def __init__(self, node: ReplicaNode, peers, *,
+                 interval: float = DEFAULT_INTERVAL,
+                 policy: Optional[BackoffPolicy] = None,
+                 seed: Optional[int] = None,
+                 dial_timeout: float = DIAL_TIMEOUT):
+        self.node = node
+        self.peers = [p for p in peers if p]
+        if not self.peers:
+            raise ValueError("gossip needs at least one peer address")
+        self.interval = interval
+        # the jittered round timer IS a BackoffPolicy: attempt 1 with
+        # base=interval sleeps uniform(0, 2*interval) — mean one
+        # interval, never phase-locked; consecutive all-transport
+        # rounds escalate the attempt, so a fully-partitioned replica
+        # backs off instead of hammering dead links
+        self._policy = policy if policy is not None else BackoffPolicy(
+            base=interval, cap=interval * 8, max_retries=1 << 30,
+            seed=seed)
+        self._dial_timeout = dial_timeout
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._failed_streak = 0
+        self.peer_stats = {p: {"ok": 0, "transport": 0, "corrupt": 0}
+                           for p in self.peers}
+        self._thread = threading.Thread(
+            target=self._run, name=f"gossip-{node.key}", daemon=True)
+
+    def start(self) -> "GossipDriver":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    # -- one round -----------------------------------------------------------
+
+    def gossip_once(self) -> Optional[dict]:
+        """One dial + exchange (also callable synchronously from
+        tests).  Returns the initiator stats on success, None on a
+        transport-class failure (the peer keeps its suspicion
+        counters)."""
+        node = self.node
+        node.begin_round()
+        addr = node.sample_peer(self.peers)
+        if addr is None:
+            return None
+        host, _, port = addr.rpartition(":")
+        if _OBS.on:
+            _M_DIALS.inc()
+        try:
+            conn = socket.create_connection(
+                (host or "127.0.0.1", int(port)),
+                timeout=self._dial_timeout)
+        except OSError:
+            node.note_transport_failure(addr)
+            self.peer_stats[addr]["transport"] += 1
+            return None
+        try:
+            # kernel-level timeouts, NOT settimeout(): Python's timeout
+            # mode flips the fd to O_NONBLOCK, which the raw-fd pump
+            # route cannot ride — SO_RCVTIMEO/SO_SNDTIMEO keep the
+            # socket blocking and surface a wedged peer as EAGAIN on
+            # either route (classified transport, round abandoned)
+            # bounded by the SO_RCVTIMEO/SO_SNDTIMEO set immediately
+            # below — settimeout(T) would flip the fd to O_NONBLOCK,
+            # which the raw-fd pump route cannot ride
+            conn.settimeout(None)  # datlint: disable=unbounded-join
+            tv = struct.pack(
+                "ll", int(self._dial_timeout),
+                int((self._dial_timeout % 1.0) * 1_000_000))
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+            # through the pump selector, like every other sidecar
+            # session leg: DAT_PUMP=native upgrades the dial half of
+            # the exchange too (the PR 14 zero-new-flags contract)
+            from ..session.pump import io_for_socket
+
+            rd, wr = io_for_socket(conn)
+            stats = run_initiator(
+                node.replica, rd, wr,
+                close_write=lambda: conn.shutdown(socket.SHUT_WR))
+        except ProtocolError as e:
+            if classify_error(e) == "corruption":
+                self.peer_stats[addr]["corrupt"] += 1
+                node.note_corruption(addr, e)
+            else:
+                node.note_transport_failure(addr)
+                self.peer_stats[addr]["transport"] += 1
+            return None
+        except OSError:
+            node.note_transport_failure(addr)
+            self.peer_stats[addr]["transport"] += 1
+            return None
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        node.note_success(addr)
+        self.peer_stats[addr]["ok"] += 1
+        if stats["received"]:
+            node.absorb(stats["received"])
+        if stats.get("records_sent"):
+            node.stats["repairs_sent"] += stats["records_sent"]
+        return stats
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            # jittered wait FIRST: N replicas started together must
+            # not all dial at t=0
+            attempt = 1 + min(6, self._failed_streak)
+            self._stop.wait(self._policy.delay(attempt))
+            if self._stop.is_set():
+                return
+            try:
+                ok = self.gossip_once() is not None
+            except Exception:
+                ok = False  # a dying exchange never kills the timer
+            self._failed_streak = 0 if ok else self._failed_streak + 1
+
+    # -- telemetry -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The gossip record ``--stats-fd`` / ``/snapshot`` carry."""
+        out = self.node.snapshot()
+        out["interval"] = self.interval
+        out["peers"] = {addr: dict(st)
+                        for addr, st in self.peer_stats.items()}
+        return out

@@ -1,0 +1,853 @@
+"""One gossip replica: a columnar change log + rateless anti-entropy +
+an optional fan-out group behind ONE small state machine (ISSUE 15,
+ROADMAP item 4).
+
+Everything shipped before this module is pairwise or one-to-many; a
+:class:`ReplicaNode` composes those pieces into the N-replica epidemic
+shape — convergence from *any* divergence with no distinguished
+source:
+
+* the **log** is the PR 6 columnar change log (records are the set
+  elements; content identity is the canonical per-record digest the
+  digest pipeline already defines);
+* **anti-entropy** is PR 10 rateless reconciliation
+  (:func:`gossip_exchange` below runs the real codec payloads through
+  the PR 2 chaos transport, so flips/truncations/drops land at real
+  wire offsets);
+* the **fan-out leg** is a PR 9 :class:`~..fanout.log.BroadcastLog`:
+  applied repairs are published once and every group follower drains
+  them hash-once, with the retention budget and its
+  ``SnapshotNeeded`` → PR 12 snapshot-bootstrap arm intact;
+* the **steering signal** is the PR 11 fleet plane: gossip round /
+  repair / quarantine counters ride the registry and the sidecar
+  snapshot (``--replica``).
+
+"Simplicity Scales" is the design yardstick: one replica state machine
+(:data:`STATES`), the staged failure vocabulary preserved verbatim
+(transport faults retry, corruption is structured, repeated corruption
+quarantines), and convergence — byte-identical content digests — as
+the only invariant.
+
+Failure contract (ROBUSTNESS.md "Convergence contract"):
+
+* a transport-class failure (drop, truncation, a partitioned link)
+  changes NO replica state — the exchange simply did not happen;
+* a corruption-class failure surfaces as ONE structured
+  :class:`~..wire.framing.ProtocolError` per exchange — never a wrong
+  diff, never a partial apply;
+* a peer whose exchanges are corrupt ``byzantine_after`` consecutive
+  times is **quarantined** with a structured
+  :class:`ByzantineDivergence` (peer + arm + wire coordinates); gossip
+  continues around it — the mesh converges without the liar.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..fanout.log import BroadcastLog, SnapshotNeeded
+from ..obs.events import emit as _emit
+from ..obs.metrics import OBS as _OBS, counter as _counter
+from ..runtime import replay
+from ..runtime.reconcile_driver import (
+    DEFAULT_BATCH0,
+    DEFAULT_OVERHEAD_CAP,
+    RatelessReplica,
+    ResponderState,
+)
+from ..session.faults import FaultPlan, FaultyReader, TransportFault
+from ..wire import reconcile_codec as rc
+from ..wire.change_codec import Change
+from ..wire.framing import ProtocolError, frame_wire_len
+
+__all__ = [
+    "ByzantineDivergence",
+    "PeerQuarantined",
+    "ReplicaNode",
+    "ByzantineReplicaNode",
+    "gossip_exchange",
+    "classify_error",
+    "STATES",
+]
+
+# the one replica state machine ("Simplicity Scales"): a node is idle
+# between rounds, gossiping during an exchange, draining its group
+# feeds, bootstrapping over the snapshot protocol, or crashed (churn)
+STATES = ("idle", "gossip", "fanout", "bootstrap", "crashed")
+
+# default corrupt-exchange threshold before a peer is quarantined: one
+# corrupt exchange can be the WIRE (a flipped byte on a chaotic link);
+# a repeat offender is a liar, not a bad cable.  Suspicion is
+# CUMULATIVE per peer — a byzantine replica that lies only when its
+# content is requested (the wrong-chunk shape) cannot launder its
+# record by interleaving clean exchanges.  Deployments with genuinely
+# lossy long-lived links should raise this per their flip rate.
+DEFAULT_BYZANTINE_AFTER = 2
+
+_M_ROUNDS = _counter("gossip.rounds")
+_M_EXCHANGES = _counter("gossip.exchanges")
+_M_REPAIRS_IN = _counter("gossip.repairs.applied")
+_M_REPAIRS_OUT = _counter("gossip.repairs.sent")
+_M_QUARANTINES = _counter("gossip.quarantines")
+_M_TRANSPORT = _counter("gossip.transport.failures")
+_M_CORRUPT = _counter("gossip.corrupt.exchanges")
+_M_BOOTSTRAPS = _counter("gossip.bootstraps")
+
+_BAD_LABEL_CHARS = '{},="\n\r'
+
+
+def _check_key(value: str) -> str:
+    # replica keys ride label sets and JSON breakdowns, same boundary
+    # contract as hub/fanout/watermark keys
+    if not isinstance(value, str) or not value or any(
+            c in value for c in _BAD_LABEL_CHARS):
+        raise ValueError(
+            f"replica key {value!r} must be a non-empty string "
+            'containing none of {},=" or newlines')
+    return value
+
+
+class ByzantineDivergence(ProtocolError):
+    """A peer's wire provably diverged from its claims: coded symbols
+    that cannot have come from a real set, repair records whose content
+    does not hash to the digests they answer, or a fan-out ack that
+    regresses.  Structured like every error in this stack
+    (``frame``/``offset`` wire coordinates) plus the cluster fields:
+    ``peer`` names the quarantined replica, ``arm`` the detection arm
+    (``wrong-symbol`` / ``wrong-chunk-digest`` / ``ack-regression`` /
+    ``feed-corrupt``).  Raising this is the decode-consistency
+    contract: divergence is NEVER silent."""
+
+    def __init__(self, message: str, *, peer: str,
+                 arm: Optional[str] = None, frame: Optional[int] = None,
+                 offset: Optional[int] = None,
+                 cause: Optional[BaseException] = None):
+        super().__init__(message, frame=frame, offset=offset, cause=cause)
+        self.peer = peer
+        self.arm = arm
+
+
+class PeerQuarantined(ProtocolError):
+    """Refusal to gossip with a quarantined peer.  Carries the same
+    structured coordinates (``peer``, the refusing side's ``offset`` in
+    exchanges = its round counter) so a refused dialer can tell this
+    apart from a dead link."""
+
+    def __init__(self, message: str, *, peer: str,
+                 frame: Optional[int] = None,
+                 offset: Optional[int] = None):
+        super().__init__(message, frame=frame, offset=offset)
+        self.peer = peer
+
+
+def classify_error(err: BaseException) -> str:
+    """The exchange failure taxonomy: ``transport`` (retryable, no
+    state changed — drops, truncations, dead links) vs ``corruption``
+    (a structured protocol failure — suspicion accrues toward
+    quarantine)."""
+    if isinstance(err, TransportFault):
+        return "transport"
+    if isinstance(err, ProtocolError):
+        return "corruption"
+    return "corruption" if isinstance(err, ValueError) else "transport"
+
+
+def _content_digest(digests: np.ndarray) -> bytes:
+    """The replica content digest: BLAKE2b over the SORTED unique
+    canonical record digests — framing- and order-independent, so two
+    replicas holding the same record set hash byte-identically no
+    matter how their logs interleaved."""
+    if len(digests) == 0:
+        return hashlib.blake2b(b"", digest_size=32).digest()
+    view = np.ascontiguousarray(digests).view("<u8").reshape(len(digests), 4)
+    order = np.lexsort(tuple(view[:, i] for i in range(3, -1, -1)))
+    return hashlib.blake2b(
+        np.ascontiguousarray(digests[order]).tobytes(),
+        digest_size=32).digest()
+
+
+class _ChaosLink:
+    """One direction of a gossip link: payloads stream through the PR 2
+    fault state, so a plan's flip/truncate/drop coordinates land at
+    real accumulated wire offsets across the round's messages."""
+
+    __slots__ = ("_buf", "_reader", "_plan")
+
+    def __init__(self, plan: Optional[FaultPlan]):
+        self._plan = plan
+        self._buf = bytearray()
+        self._reader = None if plan is None else FaultyReader(
+            self._pull, plan)
+
+    def _pull(self, n: int) -> bytes:
+        take = bytes(self._buf[:max(1, n)])
+        del self._buf[:max(1, n)]
+        return take
+
+    @property
+    def offset(self) -> int:
+        return 0 if self._reader is None else self._reader.offset
+
+    def send(self, payload: bytes) -> bytes:
+        """Deliver ``payload`` through the link.  Raises
+        :class:`TransportFault` on a drop OR a truncation (a short
+        delivery is a dead connection at message granularity — the
+        session layer's clean-EOF-mid-stream).  Flips arrive as
+        corrupted bytes for the codec to refuse."""
+        if self._reader is None:
+            return payload
+        self._buf += payload
+        out = bytearray()
+        while len(out) < len(payload):
+            chunk = self._reader.read(len(payload) - len(out))
+            if not chunk:
+                raise TransportFault(
+                    f"gossip link truncated at byte {self._reader.offset}",
+                    offset=self._reader.offset)
+            out += chunk
+        return bytes(out)
+
+
+class ReplicaNode:
+    """See module docstring.  Thread-safe: the live sidecar drives one
+    node from a gossip timer thread AND inbound responder sessions;
+    the sim drives it single-threaded."""
+
+    def __init__(self, key: str, records=(), *, seed: int = 0,
+                 engine: str = "auto",
+                 byzantine_after: int = DEFAULT_BYZANTINE_AFTER,
+                 fanout_retention: Optional[int] = None,
+                 delivered_form: bool = False):
+        self.key = _check_key(key)
+        # delivered_form (the LIVE-mesh mode, load_replica_node): the
+        # log is normalized to the per-record DELIVERED materialization
+        # (absent optionals collapsed to ''/b'', the reference's
+        # observed defaults) because that is the form every decoder
+        # delivery produces — a live replica whose set kept absent-form
+        # digests would re-reconcile those records against its peers
+        # forever (ship -> materialize -> re-encode changes identity).
+        # The in-process sim keeps the byte-exact wire form; the live
+        # drivers' faithful-absent shipping is the ROADMAP follow-on.
+        self.delivered_form = bool(delivered_form)
+        self._engine = engine
+        self._lock = threading.Lock()
+        # the log is WIRE BYTES, not row objects: repairs arrive as
+        # framed batch/record bytes and are absorbed verbatim, so
+        # absent-vs-present-empty optionals (and therefore canonical
+        # digests) survive byte-exactly — materializing rows would
+        # collapse absent to '' and silently fork the digest set
+        # datlint: guarded-by(self._lock): self._wire, self._replica, self._wire_ver
+        self._wire = bytearray(self._as_wire(records))
+        self._replica: Optional[RatelessReplica] = None
+        self._wire_ver = 0
+        self.state = "idle"
+        self.round = 0
+        self.byzantine_after = max(1, int(byzantine_after))
+        self.quarantined: dict[str, ByzantineDivergence] = {}
+        self._suspect: dict[str, int] = {}
+        self._rng = random.Random(seed)
+        self.stats = {
+            "rounds": 0, "sampled": 0, "exchanges_ok": 0,
+            "transport_failures": 0, "corrupt_exchanges": 0,
+            "refusals": 0, "repairs_applied": 0, "repairs_sent": 0,
+            "quarantines": 0, "bootstraps": 0, "wire_bytes": 0,
+        }
+        # the fan-out leg: applied repairs are published ONCE into this
+        # log; group followers drain it hash-free.  log_gen lets a
+        # follower detect a restarted owner (fresh log, fresh offsets)
+        # and re-attach at the new window instead of misreading
+        # mid-frame.
+        self.log: Optional[BroadcastLog] = (
+            BroadcastLog(retention_budget=fanout_retention)
+            if fanout_retention else None)
+        self.log_gen = 0
+        # follower-side feed cursors: owner key -> (owner log_gen, off)
+        self._feed_pos: dict[str, tuple] = {}
+        # owner-side follower acks: follower key -> offset (validated
+        # monotonic + <= log.end; a violation is the ack-regression arm)
+        self._follower_acks: dict[str, int] = {}
+
+    # -- log ------------------------------------------------------------------
+
+    def _as_wire(self, records) -> bytes:
+        """Records (Change objects / dicts) or already-framed wire
+        bytes, as wire bytes (normalized to the delivered
+        materialization in ``delivered_form`` mode)."""
+        if isinstance(records, (bytes, bytearray, memoryview)):
+            wire = bytes(records)
+            if not self.delivered_form or not wire:
+                return wire
+            cols, _ = replay.replay_log(np.frombuffer(wire, np.uint8))
+            records = [cols.row(i) for i in range(len(cols))]
+        records = [Change.from_dict(r) if isinstance(r, dict) else r
+                   for r in records]
+        if self.delivered_form:
+            records = [Change(key=r.key, change=r.change, from_=r.from_,
+                              to=r.to, value=r.value or b"",
+                              subset=r.subset or "") for r in records]
+        return replay.encode_change_log(records) if records else b""
+
+    @property
+    def replica(self) -> RatelessReplica:
+        """The node's reconciliation state, rebuilt lazily after the
+        log changed (RatelessReplica is immutable by design).  The
+        build runs OUTSIDE the node lock — it hashes the whole log and
+        can reach the one-time native-library load — with a version
+        guard: a log mutated mid-build just discards the stale build
+        (blocking-under-lock contract)."""
+        with self._lock:
+            rep = self._replica
+            if rep is not None:
+                return rep
+            wire = bytes(self._wire)
+            ver = self._wire_ver
+        rep = RatelessReplica(wire)
+        with self._lock:
+            if self._replica is None and self._wire_ver == ver:
+                self._replica = rep
+            return self._replica if self._replica is not None else rep
+
+    @property
+    def record_count(self) -> int:
+        """Distinct record states held (the log may carry duplicate
+        frames; identity is the canonical digest set)."""
+        return self.replica.n
+
+    def content_digest(self) -> bytes:
+        """Byte-identical across replicas holding the same record set —
+        the convergence invariant the sweep asserts."""
+        return _content_digest(self.replica.digests)
+
+    def canonical_wire(self) -> bytes:
+        """The log as framed wire bytes (the snapshot-bootstrap
+        dataset and the checkpoint payload)."""
+        with self._lock:
+            return bytes(self._wire)
+
+    def absorb(self, repairs, count: Optional[int] = None,
+               peer: Optional[str] = None) -> int:
+        """Append repair wire (or records) to the log verbatim
+        (duplicates are harmless — identity is the canonical digest
+        set).  Returns the record count absorbed (``count`` when the
+        caller already decoded it)."""
+        wire = self._as_wire(repairs)
+        if not wire:
+            return 0
+        with self._lock:
+            self._wire += wire
+            self._replica = None
+            self._wire_ver += 1
+        n = count if count is not None else len(
+            replay.replay_log(np.frombuffer(wire, np.uint8))[0])
+        self.stats["repairs_applied"] += n
+        if _OBS.on:
+            _M_REPAIRS_IN.inc(n)
+        return n
+
+    # -- byzantine hooks (overridden by ByzantineReplicaNode) ----------------
+
+    def coded_symbols_out(self, engine: Optional[str] = None):
+        return self.replica.coded_symbols(engine or self._engine)
+
+    def ship_wire(self, rows: np.ndarray) -> bytes:
+        """Rows as byte-preserving columnar batch frames (absent
+        optionals keep their sentinels, so canonical digests survive
+        the trip)."""
+        return replay.encode_batch_frames(
+            self.replica.columns_for_rows(rows))
+
+    def feed_ack_for(self, owner_key: str, offset: int) -> int:
+        return offset
+
+    def publish_wire(self, wire: bytes) -> bytes:
+        return wire
+
+    # -- sampling / quarantine ------------------------------------------------
+
+    def begin_round(self, rnd: Optional[int] = None) -> None:
+        """One jittered-timer tick: advance the round counter (the
+        fleet plane's rounds-behind input)."""
+        self.round = self.round + 1 if rnd is None else rnd
+        self.stats["rounds"] += 1
+        if _OBS.on:
+            _M_ROUNDS.inc()
+
+    def sample_peer(self, peers) -> Optional[str]:
+        """Pick this round's gossip partner: uniform over the known
+        peers minus self and the quarantined set."""
+        live = [p for p in peers
+                if p != self.key and p not in self.quarantined]
+        if not live:
+            return None
+        self.stats["sampled"] += 1
+        return self._rng.choice(live)
+
+    def is_quarantined(self, peer: str) -> bool:
+        return peer in self.quarantined
+
+    def refuse_if_quarantined(self, peer: str) -> None:
+        if peer in self.quarantined:
+            raise PeerQuarantined(
+                f"replica {self.key!r} refuses {peer!r}: quarantined "
+                f"({self.quarantined[peer].arm})",
+                peer=peer, offset=self.round)
+
+    def note_success(self, peer: str) -> None:
+        # deliberately does NOT clear suspicion: corruption suspicion
+        # is cumulative per peer (see DEFAULT_BYZANTINE_AFTER) — clean
+        # exchanges do not launder a liar's record
+        self.stats["exchanges_ok"] += 1
+        if _OBS.on:
+            _M_EXCHANGES.inc()
+
+    def note_transport_failure(self, peer: str) -> None:
+        self.stats["transport_failures"] += 1
+        if _OBS.on:
+            _M_TRANSPORT.inc()
+
+    def note_corruption(self, peer: str,
+                        err: BaseException) -> Optional[ByzantineDivergence]:
+        """Corruption-class failure with ``peer``: accrue suspicion;
+        at ``byzantine_after`` cumulative corrupt exchanges the peer
+        is quarantined and the structured divergence returned."""
+        self.stats["corrupt_exchanges"] += 1
+        if _OBS.on:
+            _M_CORRUPT.inc()
+        n = self._suspect.get(peer, 0) + 1
+        self._suspect[peer] = n
+        if n < self.byzantine_after or peer in self.quarantined:
+            return None
+        return self.quarantine(peer, err)
+
+    def quarantine(self, peer: str,
+                   err: BaseException) -> ByzantineDivergence:
+        """Quarantine ``peer`` with a structured divergence record;
+        gossip continues around it (sampling skips it, inbound
+        exchanges are refused with :class:`PeerQuarantined`)."""
+        if isinstance(err, ByzantineDivergence) and err.peer == peer:
+            div = err
+        else:
+            div = ByzantineDivergence(
+                f"replica {peer!r} quarantined by {self.key!r}: {err}",
+                peer=peer,
+                arm=getattr(err, "arm", None) or "wrong-symbol",
+                frame=getattr(err, "frame", None),
+                offset=getattr(err, "offset", None), cause=err)
+        self.quarantined[peer] = div
+        self._suspect.pop(peer, None)
+        self.stats["quarantines"] += 1
+        if _OBS.on:
+            _M_QUARANTINES.inc()
+            _emit("gossip.quarantine", replica=self.key, peer=peer,
+                  arm=div.arm or "?", offset=div.offset or 0)
+        return div
+
+    # -- fan-out leg ----------------------------------------------------------
+
+    def publish_repairs(self, wire: bytes) -> int:
+        """Publish applied repair WIRE into the broadcast log —
+        hash-once economics: the bytes that crossed the gossip link
+        are republished verbatim, every follower drains views of the
+        same bytes, nothing is re-encoded or re-hashed here."""
+        if self.log is None or not wire:
+            return 0
+        wire = self.publish_wire(bytes(wire))
+        self.log.append(wire)
+        return len(wire)
+
+    def note_follower_ack(self, follower: str, offset: int) -> None:
+        """Owner-side ack validation (the fan-out byzantine arm): an
+        ack that regresses or claims bytes never produced is a liar,
+        not flow control."""
+        if self.log is None:
+            return
+        last = self._follower_acks.get(follower, 0)
+        if offset < last or offset > self.log.end:
+            div = ByzantineDivergence(
+                f"byzantine ack from {follower!r}: offset {offset} "
+                f"outside [{last}, {self.log.end}]",
+                peer=follower, arm="ack-regression", offset=offset)
+            self.quarantine(follower, div)
+            raise div
+        self._follower_acks[follower] = offset
+
+    def drain_feed(self, owner: "ReplicaNode") -> int:
+        """Follower-side group drain: pull the owner's new broadcast
+        bytes, decode, absorb.  Raises :class:`SnapshotNeeded` when the
+        retention budget trimmed past this follower (the caller runs
+        the PR 12 bootstrap), :class:`ByzantineDivergence` on a feed
+        that does not parse."""
+        if owner.log is None or self.is_quarantined(owner.key):
+            return 0
+        self.state = "fanout"
+        try:
+            gen, off = self._feed_pos.get(owner.key, (owner.log_gen, 0))
+            if gen != owner.log_gen:
+                # the owner restarted: fresh log, fresh offsets — re-
+                # attach at the start of its retained window (a real
+                # subscriber would renegotiate its attach the same way)
+                gen, off = owner.log_gen, owner.log.start
+            data = owner.log.read_from(off)  # raises SnapshotNeeded
+            if not data:
+                self._feed_pos[owner.key] = (gen, off)
+                return 0
+            try:
+                cols, _ = replay.replay_log(
+                    np.frombuffer(data, np.uint8))
+            except (ValueError, ProtocolError) as e:
+                div = ByzantineDivergence(
+                    f"broadcast feed from {owner.key!r} does not parse "
+                    f"at byte {off}: {e}",
+                    peer=owner.key, arm="feed-corrupt", offset=off,
+                    cause=e)
+                self.quarantine(owner.key, div)
+                raise div from e
+            self.absorb(data, count=len(cols), peer=owner.key)
+            new_off = off + len(data)
+            self._feed_pos[owner.key] = (gen, new_off)
+            owner.note_follower_ack(
+                self.key, self.feed_ack_for(owner.key, new_off))
+            return len(cols)
+        finally:
+            self.state = "idle"
+
+    # -- bootstrap (PR 12) ----------------------------------------------------
+
+    def bootstrap_from(self, owner: "ReplicaNode") -> dict:
+        """Churn/flash-crowd recovery over the content-addressed
+        snapshot protocol: fetch the owner's dataset as verified chunks
+        (O(diff) for a stale log, the shared cold log for an empty
+        one), merge with everything this node already holds, and
+        re-attach the feed cursor at the owner's live window."""
+        from ..runtime.snapshot_driver import SnapshotSource, snapshot_local
+
+        self.state = "bootstrap"
+        try:
+            have = self.canonical_wire() or None
+            res = snapshot_local(SnapshotSource(owner.canonical_wire()),
+                                 have=have, engine=self._engine)
+            self.absorb(res["data"], peer=owner.key)
+            if owner.log is not None:
+                self._feed_pos[owner.key] = (owner.log_gen, owner.log.end)
+            self.stats["bootstraps"] += 1
+            self.stats["wire_bytes"] += res["wire_bytes"]
+            if _OBS.on:
+                _M_BOOTSTRAPS.inc()
+                _emit("gossip.bootstrap", replica=self.key,
+                      owner=owner.key, wire_bytes=res["wire_bytes"])
+            return res
+        finally:
+            self.state = "idle"
+
+    # -- churn ----------------------------------------------------------------
+
+    def checkpoint(self) -> dict:
+        """Restartable state: the log as wire bytes plus the cursors a
+        resumed node needs (round counter, feed positions, log
+        window)."""
+        with self._lock:
+            wire = bytes(self._wire)
+        return {
+            "key": self.key,
+            "round": self.round,
+            "wire": wire,
+            "feeds": dict(self._feed_pos),
+            "log_end": None if self.log is None else self.log.end,
+            "delivered_form": self.delivered_form,
+        }
+
+    @classmethod
+    def from_checkpoint(cls, ckpt: dict, **kw) -> "ReplicaNode":
+        """Churn restart: rebuild from :meth:`checkpoint`.  The
+        broadcast log restarts EMPTY on a fresh generation — followers
+        detect the generation change and re-attach; anything this node
+        published after the checkpoint re-spreads through normal
+        gossip."""
+        kw.setdefault("delivered_form", ckpt.get("delivered_form", False))
+        node = cls(ckpt["key"], ckpt["wire"], **kw)
+        node.round = ckpt["round"]
+        node._feed_pos = dict(ckpt["feeds"])
+        node.log_gen = 1  # a restart is a new feed generation
+        return node
+
+    def crash(self) -> None:
+        self.state = "crashed"
+
+    # -- telemetry ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The gossip record ``--stats-fd`` / ``/snapshot`` carry (the
+        fleet plane's per-replica convergence input)."""
+        return {
+            "replica": self.key,
+            "state": self.state,
+            "round": self.round,
+            "records": self.record_count,
+            "digest": self.content_digest().hex(),
+            "quarantined": sorted(self.quarantined),
+            **{k: v for k, v in self.stats.items()},
+        }
+
+
+class ByzantineReplicaNode(ReplicaNode):
+    """The adversary: a replica that lies on one arm of the protocol.
+    The injector side of the byzantine oracle — tests know exactly what
+    it corrupts, so every quarantine can be checked against ground
+    truth.  ``arm``:
+
+    * ``wrong-symbol`` — coded symbols XOR-corrupted after the build:
+      checksums cannot verify, the peel never completes, the responder
+      fails structurally at its symbol cap;
+    * ``wrong-chunk`` — repair records shipped with corrupted content:
+      the receiving side's digest verification refuses the whole
+      apply (``wrong-chunk-digest``);
+    * ``ack-regression`` — fan-out feed acks regress: the owner's ack
+      validation quarantines the follower;
+    * ``feed-corrupt`` — published broadcast wire is corrupted: the
+      follower's decode refuses the feed.
+    """
+
+    ARMS = ("wrong-symbol", "wrong-chunk", "ack-regression",
+            "feed-corrupt")
+
+    def __init__(self, key: str, records=(), *, arm: str = "wrong-symbol",
+                 **kw):
+        if arm not in self.ARMS:
+            raise ValueError(f"unknown byzantine arm {arm!r}")
+        super().__init__(key, records, **kw)
+        self.arm = arm
+        self._evil_rng = random.Random(0xBAD)
+        self._ack_memo: dict[str, int] = {}
+
+    def coded_symbols_out(self, engine: Optional[str] = None):
+        syms = super().coded_symbols_out(engine)
+        if self.arm != "wrong-symbol":
+            return syms
+        outer = self
+
+        class _Corrupt:
+            def extend(self, m: int) -> np.ndarray:
+                cells = np.array(syms.extend(m), copy=True)
+                if len(cells):
+                    # flip digest words in every cell: the 64-bit
+                    # checksums cannot verify, no pure cell ever peels
+                    cells[:, 3] ^= np.uint32(
+                        outer._evil_rng.randrange(1, 1 << 30))
+                return cells
+
+        return _Corrupt()
+
+    def ship_wire(self, rows: np.ndarray) -> bytes:
+        if self.arm != "wrong-chunk":
+            return super().ship_wire(rows)
+        # structurally valid records whose content no longer hashes to
+        # the digests they answer — the wrong-chunk-digest arm
+        out = []
+        for r in self.replica.records_for_rows(rows):
+            v = bytearray(r.value or b"\x00")
+            v[0] ^= 0xFF
+            out.append(Change(key=r.key, change=r.change, from_=r.from_,
+                              to=r.to, value=bytes(v), subset=r.subset))
+        return replay.encode_change_log(out)
+
+    def feed_ack_for(self, owner_key: str, offset: int) -> int:
+        if self.arm != "ack-regression":
+            return offset
+        prev = self._ack_memo.get(owner_key)
+        self._ack_memo[owner_key] = offset
+        if prev is None:
+            return offset  # first ack honest: establish a frontier...
+        # ...then regress behind it — provably byzantine, whatever the
+        # real drain position did
+        return max(0, prev - 1 - self._evil_rng.randrange(4))
+
+    def publish_wire(self, wire: bytes) -> bytes:
+        if self.arm != "feed-corrupt" or len(wire) < 2:
+            return wire
+        b = bytearray(wire)
+        b[0] ^= 0x80  # torn frame header: followers cannot parse
+        return bytes(b)
+
+
+# -- the exchange engine ------------------------------------------------------
+
+
+def gossip_exchange(initiator: ReplicaNode, responder: ReplicaNode, *,
+                    plan_out: Optional[FaultPlan] = None,
+                    plan_back: Optional[FaultPlan] = None,
+                    engine: str = "auto", batch0: int = DEFAULT_BATCH0,
+                    overhead_cap: float = DEFAULT_OVERHEAD_CAP) -> dict:
+    """One anti-entropy exchange between two nodes, message-metered
+    like :func:`~..runtime.reconcile_driver.reconcile_local` but with
+    every payload streamed through the chaos transport
+    (:class:`_ChaosLink` per direction).
+
+    On success both nodes have absorbed exactly the symmetric
+    difference and the stats dict reports wire/symbol/repair counts.
+    Failure is the taxonomy :func:`classify_error` names: transport
+    faults left both logs untouched; corruption raised ONE structured
+    ProtocolError (a :class:`ByzantineDivergence` when the responder's
+    verification caught provably-wrong content) — never a wrong diff,
+    never a partial apply."""
+    responder.refuse_if_quarantined(initiator.key)
+    initiator.refuse_if_quarantined(responder.key)
+    initiator.state = responder.state = "gossip"
+    try:
+        return _exchange(initiator, responder, plan_out, plan_back,
+                         engine, batch0, overhead_cap)
+    finally:
+        initiator.state = responder.state = "idle"
+
+
+def _exchange(initiator, responder, plan_out, plan_back, engine,
+              batch0, overhead_cap) -> dict:
+    rep_a = initiator.replica
+    rep_b = responder.replica
+    state = ResponderState(rep_b, engine=engine, overhead_cap=overhead_cap)
+    out_link = _ChaosLink(plan_out)
+    back_link = _ChaosLink(plan_back)
+    wire = {"a2b": 0, "b2a": 0}
+    msg_i = {"n": 0}
+
+    def corrupt(side: str, e: Exception) -> ProtocolError:
+        return ProtocolError(
+            f"corrupt gossip payload ({side}): {e}",
+            frame=msg_i["n"], offset=wire["a2b"] + wire["b2a"], cause=e)
+
+    def a2b(payload: bytes) -> list:
+        """One initiator->responder message; returns the decoded
+        replies that survived the back link."""
+        msg_i["n"] += 1
+        wire["a2b"] += frame_wire_len(len(payload))
+        got = out_link.send(payload)
+        try:
+            msg = rc.decode_reconcile(got)
+        except ValueError as e:
+            raise corrupt("initiator->responder", e) from e
+        replies = state.handle(msg)
+        out = []
+        for r in replies:
+            wire["b2a"] += frame_wire_len(len(r))
+            got_r = back_link.send(r)
+            try:
+                out.append(rc.decode_reconcile(got_r))
+            except ValueError as e:
+                raise corrupt("responder->initiator", e) from e
+        return out
+
+    syms = initiator.coded_symbols_out(engine)
+    replies = a2b(rc.encode_begin(rep_a.n))
+    sent = 0
+    rounds = 0
+    final = None
+    while final is None:
+        if replies and replies[-1].kind in (rc.RC_DONE, rc.RC_FAIL):
+            final = replies[-1]
+            break
+        m = batch0 if sent == 0 else sent * 2
+        cells = syms.extend(m)[sent:]
+        payload = rc.encode_symbols(sent, cells)
+        sent = m
+        rounds += 1
+        replies = a2b(payload)
+    if final.kind == rc.RC_FAIL:
+        state.result()  # raises the responder's structured error
+    # -- record exchange: both directions travel the chaos links, both
+    # are verified, and NOTHING is absorbed until every wire crossing
+    # succeeded — a transport fault mid-shipment leaves both logs
+    # exactly as they were (the no-partial-apply contract)
+    wants = final.digests
+    rows = rep_a.rows_for_digests(wants)
+    if (rows < 0).any():
+        raise ProtocolError(
+            "peer requested records this replica does not hold",
+            frame=msg_i["n"], offset=wire["a2b"] + wire["b2a"])
+    for_responder = for_initiator = None
+    n_for_b = n_for_a = 0
+    if len(rows):
+        batch = initiator.ship_wire(rows)
+        wire["a2b"] += len(batch)
+        got = out_link.send(batch)
+        n_for_b = _verify_repairs(got, wants, corrupt,
+                                  "initiator->responder",
+                                  initiator.key, msg_i["n"],
+                                  wire["a2b"] + wire["b2a"])
+        for_responder = got
+    b_rows = state.local_only_rows()
+    if len(b_rows):
+        batch = responder.ship_wire(b_rows)
+        wire["b2a"] += len(batch)
+        got = back_link.send(batch)
+        # structural validity only in this direction: the initiator
+        # has no digest expectation for the responder's local-only set
+        # (that is the protocol's information asymmetry) — content
+        # identity is re-derived from the bytes themselves
+        n_for_a = _decoded_rows(got, corrupt, "responder->initiator")
+        for_initiator = got
+    # -- commit point ---------------------------------------------------------
+    applied_b = applied_a = 0
+    if for_responder:
+        applied_b = responder.absorb(for_responder, count=n_for_b,
+                                     peer=initiator.key)
+        initiator.stats["repairs_sent"] += len(rows)
+        if _OBS.on:
+            _M_REPAIRS_OUT.inc(len(rows))
+    if for_initiator:
+        applied_a = initiator.absorb(for_initiator, count=n_for_a,
+                                     peer=responder.key)
+        responder.stats["repairs_sent"] += len(b_rows)
+        if _OBS.on:
+            _M_REPAIRS_OUT.inc(len(b_rows))
+    total = wire["a2b"] + wire["b2a"]
+    initiator.stats["wire_bytes"] += total
+    responder.stats["wire_bytes"] += total
+    return {
+        "ok": True,
+        "wire_bytes": total,
+        "symbols": sent,
+        "rounds": rounds,
+        "diff": int(len(wants) + len(b_rows)),
+        "applied_initiator": applied_a,
+        "applied_responder": applied_b,
+        "wire_initiator": for_initiator or b"",
+        "wire_responder": for_responder or b"",
+    }
+
+
+def _decoded_rows(data: bytes, corrupt, side: str) -> int:
+    """Structural validation of a repair batch: the row count, or the
+    exchange's ONE structured error."""
+    try:
+        cols, _ = replay.replay_log(np.frombuffer(data, np.uint8))
+        return len(cols)
+    except (ValueError, ProtocolError) as e:
+        raise corrupt(side, e) from e
+
+
+def _verify_repairs(data: bytes, wants: np.ndarray, corrupt, side: str,
+                    peer: str, frame: int, offset: int) -> int:
+    """The decode-consistency check at apply time: the records shipped
+    to answer a want list must hash EXACTLY to the wanted digest set —
+    wrong content, extra records, or missing records all refuse the
+    whole apply with a structured divergence (never a partial or
+    silently-wrong log).  Returns the row count for accounting."""
+    try:
+        got_rep = RatelessReplica(
+            np.frombuffer(data, np.uint8))
+    except (ValueError, ProtocolError) as e:
+        raise corrupt(side, e) from e
+    got = got_rep.digests
+    want = np.ascontiguousarray(wants)
+    if len(got) == len(want):
+        if {bytes(d) for d in got} == {bytes(d) for d in want}:
+            return len(got_rep.cols)
+    raise ByzantineDivergence(
+        f"repair records from {peer!r} do not hash to the requested "
+        f"digest set ({len(got)} distinct received, {len(want)} "
+        f"requested)", peer=peer, arm="wrong-chunk-digest", frame=frame,
+        offset=offset)

@@ -43,7 +43,18 @@ passes vacuously is NOT allowed, same contract as perf budgets):
     every target's ``/healthz`` (or snapshot-embedded health) must be
     ok;
 ``max_events_dropped``
-    per-target event-ring drop bound.
+    per-target event-ring drop bound;
+``gossip``
+    the replica-mesh convergence SLO (ISSUE 15) — an object with any
+    of ``require_converged`` (every target's gossip content digest
+    byte-identical: the mesh converged, not "close"),
+    ``max_rounds_behind`` (per-replica bound on gossip-round PROGRESS
+    behind the fleet frontier since this aggregator's first sight —
+    restart/stagger-proof, see ``_join_gossip``), and
+    ``max_quarantined`` (per-replica quarantine-count bound).
+    Evaluated over the ``gossip`` records ``--replica`` sidecars embed
+    in their snapshots; no targets reporting gossip is a loud failure,
+    same contract as an unjoined link.
 """
 
 from __future__ import annotations
@@ -73,8 +84,56 @@ DEFAULT_TIMEOUT = 5.0
 SLO_KEYS = frozenset({
     "max_lag_bytes", "max_lag_seconds", "require_converged",
     "max_shed", "max_rejected", "recompile_budget", "require_healthz",
-    "max_events_dropped",
+    "max_events_dropped", "gossip",
 })
+
+GOSSIP_SLO_KEYS = frozenset({
+    "require_converged", "max_rounds_behind", "max_quarantined",
+})
+
+
+def _join_gossip(snaps: dict, baselines: dict) -> dict:
+    """Per-target gossip records joined into the convergence view:
+    each ``--replica`` target's round/digest/quarantine state plus the
+    per-replica **rounds-behind** column.
+
+    Live round counters are LIFETIME values on unsynchronized
+    processes — a replica restarted an hour into the fleet's life
+    reports round ~5 against its peers' ~3600 while being fully
+    converged, so comparing absolute positions would breach forever on
+    any restart or staggered start.  Rounds-behind is therefore
+    *progress since this aggregator first saw the target*:
+    ``max over targets of (round − baseline) − own (round −
+    baseline)`` — zero across a healthy mesh whatever the absolute
+    counters, growing only for a replica whose gossip timer stops
+    advancing with the fleet.  A round counter that goes BACKWARD
+    (restart) re-baselines instead of reading as "behind".  The
+    ``baselines`` dict is the caller's per-view memory
+    (:class:`FleetView` owns one)."""
+    records = {tname: snap["gossip"] for tname, snap in snaps.items()
+               if isinstance((snap or {}).get("gossip"), dict)}
+    if not records:
+        return {}
+    deltas = {}
+    for tname, r in records.items():
+        rnd = int(r.get("round", 0))
+        base = baselines.setdefault(tname, rnd)
+        if rnd < base:
+            baselines[tname] = base = rnd
+        deltas[tname] = rnd - base
+    top = max(deltas.values())
+    out = {}
+    for tname, r in records.items():
+        out[tname] = {
+            "replica": r.get("replica"),
+            "round": int(r.get("round", 0)),
+            "rounds_behind": top - deltas[tname],
+            "records": r.get("records"),
+            "digest": r.get("digest"),
+            "quarantined": list(r.get("quarantined") or ()),
+            "state": r.get("state"),
+        }
+    return out
 
 
 class FleetTarget:
@@ -262,6 +321,9 @@ class FleetView:
                 t.name = f"{t.name}#{n + 1}"
         self._history: dict[str, deque] = {}
         self._hist_len = history
+        # per-target first-seen gossip round: the rounds-behind
+        # baseline (_join_gossip — live counters are lifetime values)
+        self._gossip_baseline: dict = {}
         self.polls = 0
 
     def poll(self, healthz: bool = False) -> dict:
@@ -296,6 +358,7 @@ class FleetView:
             } for name, snap in snaps.items()},
             "errors": errors,
             "links": links,
+            "gossip": _join_gossip(snaps, self._gossip_baseline),
             "shed": _counter_sum(snaps, ("hub.shed", "fanout.peer.shed")),
             "rejected": _counter_sum(snaps, ("hub.rejected",
                                              "fanout.rejected")),
@@ -373,6 +436,28 @@ def load_slo(path: str) -> dict:
     for key in ("require_converged", "require_healthz"):
         if key in slo and not isinstance(slo[key], bool):
             raise ValueError(f"SLO file {path}: {key} must be a boolean")
+    if "gossip" in slo:
+        g = slo["gossip"]
+        if not isinstance(g, dict):
+            raise ValueError(f"SLO file {path}: gossip must be an object")
+        unknown = set(g) - GOSSIP_SLO_KEYS
+        if unknown:
+            raise ValueError(
+                f"SLO file {path}: unknown gossip key(s) "
+                f"{sorted(unknown)} (known: {sorted(GOSSIP_SLO_KEYS)})")
+        if not g:
+            raise ValueError(
+                f"SLO file {path}: empty gossip object would pass "
+                "vacuously")
+        for key in ("max_rounds_behind", "max_quarantined"):
+            if key in g and not isinstance(g[key], (int, float)):
+                raise ValueError(
+                    f"SLO file {path}: gossip.{key} must be a number")
+        if "require_converged" in g \
+                and not isinstance(g["require_converged"], bool):
+            raise ValueError(
+                f"SLO file {path}: gossip.require_converged must be a "
+                "boolean")
     return slo
 
 
@@ -415,6 +500,32 @@ def evaluate_slo(slo: dict, sample: dict) -> list[dict]:
         if slo.get("require_converged"):
             row("require_converged", lname, lb == 0,
                 f"lag {lb} byte(s) (must be exactly 0)")
+    if "gossip" in slo:
+        g = slo["gossip"]
+        gossip = sample.get("gossip") or {}
+        if not gossip:
+            row("gossip", "-", False,
+                "no targets report gossip records: nothing to "
+                "evaluate convergence against")
+        if g.get("require_converged") and gossip:
+            digests = {r.get("digest") for r in gossip.values()}
+            ok = len(digests) == 1 and None not in digests
+            row("gossip.require_converged", "fleet", ok,
+                "all replica content digests byte-identical" if ok else
+                f"{len(digests)} distinct content digests across "
+                f"{len(gossip)} replicas")
+        for tname, r in sorted(gossip.items()):
+            if "max_rounds_behind" in g:
+                bound = g["max_rounds_behind"]
+                rb = r["rounds_behind"]
+                row("gossip.max_rounds_behind", tname, rb <= bound,
+                    f"{rb} round(s) behind the fleet frontier, "
+                    f"bound {bound}")
+            if "max_quarantined" in g:
+                bound = g["max_quarantined"]
+                nq = len(r["quarantined"])
+                row("gossip.max_quarantined", tname, nq <= bound,
+                    f"{nq} peer(s) quarantined, bound {bound}")
     if "max_shed" in slo:
         row("max_shed", "fleet", sample.get("shed", 0) <= slo["max_shed"],
             f"shed {sample.get('shed', 0)}, bound {slo['max_shed']}")
@@ -545,6 +656,20 @@ def render_dashboard(view: FleetView, sample: dict,
                 f"{_sparkline([b for _t, b, _s in ring])}")
     else:
         lines.append("  (no joined links yet)")
+    gossip = sample.get("gossip") or {}
+    if gossip:
+        # the per-replica convergence column (ISSUE 15): rounds-behind
+        # the fleet frontier + the content digest everyone must agree on
+        lines.append(bar)
+        lines.append(f"  {'replica':<20} {'round':>7} {'behind':>7} "
+                     f"{'records':>8} {'quar':>5}  digest")
+        for tname, r in sorted(gossip.items()):
+            lines.append(
+                f"  {str(r.get('replica') or tname)[:20]:<20} "
+                f"{r['round']:>7} {r['rounds_behind']:>7} "
+                f"{str(r.get('records', '-')):>8} "
+                f"{len(r['quarantined']):>5}  "
+                f"{(r.get('digest') or '?')[:16]}")
     lines.append(bar)
     rec = sample.get("reconcile") or {}
     lines.append(

@@ -108,6 +108,68 @@ class FaultPlan:
     # neighbors must not care either way), which the 1:1 resume sweep
     # deliberately excludes (flip is not resumable by design).
     SESSION_SCENARIOS = ("stall", "truncate", "flip")
+    # the cluster (gossip-mesh) link axis (ISSUE 15): what one sampled
+    # gossip link does to ONE exchange, on top of the scheduled
+    # partition.  "clean" is deliberately over-weighted — most links in
+    # a round behave — and every fault class the 1:1 and per-session
+    # axes know reappears here so the convergence contract is proven
+    # against the same chaos vocabulary.
+    LINK_SCENARIOS = ("clean", "clean", "clean", "reseg", "drop",
+                      "stall", "flip")
+
+    @classmethod
+    def partition_scenario(cls, seed: int, n_replicas: int) -> dict:
+        """Deterministic cluster-partition ground truth for
+        ``(seed, n_replicas)`` — the link-set cut the gossip sweep and
+        its oracle both key off (mirrors the PR 8 per-session axis:
+        the generator IS the ground truth, so tests never guess).
+
+        Returns ``{"groups": (frozenset, frozenset), "cut_round": c,
+        "heal_round": h}``: from gossip round ``c`` (inclusive) to
+        ``h`` (exclusive) every link crossing the two groups is dead
+        (an immediate drop); at ``h`` the cut heals and convergence
+        must complete within the sweep's bounded rounds.  The two
+        groups partition ``range(n_replicas)``; with fewer than two
+        replicas there is nothing to cut and the minority group is
+        empty.
+        """
+        rng = random.Random(seed * 2_654_435_761 + n_replicas)
+        cut = rng.randrange(1, 4)
+        heal = cut + rng.randrange(2, 6)
+        idx = list(range(n_replicas))
+        rng.shuffle(idx)
+        k = rng.randrange(1, n_replicas) if n_replicas > 1 else 0
+        return {
+            "groups": (frozenset(idx[:k]), frozenset(idx[k:])),
+            "cut_round": cut,
+            "heal_round": heal,
+        }
+
+    @classmethod
+    def partitioned(cls, seed: int, n_replicas: int,
+                    link: tuple[int, int], gossip_round: int) -> bool:
+        """Whether ``link`` (a replica-index pair) crosses the seeded
+        cut during ``gossip_round`` — the oracle-side view of the
+        partition axis."""
+        sc = cls.partition_scenario(seed, n_replicas)
+        if not sc["cut_round"] <= gossip_round < sc["heal_round"]:
+            return False
+        a, b = link
+        minority = sc["groups"][0]
+        return (a in minority) != (b in minority)
+
+    @classmethod
+    def link_scenario(cls, seed: int, n_replicas: int,
+                      link: tuple[int, int]) -> tuple[str, int]:
+        """The (scenario, fire_round) ground truth for one undirected
+        gossip link: which :data:`LINK_SCENARIOS` arm the link draws
+        and the single gossip round it fires in.  Deterministic, so
+        the chaos oracle can predict exactly which exchanges were
+        corrupted vs merely dropped."""
+        a, b = sorted(link)
+        rng = random.Random(
+            (seed * 5_851 + n_replicas) * 1_000_003 + a * 8_191 + b)
+        return rng.choice(cls.LINK_SCENARIOS), rng.randrange(1, 8)
 
     @classmethod
     def faulty_session(cls, seed: int, n_sessions: int) -> int:
@@ -118,7 +180,9 @@ class FaultPlan:
 
     @classmethod
     def for_sweep(cls, seed: int, wire_len: int, attempt: int = 0,
-                  session: int = 0, n_sessions: int = 1) -> "FaultPlan":
+                  session: int = 0, n_sessions: int = 1,
+                  link: Optional[tuple] = None, n_replicas: int = 1,
+                  gossip_round: int = 0) -> "FaultPlan":
         """The conformance-sweep scenario for ``(seed, attempt)``.
 
         Attempt 0 carries the seed's primary fault, attempt 1 has a 50%
@@ -138,7 +202,21 @@ class FaultPlan:
         contract against known ground truth.  The default
         ``(session=0, n_sessions=1)`` path is byte-identical to the
         pre-axis generator — existing sweeps reproduce unchanged.
+
+        **Partition/link axis** (ISSUE 15): with ``link=(a, b)`` and
+        ``n_replicas > 1`` this is the shared generator for a gossip
+        mesh's per-exchange plans.  A link crossing the seeded
+        partition cut (:meth:`partition_scenario`) during
+        ``gossip_round`` is dead — an immediate drop, healing at the
+        scenario's ``heal_round``; every other link draws its one
+        scenario from :data:`LINK_SCENARIOS` at a seeded round
+        (:meth:`link_scenario`) and is otherwise benign delivery
+        jitter.  The default ``(link=None, n_replicas=1)`` path is
+        byte-identical to the pre-axis generator (golden test).
         """
+        if link is not None and n_replicas > 1:
+            return cls._for_cluster_sweep(seed, wire_len, link,
+                                          n_replicas, gossip_round)
         if n_sessions > 1:
             return cls._for_session_sweep(seed, wire_len, attempt,
                                           session, n_sessions)
@@ -198,6 +276,48 @@ class FaultPlan:
         elif scenario == "flip":
             plan.flip_at = at
             plan.flip_mask = rng.choice([0x01, 0x40, 0x80])
+        return plan
+
+    @classmethod
+    def _for_cluster_sweep(cls, seed: int, wire_len: int,
+                           link: tuple, n_replicas: int,
+                           gossip_round: int) -> "FaultPlan":
+        # the link is ORDERED (sender -> receiver): the two directions
+        # of one exchange draw distinct jitter and fault coordinates,
+        # while the scheduled scenario and the partition cut are
+        # properties of the UNDIRECTED pair (sorted inside the
+        # scenario lookups) — one link, one story, two wires
+        a, b = link
+        rng = random.Random(
+            ((seed * 5_851 + n_replicas) * 1_000_003 + a * 8_191 + b)
+            * 131 + gossip_round)
+        span = max(1, wire_len)
+        # gossip exchanges are many and small: segments never drop to
+        # byte-at-a-time (that is the 1:1 sweep's job) and latency is
+        # token, so a 64-replica sweep stays inside the tier-1 budget
+        plan = cls(
+            seed=rng.randrange(1 << 30),
+            max_segment=rng.choice([64, 256, 1024, None]),
+            latency_prob=rng.choice([0.0, 0.0, 0.02]),
+            latency_s=0.0002,
+        )
+        if cls.partitioned(seed, n_replicas, (a, b), gossip_round):
+            plan.drop_at = 0  # the cut: the dial itself fails
+            return plan
+        scenario, fire_round = cls.link_scenario(seed, n_replicas, (a, b))
+        if gossip_round != fire_round or scenario == "clean":
+            return plan
+        at = rng.randrange(span)
+        if scenario == "drop":
+            plan.drop_at = at
+        elif scenario == "stall":
+            plan.stall_at = at
+            plan.stall_s = 0.01
+        elif scenario == "flip":
+            plan.flip_at = at
+            plan.flip_mask = rng.choice([0x01, 0x40, 0x80])
+        elif scenario == "reseg":
+            plan.max_segment = 64
         return plan
 
 

@@ -77,6 +77,18 @@ _ACTIVE_HUB = None
 # source session's wire to every subscriber connection
 _ACTIVE_FANOUT = None
 
+# replica mode (ISSUE 15): the gossip node (or its driver) whose
+# round/peer/quarantine counters --stats-fd and /snapshot carry — the
+# fleet plane's per-replica convergence input
+_ACTIVE_GOSSIP = None
+
+
+def set_active_gossip(driver) -> None:
+    """Install the gossip driver/node whose snapshot() record
+    ``--stats-fd`` snapshots carry (None detaches)."""
+    global _ACTIVE_GOSSIP
+    _ACTIVE_GOSSIP = driver
+
 
 def set_active_hub(hub) -> None:
     """Install the hub whose per-session breakdown ``--stats-fd``
@@ -484,6 +496,49 @@ def run_reconcile_session(conn_read, conn_write, close_write,
     return out
 
 
+def run_replica_session(conn_read, conn_write, close_write,
+                        node, peer: str = "?") -> dict:
+    """Serve one gossip responder session (ISSUE 15): like
+    ``--reconcile``, but against the LIVE :class:`~.cluster.ReplicaNode`
+    — records the initiator ships are absorbed into the node's log, so
+    every inbound session advances convergence instead of answering
+    from a frozen file."""
+    from .cluster import serve_responder_session
+    from .wire.framing import ProtocolError
+
+    try:
+        stats = serve_responder_session(node, conn_read, conn_write,
+                                        close_write=close_write)
+        out = {"replica": node.key, "ok": stats["ok"],
+               "symbols": stats["symbols"], "rounds": stats["rounds"],
+               "records_sent": stats["records_sent"],
+               "applied": stats["applied"]}
+    except (ProtocolError, OSError) as e:
+        out = {"replica": node.key, "ok": False, "peer": peer,
+               "error": f"{type(e).__name__}: {e}"}
+    if _OBS.on:
+        _M_SESSIONS.inc()
+        _emit("sidecar.session", **out)
+    return out
+
+
+def load_replica_node(path: str, key: str):
+    """Build the ``--replica`` gossip node from a change-log wire file
+    (same input contract as ``--reconcile``; an absent/empty file is a
+    cold replica that converges entirely from its peers)."""
+    from .cluster import ReplicaNode
+
+    wire = b""
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            wire = f.read()
+    # delivered_form: the live mesh's record identity is the per-record
+    # DELIVERED materialization (absent optionals as ''/b'') — the form
+    # every decoder delivery produces, so shipped records keep their
+    # digests and the mesh actually reaches diff 0 (see ReplicaNode)
+    return ReplicaNode(key, wire, delivered_form=True)
+
+
 def load_reconcile_replica(path: str):
     """Build the sidecar's replica from a change-log wire file
     (per-record and/or ChangeBatch frames — ``replay.replay_log``'s
@@ -630,7 +685,8 @@ def serve_tcp(host: str, port: int,
               ready_cb=None,
               drain_timeout: float | None = DEFAULT_DRAIN_TIMEOUT,
               retry_policy=None, hub=None, fanout=None,
-              reconcile_replica=None, snapshot_source=None) -> None:
+              reconcile_replica=None, snapshot_source=None,
+              replica_node=None) -> None:
     """Accept loop: one concurrent session per connection.
 
     ``max_sessions`` bounds the loop for tests; ``ready_cb(port)`` fires
@@ -718,6 +774,20 @@ def serve_tcp(host: str, port: int,
                             rd, wr,
                             lambda: conn.shutdown(socket.SHUT_WR),
                             snapshot_source,
+                            peer=f"{peer[0]}:{peer[1]}")
+                        print(f"sidecar: {peer} {stats}", file=sys.stderr,
+                              flush=True)
+                        return
+                    if replica_node is not None:
+                        # gossip replica mode (ISSUE 15): every
+                        # connection is one reconcile initiator against
+                        # the LIVE node — received records are absorbed,
+                        # so inbound sessions advance convergence
+                        rd, wr = session_pump.io_for_socket(conn)
+                        stats = run_replica_session(
+                            rd, wr,
+                            lambda: conn.shutdown(socket.SHUT_WR),
+                            replica_node,
                             peer=f"{peer[0]}:{peer[1]}")
                         print(f"sidecar: {peer} {stats}", file=sys.stderr,
                               flush=True)
@@ -923,6 +993,11 @@ def snapshot_stats() -> dict:
     if _ACTIVE_FANOUT is not None:
         out["fanout"] = _ACTIVE_FANOUT.snapshot()
         out["peers"] = _ACTIVE_FANOUT.peers_snapshot()
+    if _ACTIVE_GOSSIP is not None:
+        # replica mode (ISSUE 15): gossip round / repair / quarantine
+        # counters + the content digest — what `obs fleet` derives the
+        # per-replica rounds-behind convergence column from
+        out["gossip"] = _ACTIVE_GOSSIP.snapshot()
     # staged health rides every snapshot record, so file-based fleet
     # targets (tailing --stats-fd lines) can evaluate require_healthz
     # — not just endpoint targets with a /healthz route
@@ -1042,6 +1117,27 @@ def main(argv=None) -> int:
                         "exactly the differing records (O(diff) wire "
                         "bytes; see DESIGN.md anti-entropy, WIRE.md "
                         "Reconcile)")
+    p.add_argument("--replica", metavar="LOGFILE", default=None,
+                   help="gossip replica mode (ISSUE 15, --tcp only): "
+                        "serve every connection as a live anti-entropy "
+                        "responder whose received records are ABSORBED "
+                        "into the replica (unlike --reconcile's frozen "
+                        "file), and — with --gossip-peers — dial out on "
+                        "a jittered timer so N such sidecars converge "
+                        "from any divergence with no distinguished "
+                        "source (see DESIGN.md gossip, ROBUSTNESS.md "
+                        "convergence contract)")
+    p.add_argument("--replica-key", default="replica", metavar="KEY",
+                   help="this replica's name in gossip telemetry "
+                        "(default: replica)")
+    p.add_argument("--gossip-peers", default=None, metavar="HOST:PORT,...",
+                   help="comma list of peer --replica sidecars to "
+                        "gossip with (requires --replica)")
+    p.add_argument("--gossip-interval", type=float, default=1.0,
+                   metavar="SECONDS",
+                   help="mean seconds between gossip dials (jittered "
+                        "full-spread via BackoffPolicy; consecutive "
+                        "all-peer failures back off; default: 1)")
     p.add_argument("--snapshot", metavar="DATAFILE", default=None,
                    help="snapshot bootstrap mode (ISSUE 12): materialize "
                         "DATAFILE once as content-addressed CDC chunks "
@@ -1132,6 +1228,14 @@ def main(argv=None) -> int:
         p.error("--snapshot cannot combine with --hub/--reconcile "
                 "(it composes with --fanout, where it answers the "
                 "broadcast's snapshot-needed refusals)")
+    if args.replica and (args.hub or args.fanout or args.reconcile
+                         or args.snapshot):
+        p.error("--replica is its own session mode; it cannot combine "
+                "with --hub/--fanout/--reconcile/--snapshot")
+    if args.replica and args.stdio:
+        p.error("--replica gossips with many peers; it needs --tcp")
+    if args.gossip_peers and not args.replica:
+        p.error("--gossip-peers requires --replica")
     hub = None
     if args.hub:
         if args.stdio:
@@ -1163,6 +1267,20 @@ def main(argv=None) -> int:
             p.error("--reconcile is its own session mode; it cannot "
                     "combine with --hub/--fanout")
         replica = load_reconcile_replica(args.reconcile)
+    replica_node = None
+    gossip_driver = None
+    if args.replica:
+        replica_node = load_replica_node(args.replica, args.replica_key)
+        if args.gossip_peers:
+            from .cluster import GossipDriver
+
+            gossip_driver = GossipDriver(
+                replica_node,
+                [p_.strip() for p_ in args.gossip_peers.split(",")],
+                interval=args.gossip_interval).start()
+            set_active_gossip(gossip_driver)
+        else:
+            set_active_gossip(replica_node)
     snapshot_source = None
     if args.snapshot:
         snapshot_source = load_snapshot_source(
@@ -1230,9 +1348,14 @@ def main(argv=None) -> int:
         serve_tcp(host, int(port), drain_timeout=drain,
                   retry_policy=policy, hub=hub, fanout=fanout,
                   reconcile_replica=replica,
-                  snapshot_source=snapshot_source)
+                  snapshot_source=snapshot_source,
+                  replica_node=replica_node)
         return 0
     finally:
+        if gossip_driver is not None:
+            gossip_driver.close()
+        if replica_node is not None:
+            set_active_gossip(None)
         if snap_listener is not None:
             snap_listener.close()
         if obs_srv is not None:

@@ -1,0 +1,610 @@
+"""Unit layer for the gossip mesh (ISSUE 15): the partition/link fault
+axis, ReplicaNode semantics, the chaos-capable exchange engine, the
+byzantine quarantine arms, churn/bootstrap, and the fleet-plane gossip
+SLO.  The multi-seed chaos sweep lives in tests/test_cluster_faults.py.
+"""
+
+import dataclasses
+import io
+import json
+
+import numpy as np
+import pytest
+
+from dat_replication_protocol_tpu.cluster import (
+    ByzantineDivergence,
+    ByzantineReplicaNode,
+    ClusterSim,
+    PeerQuarantined,
+    ReplicaNode,
+    classify_error,
+    gossip_exchange,
+)
+from dat_replication_protocol_tpu.fanout.log import SnapshotNeeded
+from dat_replication_protocol_tpu.obs import fleet
+from dat_replication_protocol_tpu.session.faults import (
+    FaultPlan,
+    TransportFault,
+)
+from dat_replication_protocol_tpu.wire.framing import ProtocolError
+
+
+def recs(lo, hi, tag="s", val=b"v"):
+    return [{"key": f"k{i}", "change": i, "from": 0, "to": 1,
+             "value": val + b"%d" % i, "subset": tag}
+            for i in range(lo, hi)]
+
+
+# -- partition/link axis (satellite: FaultPlan.for_sweep) --------------------
+
+
+def test_for_sweep_default_path_golden_byte_identical():
+    """The pre-axis generator reproduces EXACTLY: these tuples were
+    captured from the generator before the partition axis landed —
+    existing 1:1 and per-session sweeps must replay unchanged."""
+    golden = {
+        (0, 1000, 0): (827307999, 64, None, None, None, 255, 988,
+                       0.02, 0.0, 0.001),
+        (1, 1000, 0): (687482608, 1024, None, 472, None, 255, None,
+                       0.0, 0.0, 0.001),
+        (2, 5000, 1): (1042467055, 1024, None, None, None, 255, 1043,
+                       0.02, 0.05, 0.001),
+        (7, 1234, 0): (324967622, None, None, 1193, None, 255, None,
+                       0.0, 0.0, 0.001),
+        (13, 64, 2): (845453773, 64, None, None, None, 255, None,
+                      0.0, 0.0, 0.001),
+        (5, 999, 1): (250431313, None, None, 551, None, 255, None,
+                      0.0, 0.0, 0.001),
+    }
+    for (seed, wl, att), want in golden.items():
+        got = dataclasses.astuple(FaultPlan.for_sweep(seed, wl, att))
+        assert got == want, (seed, wl, att)
+    # the per-session axis is untouched too
+    assert dataclasses.astuple(
+        FaultPlan.for_sweep(3, 2048, 0, session=2, n_sessions=4)) == \
+        (438892869, 7, None, None, None, 255, None, 0.0, 0.0, 0.0005)
+
+
+def test_partition_scenario_partitions_the_replica_range():
+    for seed in range(8):
+        for n in (2, 4, 16, 64):
+            sc = FaultPlan.partition_scenario(seed, n)
+            a, b = sc["groups"]
+            assert a | b == frozenset(range(n))
+            assert not (a & b)
+            assert a and b  # a real cut: both sides populated
+            assert 1 <= sc["cut_round"] < sc["heal_round"]
+            # deterministic: the generator IS the ground truth
+            assert sc == FaultPlan.partition_scenario(seed, n)
+
+
+def test_cluster_plans_cut_cross_group_links_and_heal():
+    seed, n = 9, 16
+    sc = FaultPlan.partition_scenario(seed, n)
+    minority = sc["groups"][0]
+    a = next(iter(minority))
+    b = next(iter(sc["groups"][1]))
+    during = FaultPlan.for_sweep(seed, 1000, link=(a, b), n_replicas=n,
+                                 gossip_round=sc["cut_round"])
+    assert during.drop_at == 0  # the dial itself fails
+    after = FaultPlan.for_sweep(seed, 1000, link=(a, b), n_replicas=n,
+                                gossip_round=sc["heal_round"])
+    assert after.drop_at != 0  # healed (any later fault is the link's
+    # own scheduled scenario, not the partition)
+    # intra-group links never see the cut
+    c, d = sorted(sc["groups"][1])[:2]
+    intra = FaultPlan.for_sweep(seed, 1000, link=(c, d), n_replicas=n,
+                                gossip_round=sc["cut_round"])
+    assert intra.drop_at is None or intra.drop_at > 0
+
+
+def test_link_scenario_deterministic_and_order_free():
+    s1 = FaultPlan.link_scenario(5, 8, (1, 3))
+    assert s1 == FaultPlan.link_scenario(5, 8, (3, 1))
+    assert s1[0] in FaultPlan.LINK_SCENARIOS
+    assert 1 <= s1[1] < 8
+
+
+# -- ReplicaNode --------------------------------------------------------------
+
+
+def test_content_digest_is_order_and_duplicate_free():
+    a = ReplicaNode("a", recs(0, 10))
+    b = ReplicaNode("b", list(reversed(recs(0, 10))))
+    assert a.content_digest() == b.content_digest()
+    # duplicate frames do not change identity
+    b.absorb(recs(3, 7))
+    assert a.content_digest() == b.content_digest()
+    assert b.record_count == 10
+
+
+def test_absent_optionals_survive_gossip_byte_exactly():
+    """Records WITHOUT subset/value must keep their canonical digests
+    through an exchange — repairs travel as byte-preserving wire, so
+    absent-vs-present-empty never forks the digest set (materializing
+    rows would collapse absent to '' and the mesh would re-reconcile
+    the same records forever)."""
+    bare = [{"key": f"n{i}", "change": i, "from": 0, "to": 1}
+            for i in range(6)]
+    a = ReplicaNode("a", bare + recs(0, 4))
+    b = ReplicaNode("b", recs(0, 4))
+    gossip_exchange(a, b)
+    assert a.content_digest() == b.content_digest()
+    # and a second exchange finds ZERO divergence (the digests agreed)
+    out = gossip_exchange(a, b)
+    assert out["diff"] == 0
+
+
+def test_checkpoint_restore_roundtrip():
+    a = ReplicaNode("a", recs(0, 12), fanout_retention=1 << 14)
+    a.round = 7
+    ckpt = a.checkpoint()
+    back = ReplicaNode.from_checkpoint(ckpt, fanout_retention=1 << 14)
+    assert back.key == "a"
+    assert back.round == 7
+    assert back.content_digest() == a.content_digest()
+    assert back.log_gen == 1  # a restart is a new feed generation
+
+
+def test_replica_key_validation():
+    with pytest.raises(ValueError):
+        ReplicaNode("bad{key}")
+    with pytest.raises(ValueError):
+        ReplicaNode("")
+
+
+# -- the exchange engine ------------------------------------------------------
+
+
+def test_exchange_converges_and_wire_tracks_diff():
+    big = recs(0, 400)
+    a = ReplicaNode("a", big + recs(1000, 1004, tag="u"))
+    b = ReplicaNode("b", big)
+    out = gossip_exchange(a, b)
+    assert a.content_digest() == b.content_digest()
+    # O(diff) headline: a 4-record diff over a 400-record set moves a
+    # small fraction of the full-transfer wire
+    full = len(a.canonical_wire())
+    assert out["wire_bytes"] < full
+    assert out["diff"] == 4
+
+
+def test_exchange_truncation_is_transport_class_and_stateless():
+    a = ReplicaNode("a", recs(0, 20))
+    b = ReplicaNode("b", recs(10, 30))
+    da, db = a.content_digest(), b.content_digest()
+    plan = FaultPlan(seed=1, truncate_at=40)
+    with pytest.raises(TransportFault):
+        gossip_exchange(a, b, plan_out=plan)
+    # no state change on either side — the no-partial-apply contract
+    assert a.content_digest() == da
+    assert b.content_digest() == db
+    assert classify_error(TransportFault("x")) == "transport"
+
+
+def test_exchange_flip_is_one_structured_error():
+    a = ReplicaNode("a", recs(0, 20))
+    b = ReplicaNode("b", recs(10, 30))
+    da, db = a.content_digest(), b.content_digest()
+    # flip inside the first symbols payload: the codec (or the peel
+    # checksums) must refuse — never a wrong diff
+    plan = FaultPlan(seed=2, flip_at=30, flip_mask=0x40)
+    with pytest.raises(ProtocolError) as ei:
+        gossip_exchange(a, b, plan_out=plan)
+    assert classify_error(ei.value) == "corruption"
+    assert a.content_digest() == da
+    assert b.content_digest() == db
+
+
+def test_quarantine_needs_repeated_corruption():
+    a = ReplicaNode("a", byzantine_after=2)
+    err = ProtocolError("corrupt", offset=3)
+    assert a.note_corruption("p", err) is None  # first: the wire
+    assert a.note_corruption("p", err) is not None  # second: a liar
+    assert a.is_quarantined("p")
+    with pytest.raises(PeerQuarantined) as ei:
+        a.refuse_if_quarantined("p")
+    assert ei.value.peer == "p"
+
+
+def test_suspicion_is_cumulative_not_laundered_by_success():
+    """A byzantine replica that lies only when its content is
+    requested (the wrong-chunk shape) interleaves clean exchanges with
+    corrupt ones — suspicion must accumulate anyway."""
+    a = ReplicaNode("a", byzantine_after=2)
+    err = ProtocolError("corrupt")
+    assert a.note_corruption("p", err) is None
+    a.note_success("p")  # a clean exchange in between launders nothing
+    assert a.note_corruption("p", err) is not None
+    assert a.is_quarantined("p")
+
+
+def test_sampling_skips_quarantined_peers():
+    a = ReplicaNode("a", byzantine_after=1)
+    a.note_corruption("bad", ProtocolError("corrupt"))
+    picks = {a.sample_peer(["a", "bad", "good"]) for _ in range(20)}
+    assert picks == {"good"}
+
+
+# -- byzantine arms (satellite: quarantine coverage) -------------------------
+
+
+def _byz_sim(arm, **kw):
+    return ClusterSim(4, seed=5, chaos=False, byzantine=1,
+                      byzantine_arm=arm, byzantine_after=1, **kw)
+
+
+def test_byzantine_wrong_symbol_one_error_quarantine_rest_converge():
+    sim = _byz_sim("wrong-symbol")
+    out = sim.run()
+    # injector ground truth: links are CLEAN, so every corrupt
+    # exchange involves the byzantine replica, and each such exchange
+    # surfaced exactly ONE structured error
+    corrupt = [ex for ev in sim.events for ex in ev["exchanges"]
+               if ex["outcome"] == "corruption"]
+    assert corrupt
+    for ex in corrupt:
+        assert "r1" in (ex["initiator"], ex["responder"])
+        assert ex["error"] is not None
+    assert any(q["peer"] == "r1" for q in out["quarantines"])
+    assert out["converged"]
+    healthy = {sim.nodes[k].content_digest() for k in sim.healthy()}
+    assert len(healthy) == 1
+
+
+def test_byzantine_wrong_chunk_digest_detected_at_apply():
+    sim = _byz_sim("wrong-chunk")
+    out = sim.run()
+    q = [q for q in out["quarantines"] if q["peer"] == "r1"]
+    assert q and all(x["arm"] == "wrong-chunk-digest" for x in q)
+    assert out["converged"]
+
+
+def test_byzantine_ack_regression_quarantined_by_owner():
+    owner = ReplicaNode("owner", recs(0, 8), fanout_retention=1 << 14)
+    byz = ByzantineReplicaNode("byz", (), arm="ack-regression")
+    owner.publish_repairs(owner.canonical_wire())
+    byz.drain_feed(owner)  # first ack: honest frontier
+    owner.publish_repairs(ReplicaNode("t", recs(8, 12)).canonical_wire())
+    with pytest.raises(ByzantineDivergence) as ei:
+        byz.drain_feed(owner)
+    assert ei.value.arm == "ack-regression"
+    assert ei.value.peer == "byz"
+    assert ei.value.offset is not None
+    assert owner.is_quarantined("byz")
+
+
+def test_byzantine_feed_corrupt_quarantined_by_follower():
+    owner = ByzantineReplicaNode("byz", recs(0, 8), arm="feed-corrupt",
+                                 fanout_retention=1 << 14)
+    follower = ReplicaNode("f", ())
+    d0 = follower.content_digest()
+    owner.publish_repairs(owner.canonical_wire())
+    with pytest.raises(ByzantineDivergence) as ei:
+        follower.drain_feed(owner)
+    assert ei.value.arm == "feed-corrupt"
+    assert ei.value.peer == "byz"
+    assert follower.is_quarantined("byz")
+    # nothing absorbed: corruption is never a partial apply
+    assert follower.content_digest() == d0
+
+
+def test_byzantine_divergence_is_structured():
+    e = ByzantineDivergence("msg", peer="p", arm="wrong-symbol",
+                            frame=3, offset=99)
+    assert e.peer == "p" and e.frame == 3 and e.offset == 99
+    assert "frame=3" in str(e) and "byte=99" in str(e)
+    assert isinstance(e, ProtocolError)
+
+
+# -- churn / flash crowd / bootstrap -----------------------------------------
+
+
+def test_churn_restart_resumes_from_checkpoint_and_converges():
+    sim = ClusterSim(8, seed=5, chaos=True, churn=True)
+    out = sim.run()
+    assert out["converged"] and out["rounds"] <= out["bound"]
+    crashed = [ev["churn"] for ev in sim.events
+               if ev["churn"] and "crashed" in ev["churn"]]
+    restarted = [ev["churn"] for ev in sim.events
+                 if ev["churn"] and "restarted" in ev["churn"]]
+    assert crashed and restarted
+
+
+def test_trim_past_follower_bootstraps_over_snapshot_protocol():
+    """A restarted replica whose feed cursor fell below the broadcast
+    retention window recovers over the PR 12 snapshot protocol — the
+    SnapshotNeeded -> bootstrap arm, not a silent short read."""
+    sim = ClusterSim(8, seed=0, chaos=True, churn=True, fanout=True,
+                     fanout_retention=512, records_per=32, divergence=8)
+    out = sim.run()
+    assert out["bootstraps"], "retention budget never trimmed a laggard"
+    assert out["converged"] and out["rounds"] <= out["bound"]
+
+
+def test_flash_crowd_joins_cold_and_converges():
+    sim = ClusterSim(8, seed=11, chaos=True, flash_crowd=3)
+    out = sim.run()
+    joined = [j for ev in sim.events for j in ev["joined"]]
+    assert len(joined) == 3
+    assert all(j["wire_bytes"] > 0 for j in joined)
+    assert out["converged"]
+    # the joiners ended byte-identical to the seed replicas
+    assert len(set(out["digests"].values())) == 1
+
+
+def test_snapshot_needed_surfaces_structured_from_log():
+    owner = ReplicaNode("o", recs(0, 64), fanout_retention=256)
+    follower = ReplicaNode("f", ())
+    for i in range(6):
+        owner.publish_repairs(
+            ReplicaNode("t", recs(i * 10, i * 10 + 10)).canonical_wire())
+        owner.log.enforce_retention()
+    with pytest.raises(SnapshotNeeded):
+        follower.drain_feed(owner)
+    res = follower.bootstrap_from(owner)
+    assert res["wire_bytes"] > 0
+    assert follower.stats["bootstraps"] == 1
+
+
+# -- determinism --------------------------------------------------------------
+
+
+def test_sim_is_deterministic_per_seed():
+    outs = []
+    for _ in range(2):
+        sim = ClusterSim(8, seed=13, chaos=True, churn=True, fanout=True)
+        outs.append(sim.run())
+    assert outs[0]["digests"] == outs[1]["digests"]
+    assert outs[0]["rounds"] == outs[1]["rounds"]
+    assert outs[0]["wire_bytes"] == outs[1]["wire_bytes"]
+    assert outs[0]["quarantines"] == outs[1]["quarantines"]
+
+
+# -- fleet-plane gossip SLO (tentpole: convergence observable live) ----------
+
+
+def _targets(sim):
+    def target(key):
+        node = sim.nodes[key]
+        return lambda: {"ts": 0.0,
+                        "watermarks": {"monotonic": 0.0, "links": {}},
+                        "gossip": node.snapshot()}
+
+    return [fleet.FleetTarget(target(k), name=k) for k in sim.nodes]
+
+
+def _slo_file(tmp_path, slo):
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(slo))
+    return str(path)
+
+
+def test_fleet_gossip_slo_passes_on_converged_mesh(tmp_path):
+    sim = ClusterSim(4, seed=2, chaos=False)
+    assert sim.run()["converged"]
+    slo = _slo_file(tmp_path, {"gossip": {"require_converged": True,
+                                          "max_rounds_behind": 2,
+                                          "max_quarantined": 0}})
+    buf = io.StringIO()
+    assert fleet.run_fleet_check(_targets(sim), slo, polls=1,
+                                 out=buf) == 0, buf.getvalue()
+    assert "gossip.require_converged" in buf.getvalue()
+
+
+def test_fleet_gossip_slo_fails_on_divergence(tmp_path):
+    sim = ClusterSim(4, seed=2, chaos=False)
+    sim.run()
+    sim.nodes["r0"].absorb(
+        [{"key": "rogue", "change": 1, "from": 0, "to": 1, "value": b"z"}])
+    slo = _slo_file(tmp_path, {"gossip": {"require_converged": True}})
+    buf = io.StringIO()
+    assert fleet.run_fleet_check(_targets(sim), slo, polls=1,
+                                 out=buf) == 1
+    assert "distinct content digests" in buf.getvalue()
+
+
+def test_fleet_gossip_rounds_behind_column(tmp_path):
+    """Rounds-behind is PROGRESS behind the fleet frontier since first
+    sight, not absolute position — live round counters are lifetime
+    values on unsynchronized processes, so a restarted (low-counter)
+    replica must read 0, and only a replica whose timer stops
+    advancing with the fleet reads behind."""
+    sim = ClusterSim(4, seed=2, chaos=False)
+    sim.run()
+    # a freshly restarted replica: tiny lifetime counter, converged
+    sim.nodes["r2"].round = 1
+    targets = _targets(sim)
+    view = fleet.FleetView(targets)
+    view.poll()  # baseline
+    for k in ("r0", "r1", "r2"):  # r3's timer stops advancing
+        sim.nodes[k].round += 3
+    sample = view.poll()
+    assert sample["gossip"]["r3"]["rounds_behind"] == 3
+    assert sample["gossip"]["r0"]["rounds_behind"] == 0
+    assert sample["gossip"]["r2"]["rounds_behind"] == 0  # restart-proof
+    frame = fleet.render_dashboard(view, sample)
+    assert "behind" in frame and "r3" in frame
+    # the SLO gate breaches on the stuck replica across its own polls
+    def advancing(key):
+        node = sim.nodes[key]
+
+        def snap():
+            if key != "r3":
+                node.round += 3
+            return {"ts": 0.0,
+                    "watermarks": {"monotonic": 0.0, "links": {}},
+                    "gossip": node.snapshot()}
+
+        return snap
+
+    slo = _slo_file(tmp_path, {"gossip": {"max_rounds_behind": 2}})
+    buf = io.StringIO()
+    assert fleet.run_fleet_check(
+        [fleet.FleetTarget(advancing(k), name=k) for k in sim.nodes],
+        slo, polls=2, interval=0.01, out=buf) == 1
+    assert "behind the fleet frontier" in buf.getvalue()
+
+
+@pytest.mark.parametrize("bad", [
+    {"gossip": {}},
+    {"gossip": {"unknown_key": 1}},
+    {"gossip": {"max_rounds_behind": "two"}},
+    {"gossip": {"require_converged": "yes"}},
+    {"gossip": 3},
+])
+def test_fleet_gossip_slo_malformed_shapes_are_loud(tmp_path, bad):
+    path = _slo_file(tmp_path, bad)
+    with pytest.raises(ValueError):
+        fleet.load_slo(path)
+
+
+def test_fleet_gossip_slo_no_targets_is_a_failure(tmp_path):
+    slo = _slo_file(tmp_path, {"gossip": {"require_converged": True}})
+    targets = [fleet.FleetTarget(
+        lambda: {"ts": 0.0, "watermarks": {"monotonic": 0.0,
+                                           "links": {}}}, name="t")]
+    buf = io.StringIO()
+    assert fleet.run_fleet_check(targets, slo, polls=1, out=buf) == 1
+    assert "no targets report gossip" in buf.getvalue()
+
+
+# -- live mode: sidecar --replica over real TCP ------------------------------
+
+
+def test_live_replica_mesh_converges_over_tcp():
+    """Three --replica-shaped sidecars (serve_tcp responder loop + a
+    GossipDriver each) converge from three-way divergence over real
+    sockets — the ``--replica``/``--gossip-peers`` deployment shape,
+    in-process."""
+    import threading
+
+    from dat_replication_protocol_tpu import sidecar
+    from dat_replication_protocol_tpu.cluster import GossipDriver
+
+    nodes = {
+        "n1": ReplicaNode("n1", recs(0, 30)),
+        "n2": ReplicaNode("n2", recs(20, 50)),
+        "n3": ReplicaNode("n3", recs(40, 70)),
+    }
+    ports = {}
+    for name, node in nodes.items():
+        evt = threading.Event()
+        threading.Thread(
+            target=sidecar.serve_tcp, args=("127.0.0.1", 0),
+            kwargs=dict(
+                ready_cb=lambda p, name=name, evt=evt: (
+                    ports.__setitem__(name, p), evt.set()),
+                replica_node=node, max_sessions=500),
+            daemon=True).start()
+        assert evt.wait(10)
+    drivers = [
+        GossipDriver(nodes[me],
+                     [f"127.0.0.1:{ports[o]}" for o in nodes if o != me],
+                     interval=0.05, seed=i).start()
+        for i, me in enumerate(nodes)
+    ]
+    import time
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            digests = {n.content_digest() for n in nodes.values()}
+            if len(digests) == 1:
+                break
+            time.sleep(0.05)
+        assert len({n.content_digest() for n in nodes.values()}) == 1, \
+            "live mesh did not converge"
+        assert nodes["n1"].record_count == 70
+        # the stats record --stats-fd / /snapshot carries
+        snap = drivers[0].snapshot()
+        assert snap["replica"] == "n1"
+        assert snap["rounds"] >= 1 and "peers" in snap
+    finally:
+        for d in drivers:
+            d.close()
+
+
+def test_sidecar_replica_flag_wiring():
+    """--replica mode parses, loads an absent file as a cold replica,
+    and refuses the invalid combinations."""
+    import tempfile
+
+    from dat_replication_protocol_tpu import sidecar
+
+    node = sidecar.load_replica_node("/nonexistent/cold.log", "cold")
+    assert node.record_count == 0
+    with tempfile.NamedTemporaryFile(suffix=".log") as f:
+        f.write(ReplicaNode("t", recs(0, 5)).canonical_wire())
+        f.flush()
+        node = sidecar.load_replica_node(f.name, "warm")
+        assert node.record_count == 5
+    for argv in (
+        ["--stdio", "--replica", "x.log"],
+        ["--tcp", "127.0.0.1:0", "--replica", "x.log", "--hub"],
+        ["--tcp", "127.0.0.1:0", "--replica", "x.log", "--reconcile",
+         "y.log"],
+        ["--tcp", "127.0.0.1:0", "--gossip-peers", "h:1"],
+    ):
+        with pytest.raises(SystemExit):
+            sidecar.main(argv)
+
+
+def test_snapshot_stats_carries_gossip_record():
+    from dat_replication_protocol_tpu import sidecar
+
+    node = ReplicaNode("stats-probe", recs(0, 3))
+    sidecar.set_active_gossip(node)
+    try:
+        snap = sidecar.snapshot_stats()
+        assert snap["gossip"]["replica"] == "stats-probe"
+        assert snap["gossip"]["records"] == 3
+        assert "digest" in snap["gossip"]
+    finally:
+        sidecar.set_active_gossip(None)
+    assert "gossip" not in sidecar.snapshot_stats()
+
+
+def test_delivered_form_replica_converges_on_absent_optionals():
+    """The live mesh's record identity is the DELIVERED
+    materialization (absent optionals as ''/b'') — a live replica in
+    wire form would re-reconcile absent-field records against its
+    peers forever (ship -> materialize -> re-encode changes identity).
+    ``load_replica_node`` replicas must reach diff 0 over the real
+    record-materializing drivers."""
+    import socket
+    import threading
+
+    from dat_replication_protocol_tpu.cluster import (
+        serve_responder_session,
+    )
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        run_initiator,
+    )
+
+    bare = [{"key": f"n{i}", "change": i, "from": 0, "to": 1}
+            for i in range(6)]
+    a = ReplicaNode("a", bare + recs(0, 4), delivered_form=True)
+    b = ReplicaNode("b", recs(0, 4), delivered_form=True)
+
+    def once():
+        sa, sb = socket.socketpair()
+        t = threading.Thread(target=lambda: serve_responder_session(
+            b, sb.recv, sb.sendall,
+            close_write=lambda: sb.shutdown(socket.SHUT_WR)))
+        t.start()
+        st = run_initiator(a.replica, sa.recv, sa.sendall,
+                           close_write=lambda: sa.shutdown(
+                               socket.SHUT_WR))
+        t.join(10)
+        if st["received"]:
+            a.absorb(st["received"])
+        return st
+
+    once()
+    assert a.content_digest() == b.content_digest()
+    again = once()  # and the mesh is DONE: diff 0, nothing re-ships
+    assert again["records_sent"] == 0 and not again["received"]
+    # checkpoint/restore keeps the mode
+    back = ReplicaNode.from_checkpoint(a.checkpoint())
+    assert back.delivered_form
+    assert back.content_digest() == a.content_digest()

@@ -1138,3 +1138,91 @@ def test_wire_parity_covers_pump_scanner_constant(tmp_path):
 def test_wire_parity_pump_scanner_clean_when_agreeing(tmp_path):
     assert _lint(tmp_path, ("framing.py", PUMP_PY),
                  ("native.cpp", PUMP_C_GOOD)) == []
+
+
+# -- structured-error-parity (ISSUE 15: cluster errors carry context) -------
+
+# the pre-contract shape: an error type naming neither the peer nor the
+# wire coordinates — a byzantine post-mortem reduced to "something
+# failed somewhere"
+STRUCTERR_BAD = '''
+class GossipBroken(RuntimeError):
+    def __init__(self, message):
+        super().__init__(message)
+'''
+
+STRUCTERR_GOOD = '''
+class GossipBroken(RuntimeError):
+    def __init__(self, message, *, peer, frame=None, offset=None):
+        super().__init__(message)
+        self.peer = peer
+        self.frame = frame
+        self.offset = offset
+'''
+
+
+def _lint_cluster(tmp_path, source, rules=("structured-error-parity",)):
+    from dat_replication_protocol_tpu.analysis.rules import ALL_RULES
+
+    pkg = tmp_path / "cluster"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "err.py").write_text(textwrap.dedent(source))
+    return run_paths([tmp_path],
+                     rules=[r for r in ALL_RULES if r.name in rules])
+
+
+def test_structured_error_parity_fires_on_bare_error(tmp_path):
+    findings = _lint_cluster(tmp_path, STRUCTERR_BAD)
+    assert _rules_fired(findings) == {"structured-error-parity"}
+    assert "peer" in findings[0].message
+
+
+def test_structured_error_parity_fires_on_missing_init(tmp_path):
+    findings = _lint_cluster(tmp_path, '''
+class GossipBroken(RuntimeError):
+    pass
+''')
+    assert _rules_fired(findings) == {"structured-error-parity"}
+    assert "__init__" in findings[0].message
+
+
+def test_structured_error_parity_clean_on_full_context(tmp_path):
+    assert _lint_cluster(tmp_path, STRUCTERR_GOOD) == []
+
+
+def test_structured_error_parity_accepts_self_assignments(tmp_path):
+    # offset/frame may be explicit self assignments instead of
+    # pass-through parameters
+    assert _lint_cluster(tmp_path, '''
+class GossipBroken(Exception):
+    def __init__(self, peer):
+        super().__init__(peer)
+        self.peer = peer
+        self.offset = 0
+        self.frame = None
+''') == []
+
+
+def test_structured_error_parity_scoped_to_cluster_dirs(tmp_path):
+    # the same bare error OUTSIDE a cluster/ directory is not this
+    # rule's business
+    (tmp_path / "other.py").write_text(textwrap.dedent(STRUCTERR_BAD))
+    findings = _lint(tmp_path, ("other.py", STRUCTERR_BAD),
+                     rules=None)
+    assert "structured-error-parity" not in _rules_fired(findings)
+
+
+def test_structured_error_parity_suppressible(tmp_path):
+    src = STRUCTERR_BAD.replace(
+        "class GossipBroken(RuntimeError):",
+        "class GossipBroken(RuntimeError):  "
+        "# datlint: disable=structured-error-parity")
+    assert _lint_cluster(tmp_path, src) == []
+
+
+def test_structured_error_parity_non_error_classes_exempt(tmp_path):
+    assert _lint_cluster(tmp_path, '''
+class ReplicaThing:
+    def __init__(self):
+        self.x = 1
+''') == []

@@ -463,12 +463,9 @@ def test_perf_check_prefers_the_configs_object_over_earlier_json(tmp_path):
 # host-group bench run (ISSUE 5 satellite: CPU-safe, generous budgets)
 
 
-def test_perf_check_host_only_on_live_quick_bench(tmp_path, monkeypatch):
-    import subprocess
-    import sys
-
+def _live_bench_env() -> dict:
     env = dict(os.environ)
-    env.update(BENCH_CONFIGS="1,2,6,7,8,9,10,11,12,13",
+    env.update(BENCH_CONFIGS="1,2,6,7,8,9,10,11,12,13,14",
                BENCH_ROUNDTRIPS="50",
                BENCH_DECODE_ROWS="4000", BENCH_REPLAY_ROWS="4000",
                BENCH_RESUME_ROWS="300", BENCH_RESUME_REPS="3",
@@ -480,18 +477,58 @@ def test_perf_check_host_only_on_live_quick_bench(tmp_path, monkeypatch):
                BENCH_RECONCILE_KS="10,100", BENCH_SNAPSHOT_MIB="4",
                BENCH_SNAPSHOT_JOINERS="4", BENCH_PUMP_MIB="16",
                BENCH_PUMP_SESSIONS="1,4", BENCH_PUMP_REPS="2",
+               BENCH_GOSSIP_N="4,8", BENCH_GOSSIP_RECORDS="32",
+               BENCH_GOSSIP_DIVERGENCE="8",
                BENCH_DEADLINE="300")
+    return env
+
+
+def _run_quick_bench(env: dict, timeout: int = 280) -> dict:
+    import subprocess
+    import sys
+
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--quick",
          "--metrics"],
-        capture_output=True, text=True, timeout=280, env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
     )
     assert r.returncode == 0, r.stderr[-2000:]
-    artifact = tmp_path / "live.json"
-    artifact.write_text(r.stdout)
-    out = io.StringIO()
-    rc = obs_perf.run_check(str(artifact), BUDGETS, host_only=True, out=out)
-    assert rc == 0, out.getvalue()
+    return obs_perf._parse_snapshot(r.stdout, "live-bench-stdout")
+
+
+def _failing_configs(snapshot: dict) -> list:
+    budgets = obs_perf.load_budgets(BUDGETS)
+    rows = obs_perf.check_snapshot(snapshot, budgets, host_only=True)
+    return sorted({r["config"] for r in rows if r["status"] == "fail"})
+
+
+def test_perf_check_host_only_on_live_quick_bench(tmp_path, monkeypatch):
+    snapshot = _run_quick_bench(_live_bench_env())
+    failing = _failing_configs(snapshot)
+    if failing:
+        # one-retry-with-margin rule (ISSUE 15 satellite): a
+        # budget-floor miss on the shared tier-1 run can be CI LOAD,
+        # not a regression — the whole suite plus this very bench were
+        # competing for the 2-core box.  Re-run EXACTLY the failing
+        # configs once, in isolation (their own process, nothing else
+        # running), and gate on that result.  A true regression fails
+        # both runs; only the isolated verdict counts, and only one
+        # retry is allowed — "any failure is a real regression" stays
+        # true, with the load-flake class carved out mechanically.
+        keys = [k for k, (nm, _fn) in bench.BENCHES.items()
+                if nm in failing]
+        assert keys, f"unrunnable failing configs: {failing}"
+        env = _live_bench_env()
+        env["BENCH_CONFIGS"] = ",".join(keys)
+        rerun = _run_quick_bench(env)
+        for name in failing:
+            assert name in rerun.get("configs", {}), (
+                f"isolated re-run produced no result for {name}")
+            snapshot["configs"][name] = rerun["configs"][name]
+        still = _failing_configs(snapshot)
+        assert not still, (
+            f"configs {still} missed their budget floor twice — once "
+            f"under load and once in isolation: a real regression")
 
 
 # -- bench backend_error structure (ISSUE 5 satellite) ------------------------
