@@ -4,12 +4,15 @@ Exits 0 when clean, 1 on findings, 2 on usage errors — shaped so the
 tier-1 suite (tests/test_datlint_repo_clean.py) and any pre-merge hook
 can gate on it directly.
 
-Structured surfaces (ISSUE 13 satellites):
+Structured surfaces (ISSUE 13 + 16 satellites):
 
-* ``--json`` — machine-readable output: one document with ``findings``
-  (each ``{rule, path, line, message, chains}``), counts, and (with
-  ``--stats``) per-rule wall seconds, so CI can ANNOTATE diffs instead
-  of parsing the human lines.
+* ``--format json|sarif`` — machine-readable output.  ``json`` is one
+  document with ``findings`` (each ``{rule, path, line, message,
+  chains}``), counts, and (with ``--stats``) per-rule wall seconds;
+  ``--json`` remains as an alias for ``--format json``.  ``sarif`` is
+  SARIF 2.1.0 (one run, one result per new finding, evidence chains
+  under ``properties.chains``) for CI surfaces that ingest SARIF
+  natively.
 * ``--baseline FILE`` — accept-list: findings whose stable key (rule +
   trailing path + first message sentence, no line numbers) appears in
   FILE are reported as ``accepted`` and do not fail the run; only NEW
@@ -21,6 +24,12 @@ Structured surfaces (ISSUE 13 satellites):
   graph (deterministic, byte-stable on an unchanged tree) so the
   event-loop refactor (ROADMAP item 2) can diff the thread web it
   inherits; ``artifacts/lock_graph.json`` is the checked-in copy.
+* ``--write-artifacts DIR`` — regenerate EVERY checked-in analysis
+  artifact (``lock_graph.json`` + ``event_loop_surface.json``) into
+  DIR, byte-stably: sorted keys, fixed indent, no timestamps, paths
+  project-relative.  The tier-1 suite asserts the ``artifacts/``
+  copies match a fresh regeneration, so "regenerate on change" is
+  enforced, not aspirational.
 """
 
 from __future__ import annotations
@@ -46,6 +55,71 @@ def write_lock_graph(project: Project, out_path: str | Path) -> dict:
     return doc
 
 
+def write_event_loop_surface(project: Project,
+                             out_path: str | Path) -> dict:
+    """Render and write the event-loop readiness certificate (ISSUE
+    16); same byte-stability contract as :func:`write_lock_graph`."""
+    from .concurrency import ReadinessIndex, render_event_loop_surface
+
+    doc = render_event_loop_surface(ReadinessIndex.get(project))
+    text = json.dumps(doc, indent=2, sort_keys=True) + "\n"
+    Path(out_path).write_text(text, encoding="utf-8")
+    return doc
+
+
+def write_artifacts(project: Project, out_dir: str | Path) -> list:
+    """Regenerate every checked-in analysis artifact into ``out_dir``;
+    returns the written paths (sorted)."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    write_lock_graph(project, out_dir / "lock_graph.json")
+    write_event_loop_surface(project,
+                             out_dir / "event_loop_surface.json")
+    return sorted([out_dir / "event_loop_surface.json",
+                   out_dir / "lock_graph.json"])
+
+
+def to_sarif(new: list, accepted: list, rules, n_files: int) -> dict:
+    """SARIF 2.1.0: one run; baseline-accepted findings are carried as
+    suppressed results (SARIF's native accept-list shape) so ingesting
+    CI sees them without failing on them."""
+    def result(f, suppressed: bool) -> dict:
+        r = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line},
+                },
+            }],
+            "properties": {"chains": [list(c) for c in f.chains]},
+        }
+        if suppressed:
+            r["suppressions"] = [{"kind": "external",
+                                  "justification": "baseline accept-list"}]
+        return r
+
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "datlint",
+                "informationUri":
+                    "https://github.com/mafintosh/dat-replication-protocol",
+                "rules": [{"id": r.name,
+                           "shortDescription": {"text": r.description}}
+                          for r in rules],
+            }},
+            "results": [result(f, False) for f in new]
+            + [result(f, True) for f in accepted],
+            "properties": {"files": n_files},
+        }],
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dat_replication_protocol_tpu.analysis",
@@ -62,8 +136,11 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true",
         help="print the rule names and one-line descriptions, then exit")
     parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default=None,
+        help="output format (default text); sarif is SARIF 2.1.0")
+    parser.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="emit one JSON document instead of human-readable lines")
+        help="alias for --format json (kept for ISSUE-13 callers)")
     parser.add_argument(
         "--baseline", metavar="FILE",
         help="accept-list of known findings (see --write-baseline); "
@@ -79,7 +156,19 @@ def main(argv=None) -> int:
         "--lock-graph", metavar="PATH",
         help="also write the machine-readable lock-acquisition graph "
              "(artifacts/lock_graph.json is the checked-in copy)")
+    parser.add_argument(
+        "--write-artifacts", metavar="DIR",
+        help="regenerate every checked-in analysis artifact "
+             "(lock_graph.json + event_loop_surface.json) into DIR, "
+             "byte-stably")
     args = parser.parse_args(argv)
+    if args.format is None:
+        args.format = "json" if args.as_json else "text"
+    elif args.as_json and args.format != "json":
+        print("datlint: --json contradicts --format "
+              f"{args.format}", file=sys.stderr)
+        return 2
+    args.as_json = args.format == "json"
 
     if args.list_rules:
         for rule in ALL_RULES:
@@ -119,6 +208,8 @@ def main(argv=None) -> int:
     findings = run_project(project, rules, stats if args.stats else None)
     if args.lock_graph:
         write_lock_graph(project, args.lock_graph)
+    if args.write_artifacts:
+        write_artifacts(project, args.write_artifacts)
 
     n_files = len(project.sources)
 
@@ -153,6 +244,11 @@ def main(argv=None) -> int:
 
     new = [f for f in findings if f.key() not in baseline]
     accepted = [f for f in findings if f.key() in baseline]
+
+    if args.format == "sarif":
+        print(json.dumps(to_sarif(new, accepted, rules, n_files),
+                         indent=2))
+        return 1 if new else 0
 
     if args.as_json:
         doc = {

@@ -35,11 +35,21 @@ item 2) rebuilds that web:
   cursor-coherence lesson: a linter guarding silent corruption must
   never silently disarm).
 
+* :mod:`.readiness` (ISSUE 16) lifts the same index one level up: an
+  interprocedural may-block summary pass (``nonblocking`` /
+  ``bounded-blocking`` / ``unbounded-blocking``) feeding two enforced
+  rules — :class:`~.readiness.BlockingReachability` (no unbounded
+  blocking reachable from a certified dispatch loop) and
+  :class:`~.readiness.CallbackEscape` (no user callback on a
+  dispatcher thread) — plus the per-entry-point certificate
+  ``artifacts/event_loop_surface.json``.
+
 The machine-readable lock-acquisition graph is exported as
-``artifacts/lock_graph.json`` (``python -m
-dat_replication_protocol_tpu.analysis --lock-graph PATH``) so the
-item-2 refactor can diff the thread web it inherits.  Rules and
-incidents: ANALYSIS.md "Concurrency rules".
+``artifacts/lock_graph.json``, and the event-loop readiness
+certificate as ``artifacts/event_loop_surface.json`` (both via
+``python -m dat_replication_protocol_tpu.analysis --write-artifacts
+DIR``) so the item-2 refactor can diff the thread web it inherits.
+Rules and incidents: ANALYSIS.md "Concurrency rules".
 """
 
 from __future__ import annotations
@@ -48,11 +58,17 @@ from .blocking import BlockingUnderLock
 from .guarded import GuardedState
 from .lockorder import LockOrder
 from .model import ProgramIndex, render_lock_graph
+from .readiness import BlockingReachability, CallbackEscape, \
+    ReadinessIndex, render_event_loop_surface
 
 __all__ = [
+    "BlockingReachability",
     "BlockingUnderLock",
+    "CallbackEscape",
     "GuardedState",
     "LockOrder",
     "ProgramIndex",
+    "ReadinessIndex",
+    "render_event_loop_surface",
     "render_lock_graph",
 ]

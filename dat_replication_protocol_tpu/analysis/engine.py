@@ -92,6 +92,9 @@ class SourceFile:
         # line -> set of rule names suppressed on that line
         self.line_suppressions: dict[int, set[str]] = {}
         self.file_suppressions: set[str] = set()
+        # every suppression marker as written, for the stale-suppression
+        # audit: {"line", "rules", "file", "covers", "reason", "used"}
+        self.suppress_markers: list[dict] = []
         # line -> raw comment text (Python only; rules parse declarations
         # such as coupled-state sets out of these)
         self.comments: dict[int, str] = {}
@@ -128,20 +131,26 @@ class SourceFile:
                     if tok.type == tokenize.COMMENT:
                         line = tok.start[0]
                         self.comments[line] = tok.string
-                        self._note_suppressions(line, tok.string)
+                        covers = [line]
                         # a comment-only line also covers the line below,
                         # so long statements can carry a suppression
                         # without blowing the line length
                         if lines[line - 1][:tok.start[1]].strip() == "":
-                            self._note_suppressions(line + 1, tok.string)
+                            covers.append(line + 1)
+                        for c in covers:
+                            self._note_suppressions(c, tok.string)
+                        self._note_marker(line, tok.string, covers)
             except (tokenize.TokenError, IndentationError, SyntaxError):
                 pass  # rules that need the AST will surface the error
         else:
             for i, line in enumerate(lines, start=1):
                 for m in _C_COMMENT_RE.finditer(line):
-                    self._note_suppressions(i, m.group(0))
+                    covers = [i]
                     if line[:m.start()].strip() == "":
-                        self._note_suppressions(i + 1, m.group(0))
+                        covers.append(i + 1)
+                    for c in covers:
+                        self._note_suppressions(c, m.group(0))
+                    self._note_marker(i, m.group(0), covers)
 
     def _note_suppressions(self, line: int, comment: str) -> None:
         m = _SUPPRESS_FILE_RE.search(comment)
@@ -151,6 +160,34 @@ class SourceFile:
         if m:
             self.line_suppressions.setdefault(line, set()).update(
                 m.group(1).split(","))
+
+    def _note_marker(self, line: int, comment: str, covers: list) -> None:
+        for regex, file_level in ((_SUPPRESS_FILE_RE, True),
+                                  (_SUPPRESS_RE, False)):
+            m = regex.search(comment)
+            if not m:
+                continue
+            # the reason is whatever human text shares the comment with
+            # the marker (before or after) — the audited-exception bar
+            # from ANALYSIS.md, now machine-checked
+            rest = comment[:m.start()] + comment[m.end():]
+            self.suppress_markers.append({
+                "line": line,
+                "rules": set(m.group(1).split(",")),
+                "file": file_level,
+                "covers": set(covers),
+                "reason": bool(re.search(r"\w", rest.replace("datlint", ""))),
+                "used": False,
+            })
+
+    def note_suppression_use(self, rule: str, line: int) -> None:
+        """Credit every marker that suppresses ``rule`` at ``line`` —
+        the stale-suppression audit flags whatever earns no credit."""
+        for m in self.suppress_markers:
+            if not ({rule, "all", "*"} & m["rules"]):
+                continue
+            if m["file"] or line in m["covers"]:
+                m["used"] = True
 
     def suppressed(self, rule: str, line: int) -> bool:
         if {rule, "all", "*"} & self.file_suppressions:
@@ -211,17 +248,20 @@ def run_project(project: Project, rules: Iterable,
     import time as _time
 
     by_path = {str(s.path): s for s in project.sources}
+    rules = list(rules)
     out: list[Finding] = []
     for rule in rules:
         t0 = _time.perf_counter()
         for f in rule.check(project):
             src = by_path.get(f.path)
             if src is not None and src.suppressed(f.rule, f.line):
+                src.note_suppression_use(f.rule, f.line)
                 continue
             out.append(f)
         if stats is not None:
             stats[rule.name] = stats.get(rule.name, 0.0) \
                 + _time.perf_counter() - t0
+    out.extend(_audit_suppressions(project, rules))
     # a Python file the analyzer cannot parse hides every AST rule: that
     # is itself a finding, not a silent skip
     for s in project.py_sources:
@@ -233,6 +273,61 @@ def run_project(project: Project, rules: Iterable,
                 message=f"unparsable Python: {s.parse_error.msg}",
             ))
     return sorted(out)
+
+
+class StaleSuppression:
+    """A suppression that suppresses nothing is itself a finding.
+
+    ``check`` yields nothing: staleness is only decidable AFTER every
+    other rule has run (a marker is stale when no finding of its rules
+    hit its lines in THIS run), so :func:`run_project` performs the
+    audit as a post-pass — see :func:`_audit_suppressions` — gated on
+    this rule being in the registry.  The post-pass also enforces the
+    ANALYSIS.md audited-exception bar mechanically: every marker must
+    carry a written reason in the same comment.
+    """
+
+    name = "stale-suppression"
+    description = ("a datlint suppression must suppress at least one "
+                   "finding of a rule that ran, and must carry a "
+                   "written reason in the same comment")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        return iter(())
+
+
+def _audit_suppressions(project: Project, rules: list) -> list[Finding]:
+    names = {r.name for r in rules}
+    if StaleSuppression.name not in names:
+        return []
+    out: list[Finding] = []
+    for s in project.sources:
+        for m in s.suppress_markers:
+            path = str(s.path)
+            if not m["reason"]:
+                f = Finding(
+                    path=path, line=m["line"], rule=StaleSuppression.name,
+                    message=("suppression without a written reason — an "
+                             "audited exception states its why in the "
+                             "same comment (see ANALYSIS.md), or gets "
+                             "deleted"))
+                if not s.suppressed(f.rule, f.line):
+                    out.append(f)
+            specific = m["rules"] - {"all", "*"}
+            # wildcards and rules that did not run this invocation are
+            # not judgeable for staleness — never guess
+            if m["used"] or not specific or not specific <= names:
+                continue
+            f = Finding(
+                path=path, line=m["line"], rule=StaleSuppression.name,
+                message=(f"datlint: disable="
+                         f"{','.join(sorted(m['rules']))} suppressed "
+                         f"zero findings this run — the code it excused "
+                         f"is gone (or the rule name is wrong): delete "
+                         f"the marker"))
+            if not s.suppressed(f.rule, f.line):
+                out.append(f)
+    return out
 
 
 def run_paths(paths: Iterable[str | Path], rules=None) -> list[Finding]:

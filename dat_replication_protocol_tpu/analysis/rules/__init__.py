@@ -8,7 +8,9 @@ module here plus a known-bad/known-good fixture pair in
 
 from __future__ import annotations
 
-from ..concurrency import BlockingUnderLock, GuardedState, LockOrder
+from ..concurrency import BlockingReachability, BlockingUnderLock, \
+    CallbackEscape, GuardedState, LockOrder
+from ..engine import StaleSuppression
 from .bounded_wait import BoundedWait
 from .cursor_coherence import CursorCoherence
 from .env_cache import EnvCachePolicy
@@ -40,6 +42,14 @@ ALL_RULES = (
     LockOrder(),
     BlockingUnderLock(),
     GuardedState(),
+    # event-loop readiness certifier (ISSUE 16): shares the same
+    # ProgramIndex, adds its own ReadinessIndex on top
+    BlockingReachability(),
+    CallbackEscape(),
+    # engine post-pass: must run with the full registry to judge
+    # staleness, so it lives last (position is cosmetic — run_project
+    # audits after ALL rules regardless)
+    StaleSuppression(),
 )
 
 

@@ -128,7 +128,8 @@ class GossipDriver:
             # bounded by the SO_RCVTIMEO/SO_SNDTIMEO set immediately
             # below — settimeout(T) would flip the fd to O_NONBLOCK,
             # which the raw-fd pump route cannot ride
-            conn.settimeout(None)  # datlint: disable=unbounded-join
+            # datlint: disable=unbounded-join -- SO_RCVTIMEO+SO_SNDTIMEO set below bound every op at the kernel
+            conn.settimeout(None)
             tv = struct.pack(
                 "ll", int(self._dial_timeout),
                 int((self._dial_timeout % 1.0) * 1_000_000))

@@ -653,10 +653,20 @@ class FanoutServer:
                         self._gather.release()
                 else:
                     try:
+                        # wire-peer fds are O_NONBLOCK (attach dups the
+                        # fd and set_blocking(False)s it): EAGAIN comes
+                        # straight back as a short turn, never a stall.
+                        # datlint: allow-blocking-reachable(os-io)
                         accepted = os.writev(fd, views[:st.max_iov])
                     except (BlockingIOError, InterruptedError):
                         accepted = 0
             else:
+                # sink peers are the in-process delivery surface (tests,
+                # local taps); the attach contract puts the sink's
+                # promptness on the attacher — it runs ON the broadcast
+                # turn, and a stalling sink stalls only its own server's
+                # fairness window, which the tests exercise.
+                # datlint: allow-callback-escape
                 accepted = int(st.sink(views))
         except OSError:
             # EPIPE/ECONNRESET/EBADF: the peer's transport died — shed

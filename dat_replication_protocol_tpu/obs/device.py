@@ -40,10 +40,6 @@ Three parts:
 
 Catalog and budget: OBSERVABILITY.md (device-telemetry section).
 """
-# datlint: disable-file=obs-discipline  — plumbing: jit_site/note_engine
-# forward caller-supplied site/component names into events by design;
-# the greppable literal names live at their call sites.
-
 from __future__ import annotations
 
 import sys

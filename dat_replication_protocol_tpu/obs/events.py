@@ -137,6 +137,11 @@ class EventLog:
         line = json.dumps(rec, default=repr) + "\n"
         if not isinstance(sink, int):
             try:
+                # a file-object sink runs on the emitting thread by the
+                # module-docstring contract: its promptness is the
+                # attacher's problem (tests attach StringIO; production
+                # attaches an fd and rides the deadline loop below).
+                # datlint: allow-blocking-reachable(file-io)
                 sink.write(line)
                 flush = getattr(sink, "flush", None)
                 if flush is not None:
@@ -152,6 +157,12 @@ class EventLog:
         try:
             while view:
                 try:
+                    # the EAGAIN/deadline loop below bounds this write
+                    # on a NONBLOCKING fd; a blocking fd parks only the
+                    # emitting thread, the attach_sink contract — same
+                    # doctrine as the sidecar stats emitter, which
+                    # flips its pipe nonblocking for exactly this.
+                    # datlint: allow-blocking-reachable(os-io)
                     n = os.write(sink, view)
                 except InterruptedError:
                     continue  # EINTR: retry immediately

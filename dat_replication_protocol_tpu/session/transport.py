@@ -82,6 +82,14 @@ def send_over(
     ``write_bytes`` must block when the transport is congested (that is
     the backpressure).  ``close`` (e.g. ``sock.shutdown(SHUT_WR)``) runs
     on the way out so the peer observes EOF.
+
+    Readiness certificate (``artifacts/event_loop_surface.json``, entry
+    ``transport-send-pump``): the pump's OWN waits are bounded
+    (``readable.wait(WAKE_FALLBACK)``); its remaining unbounded surface
+    is exactly the injected ``write_bytes`` callable — blocking there is
+    the backpressure contract above, and every caller that needs a bound
+    owns it at the fd/socket layer (``SO_SNDTIMEO``, ``settimeout``,
+    nonblocking-fd deadline loops) rather than inside this pump.
     """
     readable = threading.Event()
     encoder._attach_readable(readable.set)
@@ -126,6 +134,14 @@ def recv_over(
     until the decoder's drain watcher fires — so the kernel receive
     buffer (not host RAM) absorbs the in-flight window and the peer's
     sends eventually block.
+
+    Readiness certificate (``artifacts/event_loop_surface.json``, entry
+    ``transport-recv-pump``): the stall loop is bounded
+    (``wake.wait(WAKE_FALLBACK)``); the unbounded surface the
+    certificate enumerates is the injected ``read_bytes`` callable — a
+    silent peer parks the pump by design until the session owner tears
+    it down (stall teardown in the sidecar, ``SO_RCVTIMEO`` on gossip
+    dials), so the bound lives with whoever owns the fd.
     """
     # Persistent drain watcher, not a per-write on_consumed callback: a
     # done() ack landing on another thread while THIS thread is still

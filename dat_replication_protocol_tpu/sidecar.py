@@ -354,6 +354,33 @@ def run_session(read_bytes, write_bytes, close_write=None,
     return out
 
 
+# a refusal goes to a peer we are about to drop: it must never park the
+# session thread on sendall against a receiver that stopped draining
+# (the blocking-reachability certifier's first true positive — the
+# kernel buffer absorbs the ~200-byte record instantly from any healthy
+# peer, so the bound only ever fires on a dead one)
+_REFUSAL_SEND_TIMEOUT = 5.0
+
+
+def _send_refusal(conn: socket.socket, out: dict) -> None:
+    """Best-effort structured-refusal write with a hard bound.
+
+    ``settimeout`` flips the socket to timeout mode for the remaining
+    sends; that is fine here — every caller drops ``conn`` right after.
+    ``socket.timeout`` is an ``OSError`` subclass, so the one except
+    clause covers refused, reset, AND wedged receivers.
+    """
+    try:
+        conn.settimeout(_REFUSAL_SEND_TIMEOUT)
+        # bounded by the settimeout above (invisible to the certifier,
+        # which reads call shapes, not socket modes).
+        # datlint: allow-blocking-reachable(socket)
+        conn.sendall((json.dumps(out) + "\n").encode())
+        conn.shutdown(socket.SHUT_WR)
+    except OSError:
+        pass
+
+
 def run_subscriber(conn: socket.socket, fanout, key: str) -> dict:
     """Serve one fan-out subscriber connection (ISSUE 9): attach the
     socket as a downstream peer of the shared :class:`BroadcastLog` and
@@ -387,24 +414,16 @@ def run_subscriber(conn: socket.socket, fanout, key: str) -> dict:
             # the refusal record carries the redirect — port +
             # capability — so the joiner needs no out-of-band config
             out["hint"] = dict(e.hint)
-        try:
-            conn.sendall((json.dumps(out) + "\n").encode())
-            conn.shutdown(socket.SHUT_WR)
-        except OSError:
-            pass
+        _send_refusal(conn, out)
         if _OBS.on:
             _emit("sidecar.session", **out)
         return out
     except FanoutBusy as e:
         out = {"fanout_peer": key, "ok": False, "rejected": True,
                "peers": e.peers, "max_peers": e.max_peers}
-        try:
-            # the structured record IS the rejection: a bare EOF would
-            # be indistinguishable from an empty sealed broadcast
-            conn.sendall((json.dumps(out) + "\n").encode())
-            conn.shutdown(socket.SHUT_WR)
-        except OSError:
-            pass
+        # the structured record IS the rejection: a bare EOF would be
+        # indistinguishable from an empty sealed broadcast
+        _send_refusal(conn, out)
         if _OBS.on:
             _emit("sidecar.session", **out)
         return out
@@ -444,11 +463,7 @@ def run_subscriber(conn: socket.socket, fanout, key: str) -> dict:
                "detail": "subscriber connections must not send data; "
                          "the broadcast source slot was already claimed "
                          "— reconnect to retry as source"}
-        try:
-            conn.sendall((json.dumps(out) + "\n").encode())
-            conn.shutdown(socket.SHUT_WR)
-        except OSError:
-            pass
+        _send_refusal(conn, out)
         if _OBS.on:
             _emit("sidecar.session", **out)
         return out
@@ -882,6 +897,16 @@ class StatsEmitter:
         if fmt not in ("json", "prom"):
             raise ValueError(f"unknown stats format {fmt!r}")
         self._fd = fd
+        # the EAGAIN/deadline machinery in dump_once only ever engages
+        # on a NONBLOCKING fd: on a blocking pipe with a stopped
+        # consumer, os.write parks the emitter thread forever (stop()
+        # then reports False and the process leaks the thread).  Flip
+        # the fd up front so the 2 s grace bound is real — the
+        # blocking-reachability certifier's second true positive.
+        try:
+            os.set_blocking(fd, False)
+        except OSError:
+            pass  # closed/odd fd: the first write will surface it
         self._fmt = fmt
         self._interval = interval
         self._wake = threading.Event()
@@ -938,6 +963,9 @@ class StatsEmitter:
         deadline = time.monotonic() + 2.0
         while view:
             try:
+                # bounded: __init__ flipped the fd nonblocking, so this
+                # either progresses or raises EAGAIN into the deadline
+                # arm below.  datlint: allow-blocking-reachable(os-io)
                 view = view[os.write(self._fd, view):]
             except OSError as e:
                 # EAGAIN is a momentarily-full pipe, not a dead one: a

@@ -298,7 +298,7 @@ import os
 import jax
 import jax.numpy as jnp
 
-DEBUG = bool(os.environ.get("DAT_DEBUG"))  # datlint: disable=env-cache-policy
+DEBUG = bool(os.environ.get("DAT_DEBUG"))  # datlint: disable=env-cache-policy -- fixture: frozen on purpose
 
 
 @jax.jit
@@ -528,7 +528,7 @@ def test_comment_line_above_suppresses_the_next_line(tmp_path):
 
 def test_file_suppression_silences_whole_file(tmp_path):
     findings = _lint(tmp_path, ("filewide.py", '''
-        # datlint: disable-file=unbounded-join
+        # datlint: disable-file=unbounded-join -- fixture: joins audited
         def wait(a, b):
             a.join()
             b.join()
@@ -546,12 +546,72 @@ def test_suppression_in_string_literal_is_inert(tmp_path):
     assert len(findings) == 1
 
 
+def test_stale_suppression_flags_a_marker_suppressing_nothing(tmp_path):
+    findings = _lint(tmp_path, ("stale.py", '''
+        def quiet():
+            return 1  # datlint: disable=unbounded-join -- long gone
+    '''))
+    assert [f.rule for f in findings] == ["stale-suppression"]
+    assert "zero findings" in findings[0].message
+    assert findings[0].line == 3
+
+
+def test_suppression_without_a_reason_is_a_finding(tmp_path):
+    # the suppression WORKS (no unbounded-join finding) but the missing
+    # justification is itself reported: audited exceptions carry their
+    # why in the same comment
+    findings = _lint(tmp_path, ("noreason.py", '''
+        def wait(sender):
+            sender.join()  # datlint: disable=unbounded-join
+    '''))
+    assert [f.rule for f in findings] == ["stale-suppression"]
+    assert "reason" in findings[0].message
+
+
+def test_used_and_reasoned_suppression_is_silent(tmp_path):
+    findings = _lint(tmp_path, ("used.py", '''
+        def wait(sender):
+            sender.join()  # datlint: disable=unbounded-join -- drained
+    '''))
+    assert findings == []
+
+
+def test_wildcard_suppression_is_not_judged_for_staleness(tmp_path):
+    # disable-file=all suppresses ANY rule, so "suppressed zero
+    # findings" is not decidable per-rule — never guess; the reason
+    # requirement still applies (and is satisfied here)
+    findings = _lint(tmp_path, ("wild.py", '''
+        # datlint: disable-file=all -- fixture: blanket escape hatch
+        def quiet():
+            return 1
+    '''))
+    assert findings == []
+
+
+def test_stale_audit_skips_rules_that_did_not_run(tmp_path):
+    from dat_replication_protocol_tpu.analysis.engine import \
+        StaleSuppression
+
+    # unbounded-join is not in this run, so its marker's staleness is
+    # unknowable — only the reason requirement is checkable (and met)
+    findings = _lint(tmp_path, ("subset.py", '''
+        def quiet():
+            return 1  # datlint: disable=unbounded-join -- other run
+    '''), rules=[StaleSuppression()])
+    assert findings == []
+
+
 def test_c_comment_suppression(tmp_path):
+    # two C twins disagreeing on an explicit `// wire:` marker: the
+    # finding lands on the FIRST site (a.cpp), where the C-comment
+    # suppression must both silence it AND be credited as used (no
+    # stale-suppression echo)
     findings = _lint(
         tmp_path,
-        ("consts.py", "TYPE_CHANGE = 1\n"),
-        ("bad.cpp",
-         "int t = 2;  // TYPE_CHANGE  // datlint: disable=wire-constant-parity\n"))
+        ("a.cpp",
+         "// wire: TYPE_CHANGE = 1"
+         "  // datlint: disable=wire-constant-parity -- fixture drift\n"),
+        ("b.cpp", "// wire: TYPE_CHANGE = 2\n"))
     assert findings == []
 
 

@@ -1,0 +1,448 @@
+"""Event-loop readiness certifier (ISSUE 16): fixture suites for the
+may-block summary lattice, blocking-reachability, callback-escape, the
+certificate renderer, and the structured CLI surfaces added alongside
+(--format json|sarif, --write-artifacts).
+
+Fixture doctrine (same as test_datlint.py): each bad fixture is a
+minimal re-creation of the real pattern the pass certifies against —
+if a classification flips on it, the certifier has lost the property
+the item-2 rewrite diffs.
+"""
+
+import json
+import textwrap
+
+from dat_replication_protocol_tpu.analysis import run_paths
+from dat_replication_protocol_tpu.analysis.__main__ import \
+    main as datlint_main
+from dat_replication_protocol_tpu.analysis.concurrency import (
+    BlockingReachability,
+    CallbackEscape,
+    ReadinessIndex,
+    render_event_loop_surface,
+)
+from dat_replication_protocol_tpu.analysis.engine import Project
+
+READY_RULES = (BlockingReachability(), CallbackEscape())
+
+
+def _write(tmp_path, *files):
+    for name, source in files:
+        (tmp_path / name).write_text(textwrap.dedent(source))
+    return tmp_path
+
+
+def _lint(tmp_path, *files, rules=READY_RULES):
+    _write(tmp_path, *files)
+    return run_paths([tmp_path], rules=rules)
+
+
+def _index(tmp_path, *files):
+    _write(tmp_path, *files)
+    return ReadinessIndex.get(Project.from_paths([tmp_path]))
+
+
+def _summary(idx, suffix):
+    keys = [k for k in idx.fns if k.endswith(suffix)]
+    assert keys, f"no function key ends with {suffix!r}: {sorted(idx.fns)}"
+    return idx.fns[keys[0]].summary
+
+
+# -- the summary lattice ------------------------------------------------------
+
+def test_timeout_wait_is_bounded_bare_wait_is_not(tmp_path):
+    idx = _index(tmp_path, ("w.py", '''
+        import threading
+
+        class Loop:
+            def __init__(self):
+                self._ev = threading.Event()
+
+            def carries(self):
+                self._ev.wait(0.5)
+
+            def carries_kw(self):
+                self._ev.wait(timeout=2.0)
+
+            def bare(self):
+                self._ev.wait()
+
+            def explicit_none(self):
+                self._ev.wait(timeout=None)
+    '''))
+    assert _summary(idx, "::Loop.carries") == "bounded-blocking"
+    assert _summary(idx, "::Loop.carries_kw") == "bounded-blocking"
+    assert _summary(idx, "::Loop.bare") == "unbounded-blocking"
+    assert _summary(idx, "::Loop.explicit_none") == "unbounded-blocking"
+
+
+def test_sleep_join_and_acquire_boundedness(tmp_path):
+    idx = _index(tmp_path, ("j.py", '''
+        import time
+
+        def naps():
+            time.sleep(0.01)
+
+        def joins_bounded(worker):
+            worker.join(timeout=5)
+
+        def joins_forever(worker):
+            worker.join()
+
+        def string_join_is_not_a_wait(parts):
+            return ",".join(parts)
+
+        class L:
+            def try_lock(self):
+                return self._lock.acquire(blocking=False)
+
+            def takes_lock(self):
+                self._lock.acquire()
+    '''))
+    assert _summary(idx, "::naps") == "bounded-blocking"
+    assert _summary(idx, "::joins_bounded") == "bounded-blocking"
+    assert _summary(idx, "::joins_forever") == "unbounded-blocking"
+    assert _summary(idx, "::string_join_is_not_a_wait") == "nonblocking"
+    assert _summary(idx, "::L.try_lock") == "bounded-blocking"
+    assert _summary(idx, "::L.takes_lock") == "unbounded-blocking"
+
+
+def test_summary_propagates_through_calls(tmp_path):
+    idx = _index(tmp_path, ("p.py", '''
+        def leaf(sock):
+            sock.recv(4096)
+
+        def middle(sock):
+            leaf(sock)
+
+        def top(sock):
+            middle(sock)
+    '''))
+    for fn in ("::leaf", "::middle", "::top"):
+        assert _summary(idx, fn) == "unbounded-blocking"
+
+
+def test_recursion_cycle_terminates_and_stays_sound(tmp_path):
+    # ping <-> pong call each other forever; pong also reaches a bare
+    # recv.  The fixpoint must terminate (monotone on a finite
+    # lattice) and BOTH cycle members must inherit the unbounded site.
+    idx = _index(tmp_path, ("cycle.py", '''
+        def blocker(sock):
+            sock.recv(1)
+
+        def ping(sock, n):
+            if n:
+                pong(sock, n - 1)
+
+        def pong(sock, n):
+            ping(sock, n)
+            blocker(sock)
+    '''))
+    assert _summary(idx, "::ping") == "unbounded-blocking"
+    assert _summary(idx, "::pong") == "unbounded-blocking"
+
+
+def test_thread_spawn_does_not_raise_spawner_summary(tmp_path):
+    # Thread(target=self._run) with a bound method: starting a thread
+    # is nonblocking, but the TARGET's classification must resolve and
+    # surface as a spawn edge
+    idx = _index(tmp_path, ("t.py", '''
+        import threading
+
+        class Boss:
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                self.sock.recv(1)
+    '''))
+    assert _summary(idx, "::Boss.start") == "nonblocking"
+    assert _summary(idx, "::Boss._run") == "unbounded-blocking"
+    start = [rf for k, rf in idx.fns.items() if k.endswith("::Boss.start")]
+    spawns = start[0].spawns
+    assert len(spawns) == 1
+    assert spawns[0].target is not None
+    assert spawns[0].target.endswith("::Boss._run")
+
+
+def test_lambda_stored_in_dict_links_to_dynamic_call(tmp_path):
+    # the callback-escape edge case from the issue: the blocking call
+    # hides behind a lambda stored in a dict, invoked dynamically
+    idx = _index(tmp_path, ("d.py", '''
+        class Srv:
+            def __init__(self):
+                self._handlers = {}
+                self._handlers["x"] = lambda: self.sock.recv(1)
+
+            def _dispatch_loop(self):
+                self._handlers["x"]()
+    '''))
+    assert _summary(idx, "::Srv._dispatch_loop") == "unbounded-blocking"
+
+
+def test_dict_literal_of_callables_links_too(tmp_path):
+    idx = _index(tmp_path, ("dl.py", '''
+        class Srv:
+            def __init__(self):
+                self._handlers = {"x": self._on_x}
+
+            def _on_x(self):
+                self.sock.recv(1)
+
+            def _dispatch_loop(self):
+                self._handlers["x"]()
+    '''))
+    assert _summary(idx, "::Srv._dispatch_loop") == "unbounded-blocking"
+
+
+# -- blocking-reachability ----------------------------------------------------
+
+def test_unbounded_site_reachable_from_dispatch_loop_fires(tmp_path):
+    findings = _lint(tmp_path, ("srv.py", '''
+        class Srv:
+            def _dispatch_loop(self):
+                self._pump()
+
+            def _pump(self):
+                self.sock.recv(4096)
+    '''))
+    assert [f.rule for f in findings] == ["blocking-reachability"]
+    assert findings[0].line == 7
+    # the evidence chain names both hops with file:line
+    chain = findings[0].chains[0]
+    assert any("_dispatch_loop" in step for step in chain)
+    assert any(":7" in step and "recv" in step for step in chain)
+
+
+def test_bounded_dispatch_loop_is_clean(tmp_path):
+    findings = _lint(tmp_path, ("ok.py", '''
+        import time
+
+        class Srv:
+            def _dispatch_loop(self):
+                self._work.wait(0.25)
+                time.sleep(0.002)
+                if self._lock.acquire(blocking=False):
+                    pass
+    '''))
+    assert findings == []
+
+
+def test_allow_blocking_reachable_marker_silences(tmp_path):
+    findings = _lint(tmp_path, ("allowed.py", '''
+        class Srv:
+            def _dispatch_loop(self):
+                # fd is nonblocking here by construction (fixture).
+                # datlint: allow-blocking-reachable(socket)
+                self.sock.recv(4096)
+    '''))
+    assert findings == []
+
+
+def test_blocking_outside_any_dispatcher_is_not_a_finding(tmp_path):
+    # the rule certifies dispatch loops, not the whole program: a
+    # session thread may block by contract
+    findings = _lint(tmp_path, ("free.py", '''
+        def session_thread(sock):
+            sock.recv(4096)
+    '''))
+    assert findings == []
+
+
+# -- callback-escape ----------------------------------------------------------
+
+def test_user_callback_on_dispatcher_thread_fires(tmp_path):
+    findings = _lint(tmp_path, ("cb.py", '''
+        class Hub:
+            def _dispatch_loop(self):
+                self.on_done(3)
+    '''))
+    assert [f.rule for f in findings] == ["callback-escape"]
+    assert "on_done" in findings[0].message
+
+
+def test_allow_callback_escape_marker_silences(tmp_path):
+    findings = _lint(tmp_path, ("cba.py", '''
+        class Hub:
+            def _dispatch_loop(self):
+                # audited: fixture sink contract.
+                # datlint: allow-callback-escape
+                self.on_done(3)
+    '''))
+    assert findings == []
+
+
+def test_callback_on_session_thread_is_not_an_escape(tmp_path):
+    findings = _lint(tmp_path, ("sess.py", '''
+        class Hub:
+            def deliver(self):
+                self.on_done(3)
+    '''))
+    assert findings == []
+
+
+# -- the certificate ----------------------------------------------------------
+
+def test_certificate_is_deterministic_and_byte_stable(tmp_path):
+    files = (("srv.py", '''
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self._work = threading.Event()
+
+            def _dispatch_loop(self):
+                self._work.wait(0.5)
+                self._emit()
+
+            def _emit(self):
+                self.sock.sendall(b"x")
+    '''),)
+    _write(tmp_path, *files)
+    docs = []
+    for _ in range(2):
+        # a FRESH project per render: memoized indices must not be the
+        # only reason the bytes agree
+        idx = ReadinessIndex.get(Project.from_paths([tmp_path]))
+        docs.append(json.dumps(render_event_loop_surface(idx),
+                               indent=2, sort_keys=True))
+    assert docs[0] == docs[1]
+    doc = json.loads(docs[0])
+    assert doc["levels"] == ["nonblocking", "bounded-blocking",
+                             "unbounded-blocking"]
+    # the fixture tree has none of the real entry points: every named
+    # spec must be reported missing, never silently dropped
+    missing = {m["entry"] for m in doc["missing_entry_points"]}
+    assert "hub-dispatch" in missing and "sidecar-session" in missing
+    # the fixture dispatcher still certifies (by name pattern)
+    entries = {e["entry"]: e for e in doc["entry_points"]}
+    assert "Srv._dispatch_loop" in entries
+    e = entries["Srv._dispatch_loop"]
+    assert e["enforced"] is True
+    assert e["classification"] == "unbounded-blocking"
+    assert e["certified"] is False
+    assert e["unbounded"][0]["call"] == "self.sock.sendall(...)"
+    assert e["unbounded"][0]["chain"]  # file:line evidence present
+
+
+def test_checked_in_certificate_shape(tmp_path):
+    # structural invariants every consumer (ROADMAP item 2 diffing,
+    # the tier-1 byte-match test) relies on
+    _write(tmp_path, ("loop.py", '''
+        class S:
+            def _dispatch_loop(self):
+                self._q.wait(0.1)
+    '''))
+    doc = render_event_loop_surface(
+        ReadinessIndex.get(Project.from_paths([tmp_path])))
+    assert set(doc) == {"version", "generator", "levels", "summary",
+                        "entry_points", "missing_entry_points",
+                        "unbounded_functions"}
+    assert doc["version"] == 1
+    counts = doc["summary"]
+    assert counts["functions"] == (counts["nonblocking"]
+                                   + counts["bounded-blocking"]
+                                   + counts["unbounded-blocking"])
+
+
+# -- CLI: --format json|sarif, --write-artifacts ------------------------------
+
+BAD_TREE = ('''
+    class Srv:
+        def _dispatch_loop(self):
+            self.sock.recv(4096)
+''')
+
+
+def test_format_json_round_trips_findings(tmp_path, capsys):
+    _write(tmp_path, ("srv.py", BAD_TREE))
+    rc = datlint_main(["--format", "json", "--rule",
+                       "blocking-reachability", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    expected = [f.to_json() for f in run_paths(
+        [tmp_path], rules=(BlockingReachability(),))]
+    assert doc["findings"] == expected
+    f = doc["findings"][0]
+    assert set(f) == {"rule", "path", "line", "message", "chains"}
+    assert f["rule"] == "blocking-reachability"
+    assert f["chains"][0]  # evidence chain survives the round trip
+
+
+def test_json_flag_is_an_alias_for_format_json(tmp_path, capsys):
+    _write(tmp_path, ("srv.py", BAD_TREE))
+    datlint_main(["--format", "json", "--rule", "blocking-reachability",
+                  str(tmp_path)])
+    via_format = capsys.readouterr().out
+    datlint_main(["--json", "--rule", "blocking-reachability",
+                  str(tmp_path)])
+    assert capsys.readouterr().out == via_format
+
+
+def test_json_flag_contradicting_format_is_a_usage_error(tmp_path):
+    _write(tmp_path, ("ok.py", "X = 1\n"))
+    assert datlint_main(["--json", "--format", "sarif",
+                         str(tmp_path)]) == 2
+
+
+def test_format_sarif_structure(tmp_path, capsys):
+    _write(tmp_path, ("srv.py", BAD_TREE))
+    rc = datlint_main(["--format", "sarif", "--rule",
+                       "blocking-reachability", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "datlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids == {"blocking-reachability"}
+    (res,) = run["results"]
+    findings = run_paths([tmp_path], rules=(BlockingReachability(),))
+    loc = res["locations"][0]["physicalLocation"]
+    assert res["ruleId"] == findings[0].rule
+    assert loc["artifactLocation"]["uri"] == findings[0].path
+    assert loc["region"]["startLine"] == findings[0].line
+    assert res["properties"]["chains"] == [list(c)
+                                           for c in findings[0].chains]
+
+
+def test_sarif_clean_tree_exits_zero_with_no_results(tmp_path, capsys):
+    _write(tmp_path, ("ok.py", "X = 1\n"))
+    rc = datlint_main(["--format", "sarif", "--rule",
+                       "blocking-reachability", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["runs"][0]["results"] == []
+
+
+def test_write_artifacts_regenerates_both_byte_stably(tmp_path, capsys):
+    src = tmp_path / "tree"
+    src.mkdir()
+    (src / "loop.py").write_text(textwrap.dedent('''
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._work = threading.Event()
+
+            def _dispatch_loop(self):
+                with self._lock:
+                    pass
+                self._work.wait(0.1)
+    '''))
+    outs = []
+    for name in ("a", "b"):
+        out = tmp_path / name
+        rc = datlint_main(["--write-artifacts", str(out), str(src)])
+        capsys.readouterr()
+        assert rc == 0
+        assert (out / "lock_graph.json").exists()
+        assert (out / "event_loop_surface.json").exists()
+        outs.append(out)
+    for fname in ("lock_graph.json", "event_loop_surface.json"):
+        a = (outs[0] / fname).read_bytes()
+        b = (outs[1] / fname).read_bytes()
+        assert a == b, f"{fname} is not byte-stable across regeneration"
+        assert a.endswith(b"\n")

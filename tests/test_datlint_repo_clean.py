@@ -84,6 +84,46 @@ def test_lock_graph_artifact_matches_the_tree(tmp_path):
     assert doc["locks"], "lock graph lost its lock table"
 
 
+def test_event_loop_surface_artifact_matches_the_tree(tmp_path):
+    from dat_replication_protocol_tpu.analysis.__main__ import \
+        write_event_loop_surface
+
+    artifact = REPO_ROOT / "artifacts" / "event_loop_surface.json"
+    assert artifact.exists(), (
+        "artifacts/event_loop_surface.json is missing — regenerate "
+        "with python -m dat_replication_protocol_tpu.analysis "
+        "--write-artifacts artifacts")
+    fresh = tmp_path / "event_loop_surface.fresh.json"
+    write_event_loop_surface(Project.from_paths([PACKAGE_ROOT]), fresh)
+    assert fresh.read_bytes() == artifact.read_bytes(), (
+        "the checked-in event-loop readiness certificate no longer "
+        "matches the tree (a blocking site, callback edge, or entry "
+        "point moved): review the diff, then regenerate with "
+        "--write-artifacts artifacts — ROADMAP item 2 is a diff of "
+        "this certificate")
+    doc = json.loads(artifact.read_text("utf-8"))
+    # a named entry point the analyzer cannot find anymore is a LOUD
+    # hole, not a thinner certificate
+    assert doc["missing_entry_points"] == [], (
+        "entry points vanished from the certificate: "
+        f"{doc['missing_entry_points']}")
+    # the acceptance bar of ISSUE 16: both production dispatch loops
+    # certify clean — every reachable unbounded site and callback
+    # carries an audited allow marker
+    by_entry = {e["entry"]: e for e in doc["entry_points"]}
+    for entry in ("hub-dispatch", "fanout-dispatch"):
+        e = by_entry[entry]
+        assert e["enforced"] and e["certified"], (
+            f"{entry} lost its readiness certification")
+        assert e["classification"] != "unbounded-blocking"
+    # the surfaces the item-2 rewrite must absorb are enumerated with
+    # evidence, not empty: an empty enumeration means the analyzer
+    # went blind, not that the code got clean overnight
+    assert by_entry["sidecar-subscriber"]["unbounded"], (
+        "sidecar-subscriber's remaining unbounded sites vanished — "
+        "analyzer scope regression?")
+
+
 def test_registry_ships_the_incident_rules():
     # the gate is only as strong as the registry: losing a rule from
     # ALL_RULES would turn the clean-run above into a weaker check
@@ -100,6 +140,9 @@ def test_registry_ships_the_incident_rules():
         "lock-order",
         "blocking-under-lock",
         "guarded-state",
+        "blocking-reachability",
+        "callback-escape",
+        "stale-suppression",
     }
 
 

@@ -1175,3 +1175,96 @@ def test_sidecar_snapshot_cli_flags(capsys):
         sidecar.main(["--stdio", "--snapshot", "x.bin", "--hub"])
     err = capsys.readouterr().err
     assert "--snapshot cannot combine" in err
+
+
+# -- blocking-reachability regression tests (ISSUE 16) ------------------------
+# The readiness certifier (artifacts/event_loop_surface.json) found two
+# true positives: StatsEmitter's EAGAIN/deadline machinery only engages
+# on a NONBLOCKING fd, and the subscriber refusal path sendall()'d on a
+# default-blocking socket.  These tests prove the bounds are real — on
+# the pre-fix code both hang forever, so each runs the suspect call on
+# a daemon thread and asserts it RETURNS instead of letting a
+# regression wedge the whole suite.
+
+
+def test_stats_emitter_full_pipe_skips_within_grace_bound():
+    """A stats pipe nobody drains must cost one 2 s grace period and a
+    clean skip — not a parked emitter thread (the certifier's StatsEmitter
+    true positive: os.write on a blocking pipe ignores the deadline)."""
+    import json
+    import os
+
+    r, w = os.pipe()
+    emitter = sidecar.StatsEmitter(w, interval=60.0)  # thread NOT started
+    try:
+        # fill the pipe to the last byte so the very first write gets
+        # EAGAIN (a partial first write would latch the torn-line arm,
+        # which is a different — also bounded — path)
+        assert not os.get_blocking(w), (
+            "StatsEmitter must flip its fd nonblocking up front; a "
+            "blocking pipe makes the 2 s grace period fictional")
+        for chunk in (65536, 1):
+            while True:
+                try:
+                    os.write(w, b"x" * chunk)
+                except BlockingIOError:
+                    break
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.update(
+                ok=emitter.dump_once(), took=time.monotonic() - t0),
+            daemon=True)
+        t0 = time.monotonic()
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), (
+            "dump_once wedged on a full pipe — the grace bound is gone")
+        # clean skip: nothing of the record was written, emitter alive
+        assert result["ok"] is True
+        assert result["took"] < 8
+        # the skip must not have latched the emitter dead: drain the
+        # filler and the next dump emits a complete JSON line
+        os.set_blocking(r, False)
+        while True:
+            try:
+                if not os.read(r, 65536):
+                    break
+            except BlockingIOError:
+                break
+        assert emitter.dump_once() is True
+        line = b""
+        while not line.endswith(b"\n"):
+            line += os.read(r, 65536)
+        rec = json.loads(line[line.index(b"{"):].decode())
+        assert "metrics" in rec
+    finally:
+        os.close(r)
+        os.close(w)
+
+
+def test_refusal_send_to_wedged_subscriber_is_bounded(monkeypatch):
+    """A refusal record sent to a subscriber that never reads must give
+    up after _REFUSAL_SEND_TIMEOUT — the accept loop runs refusals
+    inline, so an unbounded sendall here wedges admission for every
+    later subscriber (the certifier's subscriber-path true positive)."""
+    monkeypatch.setattr(sidecar, "_REFUSAL_SEND_TIMEOUT", 0.5)
+    a, b = socket.socketpair()
+    try:
+        # shrink both kernel buffers so a fat record overfills them;
+        # b is never read — the classic wedged-peer shape
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        out = {"type": "refusal", "reason": "fanout_busy",
+               "detail": "x" * (1 << 20)}
+        t = threading.Thread(
+            target=sidecar._send_refusal, args=(a, out), daemon=True)
+        t0 = time.monotonic()
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), (
+            "_send_refusal wedged on an unread socket — the send "
+            "timeout bound is gone")
+        assert time.monotonic() - t0 < 8
+    finally:
+        a.close()
+        b.close()
