@@ -40,6 +40,13 @@ The headline metric is config 3 (the 50 GiB/s north-star target);
   14 gossip_converge  N-replica epidemic anti-entropy: rounds/seconds
                   to byte-identical replicas and total wire bytes vs
                   divergence size at N in {4, 16, 64} (ISSUE 15)
+  15 edge_scaling  C10k control plane: 1/100/1k/10k concurrent
+                  mixed-QoS sessions through ONE event-driven edge
+                  loop — peak table occupancy, finish-flood
+                  sessions/s, p99, admission/shed counts (must stay
+                  zero on a properly sized hub; ISSUE 17).  Not in
+                  the default set: request with BENCH_CONFIGS=15
+                  (the 10k cohort spawns a client subprocess)
 
 Robustness (round-1 failure was a backend-init crash that cost the round
 its only perf artifact): device-backend init is retried with backoff and
@@ -59,7 +66,7 @@ BENCH_FANOUT_PEERS / BENCH_FANOUT_STALL_S (config 10),
 BENCH_SNAPSHOT_MIB / BENCH_SNAPSHOT_JOINERS / BENCH_SNAPSHOT_STALE
 (config 12), BENCH_PUMP_MIB / BENCH_PUMP_REPS / BENCH_PUMP_SESSIONS
 (config 13), BENCH_GOSSIP_N / BENCH_GOSSIP_RECORDS /
-BENCH_GOSSIP_DIVERGENCE (config 14).
+BENCH_GOSSIP_DIVERGENCE (config 14), BENCH_EDGE_N (config 15).
 """
 
 from __future__ import annotations
@@ -2533,6 +2540,245 @@ def bench_gossip_converge(quick: bool, backend: str) -> dict:
     }
 
 
+def _edge_client_main(n: int, port: int, wire_hex: str) -> None:
+    """The client half of config 15, run as a SUBPROCESS (its own
+    RLIMIT_NOFILE budget: N concurrent sessions need N client fds plus
+    N server fds, and the container's hard cap cannot carry both sides
+    of 10k in one process).  Protocol on the pipe: print ``HELD k``
+    when the whole cohort is connected and parked mid-wire, wait for
+    ``GO`` on stdin, finish the flood, print one JSON result line."""
+    import selectors as _selectors
+    import socket as _socket
+
+    wire = bytes.fromhex(wire_hex)
+    half = len(wire) // 2
+    addr = ("127.0.0.1", port)
+    CONNECT_CHUNK = 128  # outstanding connects: stay under the backlog
+    sel = _selectors.DefaultSelector()
+    # client FSM rows: [sock, state, t_sent, latency, reply_bytes]
+    clients = []
+    t_ramp0 = time.perf_counter()
+    started = 0
+    held = 0
+    failures = 0
+    deadline = time.monotonic() + 300
+    # -- ramp: connect everyone, send HALF the wire, park -------------
+    while held + failures < n:
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"edge_scaling ramp stuck at {held}/{n}")
+        while started < n and (started - held - failures) < CONNECT_CHUNK:
+            s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            s.setblocking(False)
+            s.connect_ex(addr)
+            row = [s, "connecting", 0.0, 0.0, 0]
+            clients.append(row)
+            sel.register(s, _selectors.EVENT_WRITE, row)
+            started += 1
+        for skey, _mask in sel.select(0.05):
+            row = skey.data
+            if row[1] != "connecting":
+                continue
+            s = row[0]
+            err = s.getsockopt(_socket.SOL_SOCKET, _socket.SO_ERROR)
+            sel.unregister(s)
+            if err:
+                s.close()
+                row[1] = "failed"
+                failures += 1
+                continue
+            s.sendall(wire[:half])
+            row[1] = "held"
+            held += 1
+    ramp_s = time.perf_counter() - t_ramp0
+    print(f"HELD {held}", flush=True)
+    if sys.stdin.readline().strip() != "GO":
+        raise RuntimeError("edge client: no GO from the bench driver")
+    # -- finish flood: the measured phase -----------------------------
+    t0 = time.perf_counter()
+    reading = 0
+    for row in clients:
+        if row[1] != "held":
+            continue
+        s = row[0]
+        s.sendall(wire[half:])
+        s.shutdown(_socket.SHUT_WR)
+        row[1] = "reading"
+        row[2] = time.perf_counter()
+        sel.register(s, _selectors.EVENT_READ, row)
+        reading += 1
+    done = 0
+    while done < reading:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"edge_scaling finish stuck at {done}/{reading}")
+        for skey, _mask in sel.select(0.05):
+            row = skey.data
+            s = row[0]
+            try:
+                data = s.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                data = b""
+            if data:
+                row[4] += len(data)
+                continue
+            row[3] = time.perf_counter() - row[2]
+            row[1] = "done"
+            sel.unregister(s)
+            s.close()
+            done += 1
+    finish_s = time.perf_counter() - t0
+    sel.close()
+    ok = sum(1 for row in clients if row[1] == "done" and row[4] > 0)
+    lats = sorted(row[3] for row in clients if row[1] == "done")
+    p99 = lats[max(0, int(0.99 * (len(lats) - 1)))] if lats else 0.0
+    print(json.dumps({
+        "held": held, "failures": failures, "done": done, "ok": ok,
+        "ramp_s": round(ramp_s, 3), "finish_s": round(finish_s, 3),
+        "p99_s": round(p99, 4),
+    }), flush=True)
+
+
+def bench_edge_scaling(quick: bool, backend: str) -> dict:
+    """Config 15 (ISSUE 17): the C10k claim — 1/100/1k/10k concurrent
+    mixed-QoS-class sessions through ONE event-driven edge loop.
+
+    Every client connects and parks mid-wire until the whole cohort is
+    admitted (peak table occupancy == N, verified from the loop's own
+    snapshot), then the cohort finishes at once: the finish flood is
+    the measured phase.  Headline: finish-phase sessions/s at the top
+    N; the budget gate additionally holds ``ok_fraction`` at 1.0 and
+    admission-ladder counts (rejected/shed) at ZERO — overload
+    machinery must stay dark on a properly sized hub, at every scale.
+    The client cohort runs in a subprocess (fd budget: N sessions are
+    N fds on EACH side)."""
+    import subprocess
+    import threading
+
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.edge import EdgeLoop
+    from dat_replication_protocol_tpu.hub import ReplicationHub
+
+    ns_env = os.environ.get("BENCH_EDGE_N")
+    counts = [int(x) for x in ns_env.split(",")] if ns_env else (
+        [1, 100, 1000] if quick else [1, 100, 1000, 10000])
+
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = max(counts) + 512
+    if soft < want:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want, hard), hard))
+        except (ValueError, OSError):
+            pass
+        soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    dropped = [n for n in counts if n + 512 > soft]
+    if dropped:
+        log(f"bench[edge_scaling]: fd limit {soft} drops counts "
+            f"{dropped} (needs 1 fd/session + slack per side)")
+        counts = [n for n in counts if n + 512 <= soft] or [1]
+
+    # one tiny session wire, built untimed: a single change frame —
+    # this config measures the TABLE (admission, readiness, teardown
+    # at scale), not byte throughput (config 13 owns that)
+    e = protocol.encode()
+    e.change({"key": "edge-bench", "change": 0, "from": 0, "to": 1,
+              "value": b"v" * 64})
+    e.finalize()
+    parts = []
+    while True:
+        d = e.read(1 << 16)
+        if d is None:
+            break
+        parts.append(d)
+    wire = b"".join(parts)
+
+    res: dict = {}
+    for n in counts:
+        hub = ReplicationHub(max_sessions=n + 8, linger_s=0.002)
+        qos_of = lambda i, peer, mode: \
+            "latency" if i % 2 else "throughput"  # noqa: E731
+        loop = EdgeLoop(hub, qos_of=qos_of, max_sessions=n, tick=0.02,
+                        drain_timeout=60.0)
+        port = loop.bind("127.0.0.1", 0)
+        server = threading.Thread(target=loop.serve, daemon=True)
+        server.start()
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--edge-client", str(n), str(port), wire.hex()],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+        try:
+            line = proc.stdout.readline().strip()
+            if not line.startswith("HELD "):
+                raise RuntimeError(f"edge client died during ramp: "
+                                   f"{line!r}")
+            held = int(line.split()[1])
+            # peak occupancy: every held session sits in the ONE table
+            # — wait for the accept side to drain its backlog (held
+            # sessions cannot finish: half their wire is missing)
+            deadline = time.monotonic() + 120
+            peak = loop.snapshot()["sessions"]
+            while peak < held and time.monotonic() < deadline:
+                time.sleep(0.01)
+                peak = max(peak, loop.snapshot()["sessions"])
+            proc.stdin.write("GO\n")
+            proc.stdin.flush()
+            out = json.loads(proc.stdout.readline())
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            loop.close()
+        server.join(30)
+        snap = loop.snapshot()
+        hub.close()
+        finish_s = out["finish_s"]
+        res[n] = {
+            "sessions_s": (round(out["done"] / finish_s, 1)
+                           if finish_s else 0.0),
+            "p99_s": out["p99_s"],
+            "ramp_s": out["ramp_s"],
+            "finish_s": finish_s,
+            "peak_sessions": peak,
+            "ok": out["ok"],
+            "admitted": snap["admitted"],
+            "rejected": snap["rejected"],
+            "shed": snap["shed"],
+        }
+        log(f"bench[edge_scaling]: n={n} peak={peak} "
+            f"{res[n]['sessions_s']}/s p99={out['p99_s'] * 1e3:.1f}ms "
+            f"(ramp {out['ramp_s']:.2f}s, finish {finish_s:.2f}s, "
+            f"ok {out['ok']})")
+    top = max(counts)
+    total_ok = sum(res[n]["ok"] for n in counts)
+    return {
+        "metric": "edge_scaling_sessions_per_s",
+        # the headline: finish-flood completions/s at the LARGEST
+        # concurrent cohort
+        "value": res[top]["sessions_s"],
+        "unit": "sessions/s",
+        "vs_baseline": None,
+        "ns": counts,
+        "wire_bytes": len(wire),
+        # the C10k acceptance row: cohort size the ONE table actually
+        # held at once, and the clean-completion fraction
+        "peak_sessions_top": res[top]["peak_sessions"],
+        "ok_fraction": round(total_ok / sum(counts), 6),
+        "p99_s_top": res[top]["p99_s"],
+        "rejected_total": sum(res[n]["rejected"] for n in counts),
+        "shed_total": sum(res[n]["shed"] for n in counts),
+        **{f"sessions_s_{n}": res[n]["sessions_s"] for n in counts},
+        **{f"p99_s_{n}": res[n]["p99_s"] for n in counts},
+        **{f"peak_{n}": res[n]["peak_sessions"] for n in counts},
+        "reduced_config": top < 10000,
+        "full_config": "1/100/1k/10k concurrent mixed-QoS sessions "
+                       "through one edge loop on host",
+    }
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -2551,6 +2797,7 @@ BENCHES = {
     "12": ("snapshot_bootstrap", bench_snapshot_bootstrap),
     "13": ("wire_pump", bench_wire_pump),
     "14": ("gossip_converge", bench_gossip_converge),
+    "15": ("edge_scaling", bench_edge_scaling),
 }
 
 
@@ -2701,6 +2948,13 @@ def main() -> None:
     import contextlib
     import threading
 
+    if sys.argv[1:2] == ["--edge-client"]:
+        # config 15's client cohort, re-invoked as a subprocess: the fd
+        # budget (1 fd/session/process) is why this is not a thread
+        _edge_client_main(int(sys.argv[2]), int(sys.argv[3]),
+                          sys.argv[4])
+        return
+
     quick = "--quick" in sys.argv
     if "--metrics" in sys.argv:
         _metrics_on()
@@ -2781,7 +3035,7 @@ def main() -> None:
     # the TPU watch script, which only fires when the tunnel answers)
     for key in which:
         if key in ("1", "2", "6", "7", "8", "9", "10", "11", "12", "13",
-                   "14"):
+                   "14", "15"):
             run_config(key, "host")
 
     # priority order for the device leg: the headline hash config first,
@@ -2791,7 +3045,7 @@ def main() -> None:
     device_keys = sorted(
         (k for k in which
          if k not in ("1", "2", "6", "7", "8", "9", "10", "11", "12",
-                      "13", "14")),
+                      "13", "14", "15")),
         key=lambda k: priority.get(k, 9)
     )
     if device_keys:

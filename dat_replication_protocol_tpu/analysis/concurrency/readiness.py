@@ -95,6 +95,8 @@ _TIMEOUTISH_NAME = re.compile(
 ENTRY_SPECS = (
     ("hub-dispatch", "hub/engine.py", "ReplicationHub._dispatch_loop",
      "dispatcher"),
+    ("edge-dispatch", "edge/loop.py", "EdgeLoop._dispatch_loop",
+     "dispatcher"),
     ("fanout-dispatch", "fanout/server.py", "FanoutServer._dispatch_loop",
      "dispatcher"),
     ("sidecar-session", "sidecar.py", "run_session", "session"),

@@ -33,12 +33,26 @@ from ..session.reconnect import BackoffPolicy
 from ..wire.framing import ProtocolError
 from .node import ReplicaNode, classify_error
 
-__all__ = ["GossipDriver", "serve_responder_session"]
+__all__ = ["GossipDriver", "serve_responder_session",
+           "absorb_responder_stats"]
 
 _M_DIALS = _counter("gossip.dials")
 
 DEFAULT_INTERVAL = 1.0
 DIAL_TIMEOUT = 10.0
+
+
+def absorb_responder_stats(node: ReplicaNode, stats: dict) -> dict:
+    """Fold one completed responder exchange into the node: absorb the
+    initiator's records, stamp ``applied``, count repairs shipped.
+    Shared by the threaded :func:`serve_responder_session` and the
+    event-driven edge's replica sessions (ISSUE 17) — the mutation
+    rides the node's own lock inside ``absorb`` either way."""
+    applied = node.absorb(stats["received"]) if stats["received"] else 0
+    stats["applied"] = applied
+    if stats.get("records_sent"):
+        node.stats["repairs_sent"] += stats["records_sent"]
+    return stats
 
 
 def serve_responder_session(node: ReplicaNode, read_bytes, write_bytes,
@@ -50,11 +64,7 @@ def serve_responder_session(node: ReplicaNode, read_bytes, write_bytes,
     a failed decode."""
     stats = run_responder(node.replica, read_bytes, write_bytes,
                           close_write=close_write)
-    applied = node.absorb(stats["received"]) if stats["received"] else 0
-    stats["applied"] = applied
-    if stats.get("records_sent"):
-        node.stats["repairs_sent"] += stats["records_sent"]
-    return stats
+    return absorb_responder_stats(node, stats)
 
 
 class GossipDriver:

@@ -1,0 +1,9 @@
+"""The event-driven edge (ISSUE 17): ONE selector/epoll session table
+serving hub sessions, broadcast subscribers, reconcile/snapshot
+responders, and gossip exchanges from a single loop, with the staged
+overload ladder (admission -> per-session windows -> heaviest-offender
+shed) preserved verbatim.  See DESIGN.md "The event-driven edge"."""
+
+from .loop import QOS_PRESETS, EdgeLoop, serve_edge
+
+__all__ = ["EdgeLoop", "serve_edge", "QOS_PRESETS"]

@@ -1,0 +1,213 @@
+"""Per-session protocol machines for the event-driven edge.
+
+Each machine is the THREADED sidecar leg with its threads removed: the
+same encoder/decoder wiring, the same hub/fanout/driver calls, the same
+structured record shapes — only the byte movement moved out (the loop
+steps :func:`~..session.pump.recv_step` / ``send_step`` per selector
+turn where the threaded legs ran blocking pumps).  The chaos parity
+sweep (tests/test_edge_chaos.py) holds the two shapes byte-identical;
+ROBUSTNESS.md restates the overload contract for this table.
+
+Analyzer shape (ANALYSIS.md): these constructors are called by
+``EdgeLoop._dispatch_loop`` as imported module-level functions, so the
+blocking-reachability certifier walks them — every callback
+registration below carries its audited ``allow-callback-escape``
+marker, and nothing here blocks: the hooks only flip encoder/decoder
+state or note flags the loop polls.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..obs.watermarks import WATERMARKS as _WATERMARKS
+from ..sidecar import DIGEST_SUBSET_BLOB, DIGEST_SUBSET_CHANGE
+
+__all__ = ["HubMachine", "ResponderMachine", "hub_machine",
+           "reconcile_machine", "replica_machine", "snapshot_machine"]
+
+
+class HubMachine:
+    """State for one edge hub session (the ``run_session`` leg): the
+    tpu decoder rides a ``nowait`` hub registration, digests route back
+    through :meth:`HubSession.poll` on the loop thread, and the
+    flush-before-finalize barrier is the LOOP's (``rx_finalized`` +
+    ``HubSession.drained`` gate ``enc.finalize``)."""
+
+    __slots__ = ("enc", "dec", "hub_session", "wm_link", "digests",
+                 "rx_finalized", "shed_rejected")
+
+    def __init__(self, enc, dec, hub_session, wm_link: str):
+        self.enc = enc
+        self.dec = dec
+        self.hub_session = hub_session
+        self.wm_link = wm_link
+        self.digests = 0
+        self.rx_finalized = False
+        self.shed_rejected = False
+
+    def record(self, tx_done: bool) -> dict:
+        """The ``sidecar.session`` record — field-for-field the
+        threaded ``run_session`` shape (``tx_done`` stands in for "the
+        sender thread exited": the reply fully drained)."""
+        enc, dec = self.enc, self.dec
+        out = {
+            "changes": dec.changes,
+            "blobs": dec.blobs,
+            "bytes": dec.bytes,
+            "digests": self.digests,
+            "ok": (dec.finished and not dec.destroyed
+                   and not enc.destroyed and tx_done),
+        }
+        if self.hub_session is not None:
+            out["session"] = self.hub_session.key
+            out["shed"] = self.hub_session.shed_reason
+            # release the hub slot LAST (the threaded leg's ordering):
+            # queued work drops, in-flight completions discard
+            self.hub_session.close()
+        _WATERMARKS.untrack(self.wm_link)
+        return out
+
+
+def hub_machine(encode: Callable, decode: Callable, hub, session_key: str,
+                weight: float = 1.0) -> HubMachine:
+    """Build one edge hub session: ``encode()``/``decode()`` are the
+    package factories (passed in so this module never imports the
+    package root at call time), ``hub`` the shared
+    :class:`~..hub.ReplicationHub`.  Raises :class:`~..hub.HubBusy`
+    through — admission stage 1 is the HUB's decision, and the loop
+    answers it with the threaded leg's exact rejection record."""
+    hub_session = hub.register(session_key, weight, nowait=True)
+    # the package factories themselves: constructors, not user hooks —
+    # they allocate an Encoder/Decoder and return (no I/O, no waits)
+    # datlint: allow-callback-escape
+    enc = encode()  # reply stream: plain host encoder (digest payloads)
+    # datlint: allow-callback-escape
+    dec = decode(backend="tpu", pipeline=hub_session)
+    m = HubMachine(enc, dec, hub_session, session_key)
+    dec.watermark(session_key)
+
+    def on_digest(kind: str, seq: int, digest: bytes) -> None:
+        # the threaded leg's Change shape verbatim; no flushed.wait —
+        # reply backpressure is the loop's poll gate (enc.writable()
+        # False parks completions in the hub, parked bytes grow, the
+        # window gate stops reads: the identical ladder, new mechanism)
+        m.digests += 1
+        enc.change({
+            "key": f"{kind}-{seq}",
+            "change": seq,
+            "from": 0,
+            "to": 1,
+            "value": digest,
+            "subset": DIGEST_SUBSET_CHANGE if kind == "change"
+            else DIGEST_SUBSET_BLOB,
+        })
+
+    # digest hook runs on the LOOP thread (inside HubSession.poll):
+    # enc.change only appends to the reply queue, never blocks
+    # datlint: allow-callback-escape
+    dec.on_digest(on_digest)
+
+    def _note_finalized(done) -> None:
+        # the decoder's flush-before-finalize flush is nowait: note the
+        # request stream finalized and let the LOOP hold the barrier
+        # (enc.finalize waits for HubSession.drained)
+        m.rx_finalized = True
+        done()
+
+    dec.finalize(_note_finalized)
+    # error hooks, not user code: destroy() flips state and wakes
+    # watchers — never blocks the loop
+    # datlint: allow-callback-escape
+    dec.on_error(lambda _e: enc.destroy())
+    # datlint: allow-callback-escape
+    enc.on_error(lambda _e: None if dec.destroyed else dec.destroy())
+    return m
+
+
+class ResponderMachine:
+    """State for one edge responder session (reconcile / replica /
+    snapshot): wraps the driver machine's ``(enc, dec, finish)`` and
+    renders the threaded leg's record shape on teardown."""
+
+    __slots__ = ("enc", "dec", "_finish", "_shape", "peer")
+
+    def __init__(self, enc, dec, finish, shape: Callable, peer: str):
+        self.enc = enc
+        self.dec = dec
+        self._finish = finish
+        self._shape = shape
+        self.peer = peer
+
+    def record(self, error: Optional[BaseException] = None) -> dict:
+        """Finish the driver machine and render the session record —
+        the threaded legs' ``try/except (ProtocolError, OSError)``
+        collapse, with ``error`` standing in for a transport exception
+        the loop already observed."""
+        from ..wire.framing import ProtocolError
+
+        if error is None:
+            try:
+                return self._shape(self._finish())
+            except (ProtocolError, OSError) as e:
+                error = e
+        return self._shape(None, error)
+
+
+def reconcile_machine(replica, peer: str) -> ResponderMachine:
+    """The ``--reconcile`` leg (``run_reconcile_session``'s shape)."""
+    from ..runtime.reconcile_driver import responder_machine
+
+    enc, dec, finish = responder_machine(replica)
+
+    def shape(stats, error=None) -> dict:
+        if stats is None:
+            return {"reconcile": True, "ok": False, "peer": peer,
+                    "error": f"{type(error).__name__}: {error}"}
+        return {"reconcile": True, "ok": stats["ok"],
+                "symbols": stats["symbols"], "rounds": stats["rounds"],
+                "records_sent": stats["records_sent"],
+                "records_received": len(stats["received"])}
+
+    return ResponderMachine(enc, dec, finish, shape, peer)
+
+
+def replica_machine(node, peer: str) -> ResponderMachine:
+    """The ``--replica`` gossip leg (``run_replica_session``'s shape):
+    received records are absorbed into the LIVE node on completion."""
+    from ..cluster.live import absorb_responder_stats
+    from ..runtime.reconcile_driver import responder_machine
+
+    enc, dec, finish = responder_machine(node.replica)
+
+    def shape(stats, error=None) -> dict:
+        if stats is None:
+            return {"replica": node.key, "ok": False, "peer": peer,
+                    "error": f"{type(error).__name__}: {error}"}
+        stats = absorb_responder_stats(node, stats)
+        return {"replica": node.key, "ok": stats["ok"],
+                "symbols": stats["symbols"], "rounds": stats["rounds"],
+                "records_sent": stats["records_sent"],
+                "applied": stats["applied"]}
+
+    return ResponderMachine(enc, dec, finish, shape, peer)
+
+
+def snapshot_machine(source, peer: str,
+                     link: Optional[str] = None) -> ResponderMachine:
+    """The ``--snapshot`` bootstrap leg (``run_snapshot_session``'s
+    shape), BEGIN already queued on the encoder."""
+    from ..runtime.snapshot_driver import snapshot_responder_machine
+
+    enc, dec, finish = snapshot_responder_machine(source, link=link)
+
+    def shape(stats, error=None) -> dict:
+        if stats is None:
+            return {"snapshot": True, "ok": False, "peer": peer,
+                    "error": f"{type(error).__name__}: {error}"}
+        return {"snapshot": True, "ok": stats["ok"],
+                "cold": stats["cold"], "chunks_sent": stats["chunks_sent"],
+                "chunk_bytes_sent": stats["chunk_bytes_sent"],
+                "symbols": stats["symbols"], "rounds": stats["rounds"]}
+
+    return ResponderMachine(enc, dec, finish, shape, peer)

@@ -124,11 +124,14 @@ class _SessionState:
         "out_items", "out_bytes", "comp", "comp_items", "comp_bytes",
         "submitted", "submitted_bytes", "delivered", "delivered_bytes",
         "dispatches", "shed", "shed_parked", "gone", "flush_goal",
+        "nowait",
     )
 
-    def __init__(self, key: str, weight: float, lock: threading.Lock):
+    def __init__(self, key: str, weight: float, lock: threading.Lock,
+                 nowait: bool = False):
         self.key = key
         self.weight = weight
+        self.nowait = nowait
         self.cv = threading.Condition(lock)
         self.q: deque = deque()   # (kind, item, cb, tag, nbytes)
         self.q_items = 0
@@ -211,6 +214,42 @@ class HubSession:
 
     def flush(self) -> None:
         self._hub._flush_session(self._state)
+
+    # -- nowait surface (the event-driven edge, ISSUE 17) -------------------
+
+    def poll(self) -> int:
+        """One non-blocking completion turn: pop whatever digests have
+        routed back and deliver them (in submit order, on THIS thread —
+        the edge loop's), never waiting.  Returns the count delivered;
+        raises :class:`SessionShed` / :class:`HubError` exactly like
+        ``submit`` when the hub's overload policy hit this session."""
+        return self._hub._poll_session(self._state)
+
+    @property
+    def has_completions(self) -> bool:
+        """Lock-free: are completions waiting for :meth:`poll`?  A
+        plain GIL-atomic attribute read (at worst one update stale) so
+        the edge loop can skip the hub lock for idle sessions."""
+        return self._state.comp_items > 0
+
+    def window_room(self) -> bool:
+        """Lock-free mirror of the submit window check — the SAME
+        predicate ``_submit_run_inner`` gates on, read without the
+        lock.  The edge loop gates READS on this: a full window stops
+        the session's socket from being drained, so the kernel buffer
+        (then the peer's TCP window) absorbs the overload — the
+        identical ladder, enforced by backpressure instead of a
+        blocked thread."""
+        st, hub = self._state, self._hub
+        return st.parked_items < hub.window_items and (
+            st.parked_bytes < hub.window_bytes or st.parked_items == 0)
+
+    @property
+    def drained(self) -> bool:
+        """Lock-free: nothing parked (queued, in-pipeline, or
+        undelivered) — the edge's flush-before-finalize barrier
+        predicate."""
+        return self._state.parked_items == 0
 
     def close(self) -> None:
         """Unregister; queued work is dropped, in-flight completions are
@@ -338,10 +377,19 @@ class ReplicationHub:
     # -- registration / admission -------------------------------------------
 
     def register(self, key: Optional[str] = None,
-                 weight: float = 1.0) -> HubSession:
+                 weight: float = 1.0, *,
+                 nowait: bool = False) -> HubSession:
         """Admit one session.  Raises :class:`HubBusy` (structured) when
         the session count or parked-bytes budget is exhausted — bounded
-        state instead of queue growth is the overload contract."""
+        state instead of queue growth is the overload contract.
+
+        ``nowait=True`` registers an event-driven session (the edge
+        loop's, ISSUE 17): ``submit``/``flush`` never block and never
+        deliver inline — completions are drained by
+        :meth:`HubSession.poll` and the window is enforced by the
+        caller gating reads on :meth:`HubSession.window_room` (the
+        same predicate, applied as backpressure instead of a blocked
+        thread).  Admission and shed policy are identical."""
         if weight <= 0:
             raise ValueError("session weight must be > 0")
         if key is not None and (not key or any(
@@ -382,7 +430,8 @@ class ReplicationHub:
                     parked_budget=self.parked_budget,
                 )
             else:
-                st = _SessionState(key, float(weight), self._lock)
+                st = _SessionState(key, float(weight), self._lock,
+                                   nowait=nowait)
                 self._sessions[key] = st
                 sessions_now = len(self._sessions)
                 if _OBS.on:
@@ -460,6 +509,34 @@ class ReplicationHub:
 
     def _submit_run_inner(self, st: _SessionState, entries,
                           run_bytes: int, n: int) -> None:
+        if st.nowait:
+            # event-driven session: never wait, never deliver inline.
+            # The caller (the edge loop) gated reads on window_room()
+            # before decoding these entries, so overshoot is bounded by
+            # one read turn's decode product — the same run-granularity
+            # admission the blocking path applies to an oversized run.
+            # Accounting, shed policy, and liveness checks are the
+            # blocking path's verbatim.
+            with self._lock:
+                self._check_session_alive_locked(st)
+                st.q.extend(entries)
+                st.q_items += n
+                st.q_bytes += run_bytes
+                st.submitted += n
+                st.submitted_bytes += run_bytes
+                was_idle = self._q_items == 0
+                self._q_items += n
+                self._q_bytes += run_bytes
+                self._parked_bytes += run_bytes
+                if self._oldest_ts is None:
+                    self._oldest_ts = time.monotonic()
+                if _OBS.on:
+                    _M_PARKED.set(self._parked_bytes)
+                self._maybe_shed_locked()
+                self._check_session_alive_locked(st)
+                if was_idle or self._q_items >= self._max_batch:
+                    self._work.notify_all()
+            return
         while True:
             with self._lock:
                 self._check_session_alive_locked(st)
@@ -506,6 +583,13 @@ class ReplicationHub:
             self._check_session_alive_locked(st)
             st.flush_goal = st.submitted
             self._work.notify_all()
+        if st.nowait:
+            # event-driven session: the flush BARRIER moves to the
+            # caller (the edge defers enc.finalize until the session is
+            # drained); setting the goal above is what matters — the
+            # dispatcher now drains the readback pipeline promptly so
+            # completions land without waiting for the next batch
+            return
         try:
             while True:
                 with self._lock:
@@ -520,6 +604,21 @@ class ReplicationHub:
         finally:
             with self._lock:
                 st.flush_goal = None
+
+    def _poll_session(self, st: _SessionState) -> int:
+        """One non-blocking completion turn for a nowait session (see
+        :meth:`HubSession.poll`): pop under the lock, deliver outside
+        it — the thread delivering is the edge loop's, so a slow
+        digest consumer parks only its own session's turn."""
+        with self._lock:
+            ready = self._pop_completions_locked(st)
+            if not ready:
+                # surface shed/closure HERE (the poll path is the nowait
+                # session's only recurring hub call when the wire is idle)
+                self._check_session_alive_locked(st)
+                return 0
+        self._deliver(st, ready)
+        return len(ready)
 
     def _pop_completions_locked(self, st: _SessionState) -> list:
         if not st.comp:

@@ -355,13 +355,16 @@ class FleetView:
                 "jit_sites": snap.get("jit_sites") or {},
                 "hub": snap.get("hub"),
                 "fanout": snap.get("fanout"),
+                "edge": snap.get("edge"),
             } for name, snap in snaps.items()},
             "errors": errors,
             "links": links,
             "gossip": _join_gossip(snaps, self._gossip_baseline),
-            "shed": _counter_sum(snaps, ("hub.shed", "fanout.peer.shed")),
+            "shed": _counter_sum(snaps, ("hub.shed", "fanout.peer.shed",
+                                         "edge.shed")),
             "rejected": _counter_sum(snaps, ("hub.rejected",
-                                             "fanout.rejected")),
+                                             "fanout.rejected",
+                                             "edge.rejected")),
             "reconcile": {
                 "rounds": _counter_sum(snaps, ("reconcile.rounds",)),
                 "symbols_seen": self._gauge_max(snaps,

@@ -61,13 +61,21 @@ def _slug(reason: str) -> str:
 
 
 def _write_json(path: str, obj) -> None:
+    # ABSORBED (ISSUE 17 satellite): post-mortem bundle writes go to a
+    # local --flight-dir; a dump happens at most max_bundles times per
+    # capture, on the failure path — never on a session's hot path
+    # datlint: allow-blocking-reachable(file-io)
     with open(path, "w", encoding="utf-8") as f:
+        # datlint: allow-blocking-reachable(file-io)
         json.dump(obj, f, default=repr)
 
 
 def _write_jsonl(path: str, records: list) -> None:
+    # ABSORBED: same local-bundle contract as _write_json above
+    # datlint: allow-blocking-reachable(file-io)
     with open(path, "w", encoding="utf-8") as f:
         for rec in records:
+            # datlint: allow-blocking-reachable(file-io)
             f.write(json.dumps(rec, default=repr) + "\n")
 
 
@@ -151,6 +159,8 @@ class FlightRecorder:
             directory = self.dir
             if directory is None:
                 return None
+            # a weakref deref: returns the referent or None, no user code
+            # datlint: allow-callback-escape
             last = (self._last_error() if self._last_error is not None
                     else None)
             if error is not None and error is last:

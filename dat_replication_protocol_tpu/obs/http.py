@@ -104,6 +104,10 @@ def default_healthz(admission_fn: Optional[Callable[[], dict]] = None
     # stage 2: admission (hub/fanout owners install the callable)
     if admission_fn is not None:
         try:
+            # the admission_state contract (datlint healthz check):
+            # lock-free attribute reads only — a health probe must
+            # never block behind an engine lock
+            # datlint: allow-callback-escape
             adm = admission_fn()
         except Exception as e:
             adm = {"open": False, "error": f"{type(e).__name__}: {e}"}

@@ -342,6 +342,10 @@ class Registry:
                 out["histograms"][m.name] = m._snapshot()
         for fn in collectors:
             try:
+                # collectors are snapshot-grade attribute reads (the
+                # hub/fanout/edge `_collect` contract) — best-effort,
+                # absorbed by the except arm below
+                # datlint: allow-callback-escape
                 contributed = fn()
             except Exception:
                 # a dying collector (hub mid-close) must not take the

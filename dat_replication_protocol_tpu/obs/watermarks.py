@@ -211,6 +211,9 @@ class WatermarkBoard:
         offsets = {}
         for role, fn in list(entry.cursors.items()):
             try:
+                # registered cursor getters are attribute reads (wire
+                # offsets) — snapshot-grade, never blocking
+                # datlint: allow-callback-escape
                 offsets[role] = int(fn())
             except Exception:
                 # a dying owner (decoder mid-destroy) must not take the

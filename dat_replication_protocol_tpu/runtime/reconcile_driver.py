@@ -57,7 +57,8 @@ from ..wire.framing import CAP_CHANGE_BATCH, CAP_RECONCILE, ProtocolError, \
     frame_wire_len
 
 __all__ = ["RatelessReplica", "ResponderState", "reconcile_local",
-           "run_initiator", "run_responder", "DEFAULT_BATCH0"]
+           "run_initiator", "run_responder", "responder_machine",
+           "DEFAULT_BATCH0"]
 
 # first symbol batch; each round doubles (the classic rateless
 # schedule: total streamed <= 2x the decode point, log2(k) rounds)
@@ -484,6 +485,9 @@ def run_initiator(replica: RatelessReplica, read_bytes, write_bytes,
 
     dec.reconcile(on_reconcile)
     dec.change(lambda c, done_cb: (received.append(c), done_cb()))
+    # error hook, not user code: destroy() only flips state and wakes
+    # watchers — it never blocks the registering loop
+    # datlint: allow-callback-escape
     dec.on_error(lambda _e: None if enc.destroyed else enc.destroy())
 
     enc.reconcile_frame(rc.encode_begin(replica.n))
@@ -516,17 +520,20 @@ def run_initiator(replica: RatelessReplica, read_bytes, write_bytes,
             "records_sent": stats["records_sent"], "received": received}
 
 
-def run_responder(replica: RatelessReplica, read_bytes, write_bytes,
-                  close_write=None, engine: str = "auto",
-                  overhead_cap: float = DEFAULT_OVERHEAD_CAP,
-                  max_symbols: int = DEFAULT_MAX_SYMBOLS,
-                  chunk_size: int = 64 * 1024) -> dict:
-    """Serve one reconciliation as the responder over a duplex byte
-    pair: decode the initiator's symbol stream, answer MORE/DONE/FAIL,
-    ship this replica's differing records, collect the initiator's.
-    Returns ``{"ok", "symbols", "rounds", "records_sent",
-    "received"}``; raises the session's structured ProtocolError on a
-    failed decode (after tearing both directions down)."""
+def responder_machine(replica: RatelessReplica, *, engine: str = "auto",
+                      overhead_cap: float = DEFAULT_OVERHEAD_CAP,
+                      max_symbols: int = DEFAULT_MAX_SYMBOLS) -> tuple:
+    """The responder's protocol machine, factored off its threads
+    (ISSUE 17): the encoder/decoder pair with the full MORE/DONE/FAIL
+    + record exchange wired, returned as ``(enc, dec, finish)``.  The
+    caller owns byte movement — the threaded :func:`run_responder`
+    pumps them with a sender thread + blocking recv loop, the
+    event-driven edge steps them from ONE selector turn with the same
+    frames on the wire.  ``finish()`` is idempotent: tears down a
+    half-open encoder, raises the session's structured ProtocolError
+    if the decode failed, and returns the stats record both callers
+    emit (``{"ok", "symbols", "rounds", "records_sent",
+    "received"}``)."""
     enc = Encoder(peer_caps=CAP_RECONCILE | CAP_CHANGE_BATCH)
     dec = Decoder()
     state = ResponderState(replica, engine=engine,
@@ -554,8 +561,40 @@ def run_responder(replica: RatelessReplica, read_bytes, write_bytes,
 
     dec.reconcile(on_reconcile)
     dec.change(lambda c, done_cb: (state.note_remote_record(c), done_cb()))
+    # error hook, not user code: destroy() only flips state and wakes
+    # watchers — it never blocks the registering loop
+    # datlint: allow-callback-escape
     dec.on_error(lambda _e: None if enc.destroyed else enc.destroy())
 
+    def finish() -> dict:
+        if not enc.destroyed and not enc.finalized:
+            # peer went away before decode completed: release the
+            # reply pump / drop the reply tail
+            enc.destroy()
+        state.result()  # raises the structured error on a failed session
+        return {"ok": dec.finished and not dec.destroyed,
+                "symbols": state.peeler.symbols_seen,
+                "rounds": state.rounds,
+                "records_sent": sent_records["n"],
+                "received": state.remote_records}
+
+    return enc, dec, finish
+
+
+def run_responder(replica: RatelessReplica, read_bytes, write_bytes,
+                  close_write=None, engine: str = "auto",
+                  overhead_cap: float = DEFAULT_OVERHEAD_CAP,
+                  max_symbols: int = DEFAULT_MAX_SYMBOLS,
+                  chunk_size: int = 64 * 1024) -> dict:
+    """Serve one reconciliation as the responder over a duplex byte
+    pair: decode the initiator's symbol stream, answer MORE/DONE/FAIL,
+    ship this replica's differing records, collect the initiator's.
+    Returns ``{"ok", "symbols", "rounds", "records_sent",
+    "received"}``; raises the session's structured ProtocolError on a
+    failed decode (after tearing both directions down)."""
+    enc, dec, finish = responder_machine(replica, engine=engine,
+                                         overhead_cap=overhead_cap,
+                                         max_symbols=max_symbols)
     sender = threading.Thread(
         target=lambda: send_over(enc, write_bytes, close_write,
                                  chunk_size=chunk_size),
@@ -575,8 +614,4 @@ def run_responder(replica: RatelessReplica, read_bytes, write_bytes,
             # reply pump so the thread does not park forever
             enc.destroy()
         sender.join(timeout=30)
-    state.result()  # raises the structured error on a failed session
-    return {"ok": dec.finished and not dec.destroyed,
-            "symbols": state.peeler.symbols_seen, "rounds": state.rounds,
-            "records_sent": sent_records["n"],
-            "received": state.remote_records}
+    return finish()

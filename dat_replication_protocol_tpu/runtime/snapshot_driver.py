@@ -69,8 +69,8 @@ from ..wire.framing import CAP_SNAPSHOT, ProtocolError, TYPE_SNAPSHOT, \
 
 __all__ = ["SnapshotSource", "SnapshotResponder", "SnapshotJoiner",
            "LogSlice", "snapshot_local", "run_snapshot_responder",
-           "run_snapshot_joiner", "symbol_cap", "DEFAULT_SYMBOL_BATCH0",
-           "DEFAULT_MAX_SYMBOLS"]
+           "snapshot_responder_machine", "run_snapshot_joiner",
+           "symbol_cap", "DEFAULT_SYMBOL_BATCH0", "DEFAULT_MAX_SYMBOLS"]
 
 # first symbol batch; each round doubles (the reconcile-driver schedule)
 DEFAULT_SYMBOL_BATCH0 = 64
@@ -799,6 +799,10 @@ def _send_replies(enc: Encoder, replies, chunk_size: int,
                         writable = enc._push(v, None)
                         at += len(v)
                     if not writable and at < item.end:
+                        # one-shot resume hook fired from the sender's
+                        # read (thread pump) or send turn (edge loop):
+                        # it only re-queues bounded slices, never blocks
+                        # datlint: allow-callback-escape
                         enc.on_drain(lambda i=idx, a=at: pump(i, a))
                         return
                 at = None
@@ -806,22 +810,30 @@ def _send_replies(enc: Encoder, replies, chunk_size: int,
                 enc.snapshot_frame(item)
             idx += 1
         if on_done is not None:
+            # completion hook: the callers' _finish only calls
+            # enc.finalize() — queue state flips, no blocking
+            # datlint: allow-callback-escape
             on_done()
 
     pump()
 
 
-def run_snapshot_responder(source, read_bytes, write_bytes,
-                           close_write=None, *,
-                           batch0: int = DEFAULT_SYMBOL_BATCH0,
-                           chunk_budget: int | None = None,
-                           link: str | None = None,
-                           chunk_size: int = 64 * 1024) -> dict:
-    """Serve one snapshot session as the responder over a duplex byte
-    pair (the :mod:`..session.transport` contract).  Sends BEGIN, then
-    answers the joiner's WANTs until DONE/FAIL; finalizes after the
-    last word.  ``link`` registers the ``snapshot.chunks.sent``
-    watermark role on the fleet plane (PR 11) for live scrapes."""
+def snapshot_responder_machine(source, *,
+                               batch0: int = DEFAULT_SYMBOL_BATCH0,
+                               chunk_budget: int | None = None,
+                               link: str | None = None,
+                               chunk_size: int = 64 * 1024) -> tuple:
+    """The snapshot responder's protocol machine, factored off its
+    threads (ISSUE 17): encoder/decoder pair with BEGIN already queued
+    and the WANT/DONE/FAIL exchange wired, returned as ``(enc, dec,
+    finish)``.  The caller owns byte movement — the threaded
+    :func:`run_snapshot_responder` pumps them, the event-driven edge
+    steps them per selector turn; LogSlice pacing via
+    :meth:`Encoder.on_drain` works under both (the hook fires from
+    whichever side drains the queue).  ``finish()`` is idempotent:
+    tears down a half-open encoder, releases the watermark link,
+    raises ``resp.failed`` if the session failed, and returns the
+    stats record both callers emit."""
     if not isinstance(source, SnapshotSource):
         source = SnapshotSource(source)
     resp = SnapshotResponder(source, batch0=batch0,
@@ -841,11 +853,46 @@ def run_snapshot_responder(source, read_bytes, write_bytes,
         done()
 
     dec.snapshot(on_snapshot)
+    # error hook, not user code: destroy() only flips state and wakes
+    # watchers — it never blocks the registering loop
+    # datlint: allow-callback-escape
     dec.on_error(lambda _e: None if enc.destroyed else enc.destroy())
     if link is not None:
         _WATERMARKS.track("snapshot.chunks.sent", link,
                           lambda: resp.chunk_bytes_sent)
     _send_replies(enc, resp.begin_payloads(), chunk_size)
+
+    def finish() -> dict:
+        if not enc.destroyed and not enc.finalized:
+            # joiner went away before the session completed: release
+            # the reply pump / drop the reply tail
+            enc.destroy()
+        if link is not None:
+            _WATERMARKS.untrack(link)  # idempotent (dict pop)
+        if resp.failed is not None:
+            raise resp.failed
+        return {"ok": resp.finished, "chunks_sent": resp.chunks_sent,
+                "chunk_bytes_sent": resp.chunk_bytes_sent,
+                "symbols": resp.symbols_sent, "rounds": resp.rounds,
+                "cold": resp.cold}
+
+    return enc, dec, finish
+
+
+def run_snapshot_responder(source, read_bytes, write_bytes,
+                           close_write=None, *,
+                           batch0: int = DEFAULT_SYMBOL_BATCH0,
+                           chunk_budget: int | None = None,
+                           link: str | None = None,
+                           chunk_size: int = 64 * 1024) -> dict:
+    """Serve one snapshot session as the responder over a duplex byte
+    pair (the :mod:`..session.transport` contract).  Sends BEGIN, then
+    answers the joiner's WANTs until DONE/FAIL; finalizes after the
+    last word.  ``link`` registers the ``snapshot.chunks.sent``
+    watermark role on the fleet plane (PR 11) for live scrapes."""
+    enc, dec, finish = snapshot_responder_machine(
+        source, batch0=batch0, chunk_budget=chunk_budget, link=link,
+        chunk_size=chunk_size)
 
     sender = threading.Thread(
         target=lambda: send_over(enc, write_bytes, close_write,
@@ -866,14 +913,7 @@ def run_snapshot_responder(source, read_bytes, write_bytes,
             # the reply pump so the thread does not park forever
             enc.destroy()
         sender.join(timeout=30)
-        if link is not None:
-            _WATERMARKS.untrack(link)
-    if resp.failed is not None:
-        raise resp.failed
-    return {"ok": resp.finished, "chunks_sent": resp.chunks_sent,
-            "chunk_bytes_sent": resp.chunk_bytes_sent,
-            "symbols": resp.symbols_sent, "rounds": resp.rounds,
-            "cold": resp.cold}
+    return finish()
 
 
 def run_snapshot_joiner(read_bytes, write_bytes, close_write=None, *,

@@ -63,6 +63,7 @@ from .transport import WAKE_FALLBACK, recv_over, send_over, \
 __all__ = [
     "effective_pump_route", "recv_pump", "send_pump", "pump_reader",
     "pump_writer", "io_for_socket", "send_spans_nb", "probe_caps",
+    "EdgePump", "recv_step", "send_step",
 ]
 
 # receive slab geometry: cap bounds one pump call's batch (and the
@@ -200,6 +201,9 @@ def recv_pump(decoder: Decoder, fd: int,
             # sees the same bytes as one read-only view
             data = memoryview(buf)[:nbytes]
             if tap is not None:
+                # the broadcast tee (FanoutServer.publish): an append +
+                # O(1) mark under the server lock — never blocks
+                # datlint: allow-callback-escape
                 tap(data)
             wake.clear()
             try:
@@ -255,6 +259,8 @@ def send_pump(encoder: Encoder, fd: int,
     stats = np.zeros(2, dtype=np.int64)
     readable = threading.Event()
     encoder._attach_readable(readable.set)
+    # wake hook only: sets an Event, never blocks (ISSUE 17 satellite)
+    # datlint: allow-callback-escape
     encoder.on_error(lambda _e: readable.set())
     try:
         while True:
@@ -284,11 +290,15 @@ def send_pump(encoder: Encoder, fd: int,
             if _OBS.on:
                 _note_batch(int(w), stats)
             if on_progress is not None:
+                # the sidecar's reply-stall clock: one monotonic read
+                # datlint: allow-callback-escape
                 on_progress()
     finally:
         encoder._detach_readable()
         if close is not None:
             try:
+                # a shutdown/close syscall on the way out — bounded
+                # datlint: allow-callback-escape
                 close()
             except OSError:
                 pass
@@ -411,6 +421,160 @@ class SpanGather:
 
     def release(self) -> None:
         self._arrs = []
+
+
+class EdgePump:
+    """Per-session pump state for the event-driven edge (ISSUE 17):
+    the batched-syscall primitives of this module, re-cut as ONE
+    bounded non-blocking turn per call instead of a thread-owned loop.
+
+    ``fd`` MUST be non-blocking — the edge loop sets ``O_NONBLOCK``
+    at admission and never clears it; every kernel call below is
+    bounded by that flag (would-block returns immediately), which is
+    what lets :meth:`EdgeLoop._dispatch_loop` inline these sites and
+    still certify ``bounded-blocking``.  The native route degrades
+    per-call to plain ``os.read``/``os.write`` exactly like the
+    thread pumps (the route that runs is always a route that
+    exists)."""
+
+    __slots__ = ("fd", "cap", "recv_st", "pending", "gather", "native")
+
+    def __init__(self, fd: int, cap: int = PUMP_BUF):
+        self.fd = fd
+        self.cap = cap
+        self.native = effective_pump_route() == "native"
+        self.recv_st = _RecvState(cap) if self.native else None
+        self.gather = SpanGather(cap=1) if self.native else None
+        self.pending: Optional[memoryview] = None  # unsent reply tail
+
+
+def recv_step(pump: EdgePump, decoder: Decoder, tap=None) -> tuple:
+    """ONE bounded receive turn: drain what the kernel already
+    buffered on ``pump.fd`` into ``decoder``, never waiting.  Returns
+    ``(nbytes, eof)``; ``(0, False)`` means would-block (wait for the
+    selector's next READ event).  Native route: one
+    ``dat_pump_recv_scan`` batch (its first ``read`` returns
+    ``-EAGAIN`` on the non-blocking fd instead of sleeping) feeding
+    ``decoder.write_indexed``; Python route: ``os.read`` until
+    ``EAGAIN``, EOF, decoder stall, or the ``PUMP_BUF`` turn budget —
+    a faulted neighbor can cost this session at most one slab of
+    latency per turn."""
+    if pump.native:
+        st = pump.recv_st
+        buf = np.empty(st.cap, dtype=np.uint8)  # fresh: see _RecvState
+        t0 = _perf()
+        r = native.pump_recv_scan(pump.fd, buf, PUMP_SLICE, st.starts,
+                                  st.lens, st.ids, st.stats)
+        if r is None:  # library vanished mid-session (tests reset)
+            pump.native = False
+            return recv_step(pump, decoder, tap)
+        nbytes, nframes, consumed, _err = r
+        if _OBS.on:
+            _H_NATIVE.observe(_perf() - t0)
+        if nbytes in (-11, -4):  # EAGAIN / EINTR: retry next turn
+            return (0, False)
+        if nbytes < 0:
+            raise OSError(-nbytes, os.strerror(-nbytes))
+        if nbytes == 0:
+            return (0, True)
+        if _OBS.on:
+            _note_batch(nbytes, st.stats)
+        data = memoryview(buf)[:nbytes]
+        if tap is not None:
+            # the broadcast tee (FanoutServer.publish): an append +
+            # O(1) mark under the server lock — never blocks the loop
+            # datlint: allow-callback-escape
+            tap(data)
+        try:
+            decoder.write_indexed(data, st.starts, st.lens, st.ids,
+                                  nframes, consumed)
+        except DecoderDestroyedError:
+            pass  # the loop's teardown predicate sees dec.destroyed
+        return (nbytes, False)
+    total = 0
+    while total < pump.cap:
+        try:
+            # bounded: pump.fd is O_NONBLOCK by the EdgePump contract
+            # — a stalled peer surfaces as BlockingIOError, never a
+            # sleeping read under the loop
+            # datlint: allow-blocking-reachable(os-io)
+            data = os.read(pump.fd, PUMP_SLICE)
+        except BlockingIOError:
+            return (total, False)
+        except InterruptedError:
+            continue
+        if not data:
+            return (total, True)
+        total += len(data)
+        if tap is not None:
+            # same broadcast tee as the native arm above
+            # datlint: allow-callback-escape
+            tap(data)
+        try:
+            ok = decoder.write(data)
+        except DecoderDestroyedError:
+            return (total, False)
+        if not ok:
+            return (total, False)  # decoder stall: the loop gates reads
+    return (total, False)
+
+
+# one send turn pushes at most this many pulls — the encoder's
+# high-water mark bounds what it can buffer, this bounds the turn even
+# against a pathological producer
+_SEND_TURN_PULLS = 8
+
+
+def send_step(pump: EdgePump, encoder: Encoder) -> tuple:
+    """ONE bounded send turn: push encoder output to ``pump.fd`` until
+    would-block, the encoder runs dry, or the turn budget.  Returns
+    ``(accepted, finished, blocked)`` — ``finished`` means the encoder
+    is finalized AND fully drained (reply EOF: the loop may shut down
+    the write half); ``blocked`` means the kernel refused bytes we
+    still hold (watch ``EVENT_WRITE``).  Native route:
+    :func:`send_spans_nb` gather batches; Python route: non-blocking
+    ``os.write`` with the partial tail stashed in ``pump.pending``."""
+    accepted = 0
+    for _ in range(_SEND_TURN_PULLS):
+        if pump.pending is None:
+            try:
+                data = encoder.read(PUMP_SEND_CHUNK)
+            except EncoderDestroyedError:
+                return (accepted, True, False)
+            if data is None:  # finalized and drained
+                return (accepted, True, False)
+            if not data:  # nothing ready (producer still appending)
+                return (accepted, False, False)
+            pump.pending = memoryview(data) if not isinstance(
+                data, memoryview) else data
+        view = pump.pending
+        if pump.native:
+            n = pump.gather.fill([view])
+            try:
+                w = send_spans_nb(pump.fd, pump.gather, n)
+            except OSError as e:
+                if e.errno == 38:  # ENOSYS: library vanished, degrade
+                    pump.native = False
+                    continue
+                raise
+            finally:
+                pump.gather.release()
+        else:
+            try:
+                # bounded: pump.fd is O_NONBLOCK by the EdgePump
+                # contract — would-block is an exception, not a sleep
+                # datlint: allow-blocking-reachable(os-io)
+                w = os.write(pump.fd, view)
+            except BlockingIOError:
+                w = 0
+            except InterruptedError:
+                w = 0
+        accepted += w
+        if w < len(view):
+            pump.pending = view[w:] if w else view
+            return (accepted, False, True)
+        pump.pending = None
+    return (accepted, False, False)
 
 
 def send_spans_nb(fd: int, gather: SpanGather, n: int) -> int:

@@ -94,6 +94,11 @@ def retrying(fn: Callable[[], object], policy: BackoffPolicy,
     failures = 0
     while True:
         try:
+            # ABSORBED (ISSUE 17 satellite): the retried operation is
+            # the caller's own bind/accept/dial — its blocking bound is
+            # the caller's contract (kernel timeouts at those sites),
+            # not this wrapper's; the backoff sleeps here ARE bounded
+            # datlint: allow-callback-escape
             return fn()
         except retry_on as e:
             failures += 1

@@ -93,6 +93,8 @@ def send_over(
     """
     readable = threading.Event()
     encoder._attach_readable(readable.set)
+    # wake hook only: sets an Event, never blocks (ISSUE 17 satellite)
+    # datlint: allow-callback-escape
     encoder.on_error(lambda _e: readable.set())
     try:
         while True:
@@ -112,11 +114,17 @@ def send_over(
                      else _M_SEND_WAKE_POLL).inc()
                 readable.clear()
                 continue
+            # ABSORBED into the certificate (docstring above): blocking
+            # here IS the backpressure contract; the bound belongs to
+            # the fd owner (SO_SNDTIMEO, stall teardown), not the pump
+            # datlint: allow-callback-escape
             write_bytes(bytes(data))
     finally:
         encoder._detach_readable()
         if close is not None:
             try:
+                # a shutdown/close syscall on the way out — bounded
+                # datlint: allow-callback-escape
                 close()
             except OSError:
                 pass
@@ -155,6 +163,10 @@ def recv_over(
     decoder._add_drain_watcher(wake.set)
     try:
         while not decoder.destroyed:
+            # ABSORBED into the certificate (docstring above): a silent
+            # peer parks the pump by design; the bound lives with the
+            # fd owner (sidecar stall teardown, gossip SO_RCVTIMEO)
+            # datlint: allow-callback-escape
             data = read_bytes(chunk_size)
             if not data:
                 if not decoder.destroyed and not decoder.finished:
@@ -219,6 +231,10 @@ def write_all(fd: int, data) -> None:
     lesson)."""
     view = memoryview(data)
     while view:
+        # ABSORBED: a full pipe/socket blocking here IS the send-side
+        # backpressure contract (module docstring); callers owning a
+        # bound set it at the fd layer (SO_SNDTIMEO, stall teardown)
+        # datlint: allow-blocking-reachable(os-io)
         view = view[os.write(fd, view):]
 
 

@@ -107,11 +107,12 @@ def test_event_loop_surface_artifact_matches_the_tree(tmp_path):
     assert doc["missing_entry_points"] == [], (
         "entry points vanished from the certificate: "
         f"{doc['missing_entry_points']}")
-    # the acceptance bar of ISSUE 16: both production dispatch loops
-    # certify clean — every reachable unbounded site and callback
-    # carries an audited allow marker
+    # the acceptance bar of ISSUE 16/17: every production dispatch loop
+    # — hub, fanout, and the event-driven edge — certifies clean: each
+    # reachable unbounded site and callback carries an audited allow
+    # marker
     by_entry = {e["entry"]: e for e in doc["entry_points"]}
-    for entry in ("hub-dispatch", "fanout-dispatch"):
+    for entry in ("hub-dispatch", "fanout-dispatch", "edge-dispatch"):
         e = by_entry[entry]
         assert e["enforced"] and e["certified"], (
             f"{entry} lost its readiness certification")

@@ -1,0 +1,437 @@
+"""The event-driven edge (ISSUE 17): ONE epoll session table.
+
+Every test here is the threaded sidecar test restated against
+:class:`~dat_replication_protocol_tpu.edge.EdgeLoop` — same foreign
+clients (raw wire bytes from test_wire_fixtures), same structured
+record shapes, same staged-overload ladder — proving the C10k rewrite
+changed the mechanism and nothing observable.
+"""
+
+import hashlib
+import socket
+import threading
+import time
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.edge import EdgeLoop, QOS_PRESETS, \
+    serve_edge
+from dat_replication_protocol_tpu.hub import ReplicationHub
+
+from test_wire_fixtures import CHANGE_PAYLOAD, SESSION_1, SESSION_4
+
+
+def _decode_reply(raw: bytes) -> list:
+    out = []
+    dec = protocol.decode()
+    dec.change(lambda ch, done: (out.append(ch), done()))
+    dec.write(raw)
+    dec.end()
+    assert dec.finished
+    return out
+
+
+def _recv_all(sock: socket.socket) -> bytes:
+    parts = []
+    while True:
+        d = sock.recv(65536)
+        if not d:
+            return b"".join(parts)
+        parts.append(d)
+
+
+def _start_loop(loop: EdgeLoop) -> tuple:
+    """Bind + serve on a thread; returns (port, thread)."""
+    port = loop.bind("127.0.0.1", 0)
+    t = threading.Thread(target=loop.serve, daemon=True)
+    t.start()
+    return port, t
+
+
+def test_edge_serves_reference_transcript_session_1():
+    hub = ReplicationHub(linger_s=0.002)
+    loop = EdgeLoop(hub, max_sessions=1)
+    try:
+        port, t = _start_loop(loop)
+        c = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c.sendall(SESSION_1)
+        c.shutdown(socket.SHUT_WR)
+        reply = _decode_reply(_recv_all(c))
+        c.close()
+        t.join(timeout=10)
+        assert not t.is_alive()
+    finally:
+        hub.close()
+    assert len(reply) == 1
+    ch = reply[0]
+    assert ch.key == "change-0" and ch.subset == "digest:change"
+    assert ch.value == hashlib.blake2b(
+        CHANGE_PAYLOAD, digest_size=32).digest()
+
+
+def test_edge_blob_and_change_session_4():
+    hub = ReplicationHub(linger_s=0.002)
+    loop = EdgeLoop(hub, max_sessions=1)
+    try:
+        port, t = _start_loop(loop)
+        c = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c.sendall(SESSION_4)
+        c.shutdown(socket.SHUT_WR)
+        reply = _decode_reply(_recv_all(c))
+        c.close()
+        t.join(timeout=10)
+    finally:
+        hub.close()
+    by_key = {ch.key: ch for ch in reply}
+    assert set(by_key) == {"blob-0", "change-0"}
+    assert by_key["blob-0"].value == hashlib.blake2b(
+        b"hello world", digest_size=32).digest()
+    assert by_key["blob-0"].subset == "digest:blob"
+    assert by_key["change-0"].value == hashlib.blake2b(
+        CHANGE_PAYLOAD, digest_size=32).digest()
+
+
+def test_edge_protocol_error_closes_connection():
+    """Hostile bytes observe the destroy cascade + EOF — never a hang,
+    and the loop survives to serve the NEXT session cleanly (the
+    neighbor-isolation half of the contract)."""
+    hub = ReplicationHub(linger_s=0.002)
+    loop = EdgeLoop(hub, max_sessions=2)
+    try:
+        port, t = _start_loop(loop)
+        c = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c.settimeout(15)
+        c.sendall(b"\xff" * 64)  # hostile length varint
+        assert _recv_all(c) == b""
+        c.close()
+        # the loop is still alive: a clean session completes after it
+        c2 = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c2.sendall(SESSION_1)
+        c2.shutdown(socket.SHUT_WR)
+        reply = _decode_reply(_recv_all(c2))
+        c2.close()
+        t.join(timeout=10)
+        assert len(reply) == 1 and reply[0].key == "change-0"
+    finally:
+        hub.close()
+
+
+def test_edge_hub_busy_rejection_is_structured(obs_enabled):
+    """Overload stage 1 through the loop: past the hub's admission
+    bound the client observes EOF with no reply bytes, the edge counts
+    the rejection, and the hub's structured reject event fires — the
+    threaded leg's record, byte-for-byte."""
+    from dat_replication_protocol_tpu.obs.events import EVENTS
+
+    hub = ReplicationHub(max_sessions=1)
+    held = hub.register("occupant")
+    loop = EdgeLoop(hub, max_sessions=1)
+    try:
+        port, t = _start_loop(loop)
+        c = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c.settimeout(15)
+        c.sendall(SESSION_1)
+        assert _recv_all(c) == b""  # EOF, no decoder, no reply
+        c.close()
+        t.join(timeout=10)
+        snap = loop.snapshot()
+        assert snap["rejected"] == 1 and snap["admitted"] == 0
+        recs = [e["fields"] for e in EVENTS.events("sidecar.session")]
+        assert recs and recs[-1] == {
+            "changes": 0, "blobs": 0, "bytes": 0, "digests": 0,
+            "ok": False, "rejected": True, "sessions": 1,
+            "parked_bytes": 0}
+        assert obs_enabled.REGISTRY.counter("edge.rejected").value == 1
+        held.close()
+    finally:
+        hub.close()
+
+
+def test_edge_concurrent_sessions_one_loop(obs_enabled):
+    """N concurrent mixed-QoS hub sessions through ONE loop thread:
+    every reply byte-exact, the session-table snapshot carries the
+    per-class breakdown while they are live, and the per-class gauges
+    ride the registry collector (the fleet-plane satellite)."""
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+
+    N = 8
+    hub = ReplicationHub(linger_s=0.002)
+    qos_of = lambda n, peer, mode: \
+        "latency" if n % 2 else "throughput"  # noqa: E731
+    loop = EdgeLoop(hub, qos_of=qos_of, max_sessions=N)
+    hold = threading.Event()
+    results = {}
+
+    def client(i):
+        c = socket.create_connection(("127.0.0.1", port), timeout=10)
+        half = len(SESSION_4) // 2
+        c.sendall(SESSION_4[:half])
+        hold.wait(10)  # keep every session parked in the table at once
+        c.sendall(SESSION_4[half:])
+        c.shutdown(socket.SHUT_WR)
+        results[i] = _decode_reply(_recv_all(c))
+        c.close()
+
+    try:
+        port, t = _start_loop(loop)
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(N)]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = loop.snapshot()
+            if snap["sessions"] == N:
+                break
+            time.sleep(0.01)
+        snap = loop.snapshot()
+        assert snap["sessions"] == N
+        assert snap["by_class"] == {"latency": N // 2,
+                                    "throughput": N // 2}
+        assert snap["by_kind"] == {"hub": N}
+        reg = obs_metrics.snapshot()
+        assert reg["gauges"]["edge.sessions"] == float(N)
+        assert reg["gauges"]["edge.sessions{class=latency}"] == N // 2
+        adm = loop.admission_state()
+        assert adm["stage"] == "edge" and adm["open"] is True
+        assert adm["hub"]["sessions"] == N
+        hold.set()
+        for th in threads:
+            th.join(15)
+            assert not th.is_alive(), "client HANG"
+        t.join(timeout=10)
+    finally:
+        hold.set()
+        hub.close()
+    blob_digest = hashlib.blake2b(b"hello world", digest_size=32).digest()
+    for i in range(N):
+        by_key = {ch.key: ch for ch in results[i]}
+        assert set(by_key) == {"blob-0", "change-0"}, f"client {i}"
+        assert by_key["blob-0"].value == blob_digest
+
+
+def test_edge_fanout_broadcasts_source_wire_to_subscribers():
+    """The --fanout shape through the loop: first connection claims the
+    source slot (decoded + digested once), later connections subscribe
+    and receive the source's wire byte-exactly — including a late
+    joiner served from retention after seal."""
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    hub = ReplicationHub(linger_s=0.002)
+    fanout = FanoutServer(stall_timeout=10.0)
+    loop = EdgeLoop(hub, fanouts={"main": fanout}, max_sessions=3)
+    try:
+        port, t = _start_loop(loop)
+        addr = ("127.0.0.1", port)
+        src = socket.create_connection(addr, timeout=10)
+        half = len(SESSION_4) // 2
+        src.sendall(SESSION_4[:half])
+        time.sleep(0.2)  # the claim lands before the subscriber dials
+        sub1 = socket.create_connection(addr, timeout=10)
+        src.sendall(SESSION_4[half:])
+        src.shutdown(socket.SHUT_WR)
+        reply = _decode_reply(_recv_all(src))
+        src.close()
+        by_key = {ch.key: ch for ch in reply}
+        assert set(by_key) == {"blob-0", "change-0"}  # digested at source
+        sub2 = socket.create_connection(addr, timeout=10)  # late joiner
+        got1 = _recv_all(sub1)
+        got2 = _recv_all(sub2)
+        sub1.close()
+        sub2.close()
+        t.join(timeout=10)
+        assert got1 == SESSION_4  # byte-exact broadcast
+        assert got2 == SESSION_4
+    finally:
+        fanout.close()
+        hub.close()
+
+
+def test_edge_one_hub_serves_n_broadcast_groups():
+    """The tentpole's unified-table claim: ONE loop + ONE hub serving
+    TWO broadcast groups at once — each group's source digested by the
+    shared hub, each group's subscriber byte-exact on ITS OWN wire."""
+    from dat_replication_protocol_tpu.fanout import FanoutServer
+
+    hub = ReplicationHub(linger_s=0.002)
+    f_a = FanoutServer(stall_timeout=10.0)
+    f_b = FanoutServer(stall_timeout=10.0)
+    # connections 1+3 -> group a (source, then subscriber); 2+4 -> b
+    group_of = lambda n, peer: "a" if n in (1, 3) else "b"  # noqa: E731
+    loop = EdgeLoop(hub, fanouts={"a": f_a, "b": f_b},
+                    group_of=group_of, max_sessions=4)
+    try:
+        port, t = _start_loop(loop)
+        addr = ("127.0.0.1", port)
+        src_a = socket.create_connection(addr, timeout=10)   # n=1
+        src_b = socket.create_connection(addr, timeout=10)   # n=2
+        time.sleep(0.2)  # both claims land before the subscribers dial
+        sub_a = socket.create_connection(addr, timeout=10)   # n=3
+        sub_b = socket.create_connection(addr, timeout=10)   # n=4
+        src_a.sendall(SESSION_1)
+        src_a.shutdown(socket.SHUT_WR)
+        src_b.sendall(SESSION_4)
+        src_b.shutdown(socket.SHUT_WR)
+        reply_a = _decode_reply(_recv_all(src_a))
+        reply_b = _decode_reply(_recv_all(src_b))
+        src_a.close()
+        src_b.close()
+        got_a = _recv_all(sub_a)
+        got_b = _recv_all(sub_b)
+        sub_a.close()
+        sub_b.close()
+        t.join(timeout=10)
+        assert got_a == SESSION_1 and got_b == SESSION_4
+        assert {ch.key for ch in reply_a} == {"change-0"}
+        assert {ch.key for ch in reply_b} == {"blob-0", "change-0"}
+    finally:
+        f_a.close()
+        f_b.close()
+        hub.close()
+
+
+def test_edge_reconcile_leg_exchanges_exact_diff(tmp_path):
+    """The --reconcile responder through the loop: the initiator's
+    record shape and O(diff) exchange, identical to the threaded leg."""
+    from dat_replication_protocol_tpu import sidecar
+    from dat_replication_protocol_tpu.runtime import replay
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        run_initiator,
+    )
+
+    def log_bytes(keys):
+        return replay.encode_change_log(
+            [{"key": k, "change": i, "from": i, "to": i + 1,
+              "value": b"v:" + k.encode()} for i, k in enumerate(keys)])
+
+    keys = [f"key-{i:05d}" for i in range(200)]
+    logfile = tmp_path / "srv_log.bin"
+    logfile.write_bytes(log_bytes(keys + ["srv-only-1", "srv-only-2"]))
+    client = RatelessReplica(log_bytes(keys + ["cli-only"]))
+    replica = sidecar.load_reconcile_replica(str(logfile))
+    loop = EdgeLoop(reconcile_replica=replica, max_sessions=2)
+    try:
+        port, t = _start_loop(loop)
+        for _ in range(2):  # a second session against the same replica
+            c = socket.create_connection(("127.0.0.1", port), timeout=10)
+            out = run_initiator(
+                client, c.recv, c.sendall,
+                close_write=lambda c=c: c.shutdown(socket.SHUT_WR))
+            c.close()
+            assert out["ok"]
+            assert out["records_sent"] == 1
+            assert {ch.key for ch in out["received"]} == {"srv-only-1",
+                                                          "srv-only-2"}
+        t.join(timeout=10)
+    finally:
+        pass
+
+
+def test_edge_mixed_modes_share_one_session_table(tmp_path):
+    """Hub sessions and reconcile responders through the SAME loop and
+    the SAME table at the same time — the whole point of the rewrite."""
+    from dat_replication_protocol_tpu import sidecar
+    from dat_replication_protocol_tpu.runtime import replay
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        run_initiator,
+    )
+
+    logfile = tmp_path / "log.bin"
+    logfile.write_bytes(replay.encode_change_log(
+        [{"key": "srv-only", "change": 0, "from": 0, "to": 1,
+          "value": b"v"}]))
+    replica = sidecar.load_reconcile_replica(str(logfile))
+    client = RatelessReplica([])
+    hub = ReplicationHub(linger_s=0.002)
+    mode_of = lambda n, peer: "hub" if n == 1 else "reconcile"  # noqa: E731
+    loop = EdgeLoop(hub, reconcile_replica=replica, mode_of=mode_of,
+                    max_sessions=2)
+    box = {}
+    try:
+        port, t = _start_loop(loop)
+        addr = ("127.0.0.1", port)
+        hub_c = socket.create_connection(addr, timeout=10)  # n=1: hub
+        half = len(SESSION_4) // 2
+        hub_c.sendall(SESSION_4[:half])  # park the hub session mid-wire
+
+        def reconcile_leg():
+            c = socket.create_connection(addr, timeout=10)  # n=2
+            box["out"] = run_initiator(
+                client, c.recv, c.sendall,
+                close_write=lambda: c.shutdown(socket.SHUT_WR))
+            c.close()
+
+        tr = threading.Thread(target=reconcile_leg, daemon=True)
+        tr.start()
+        tr.join(15)
+        assert not tr.is_alive(), "reconcile starved by the hub session"
+        assert box["out"]["ok"]
+        assert {ch.key for ch in box["out"]["received"]} == {"srv-only"}
+        hub_c.sendall(SESSION_4[half:])  # now finish the hub session
+        hub_c.shutdown(socket.SHUT_WR)
+        reply = _decode_reply(_recv_all(hub_c))
+        hub_c.close()
+        t.join(timeout=10)
+        assert {ch.key for ch in reply} == {"blob-0", "change-0"}
+    finally:
+        hub.close()
+
+
+def test_edge_qos_presets_map_onto_hub_weights():
+    """The QoS tiers are the existing window/weight presets, not a new
+    scheduler: latency outweighs throughput, and its recv slab is the
+    small one."""
+    assert QOS_PRESETS["latency"]["weight"] > \
+        QOS_PRESETS["throughput"]["weight"]
+    assert QOS_PRESETS["latency"]["recv_cap"] < \
+        QOS_PRESETS["throughput"]["recv_cap"]
+
+
+def test_serve_edge_ready_cb_and_close():
+    """The serve_edge entry point: ready_cb(port) fires once bound, and
+    close() from another thread exits the loop promptly."""
+    hub = ReplicationHub(linger_s=0.002)
+    ready = threading.Event()
+    box = {}
+    loop = EdgeLoop(hub, tick=0.02)
+    loop.bind("127.0.0.1", 0)
+    t = threading.Thread(
+        target=loop.serve,
+        kwargs=dict(ready_cb=lambda p: (box.__setitem__("p", p),
+                                        ready.set())),
+        daemon=True)
+    t.start()
+    try:
+        assert ready.wait(10)
+        assert box["p"] == loop.port
+        loop.close()
+        t.join(10)
+        assert not t.is_alive(), "close() did not stop the loop"
+    finally:
+        hub.close()
+
+
+def test_edge_stats_fd_snapshot_carries_edge_aggregate(obs_enabled):
+    """The fleet-plane satellite: snapshot_stats() (what --stats-fd and
+    /snapshot serve) carries the session-table aggregate while an edge
+    loop is active, and /healthz's admission stage is the edge's."""
+    from dat_replication_protocol_tpu import sidecar
+    from dat_replication_protocol_tpu.obs.http import default_healthz
+
+    hub = ReplicationHub(linger_s=0.002)
+    loop = EdgeLoop(hub)
+    sidecar.set_active_edge(loop)
+    sidecar.set_active_hub(hub)
+    try:
+        snap = sidecar.snapshot_stats()
+        assert snap["edge"]["sessions"] == 0
+        assert snap["edge"]["by_class"] == {}
+        assert "pump_route" in snap["edge"]
+        hz = default_healthz(sidecar._active_admission_fn())
+        adm = hz["stages"]["admission"]
+        assert adm["stage"] == "edge" and adm["ok"] is True
+    finally:
+        sidecar.set_active_hub(None)
+        sidecar.set_active_edge(None)
+        hub.close()

@@ -1,0 +1,201 @@
+"""FaultPlan chaos arm over the unified session table (ISSUE 17).
+
+The 20-seed tier-1 sweep: N mixed-QoS sessions through ONE
+:class:`~dat_replication_protocol_tpu.edge.EdgeLoop`, with the
+FaultPlan-elected session misbehaving per its deterministic scenario
+(``stall`` / ``truncate`` / ``flip``).  The contract under test is
+neighbor isolation: the faulted session tears down STRUCTURALLY (a
+not-ok record, never a hang), resumes cleanly on reconnect, and every
+healthy neighbor's reply stays byte-exact with a flat completion-time
+tail — one bad socket never perturbs another session's bytes or p99.
+"""
+
+import hashlib
+import socket
+import threading
+import time
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu.edge import EdgeLoop
+from dat_replication_protocol_tpu.hub import ReplicationHub
+from dat_replication_protocol_tpu.session.faults import FaultPlan
+
+from test_wire_fixtures import CHANGE_PAYLOAD, SESSION_4
+
+N_SESSIONS = 4
+SEEDS = range(20)
+
+# one bad session must never stretch a healthy neighbor's completion
+# into the same order as the fault's own lifetime: the stall scenario
+# parks its socket ~0.3s, the teardown ladder runs on the loop's tick —
+# a neighbor contaminated by either would blow well past this
+P99_BUDGET_S = 5.0
+
+_BLOB_DIGEST = hashlib.blake2b(b"hello world", digest_size=32).digest()
+_CHANGE_DIGEST = hashlib.blake2b(CHANGE_PAYLOAD, digest_size=32).digest()
+
+
+def _decode_reply(raw: bytes) -> list:
+    out = []
+    dec = protocol.decode()
+    dec.change(lambda ch, done: (out.append(ch), done()))
+    dec.write(raw)
+    dec.end()
+    assert dec.finished
+    return out
+
+
+def _recv_all(sock: socket.socket) -> bytes:
+    parts = []
+    while True:
+        try:
+            d = sock.recv(65536)
+        except OSError:
+            return b"".join(parts)
+        if not d:
+            return b"".join(parts)
+        parts.append(d)
+
+
+def _healthy_client(addr, results, i):
+    t0 = time.monotonic()
+    c = socket.create_connection(addr, timeout=10)
+    c.settimeout(15)
+    c.sendall(SESSION_4)
+    c.shutdown(socket.SHUT_WR)
+    reply = _decode_reply(_recv_all(c))
+    c.close()
+    results[i] = (reply, time.monotonic() - t0)
+
+
+def _faulty_client(addr, scenario: str):
+    """One connection misbehaving per its FaultPlan scenario — client
+    bytes seen by the loop match the plan's session-axis vocabulary."""
+    c = socket.create_connection(addr, timeout=10)
+    c.settimeout(15)
+    half = len(SESSION_4) // 2
+    if scenario == "flip":
+        # one bit of wire corruption mid-stream: the decoder must
+        # destroy with a structured error, reply answered with EOF
+        bad = bytearray(SESSION_4)
+        bad[half] ^= 0x40
+        c.sendall(bytes(bad))
+        c.shutdown(socket.SHUT_WR)
+        _recv_all(c)
+    elif scenario == "truncate":
+        # a clean-looking EOF mid-frame
+        c.sendall(SESSION_4[:half])
+        c.shutdown(socket.SHUT_WR)
+        _recv_all(c)
+    else:  # stall: park mid-wire, then die without a clean shutdown
+        c.sendall(SESSION_4[:half])
+        time.sleep(0.3)
+    c.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_sweep_faulted_session_never_perturbs_neighbors(seed):
+    faulty = FaultPlan.faulty_session(seed, N_SESSIONS)
+    scenario = FaultPlan.session_scenario(seed, N_SESSIONS)
+    hub = ReplicationHub(linger_s=0.002)
+    qos_of = lambda n, peer, mode: \
+        "latency" if n % 2 else "throughput"  # noqa: E731
+    # +1: the faulted session RECONNECTS after its teardown (resume)
+    loop = EdgeLoop(hub, qos_of=qos_of, max_sessions=N_SESSIONS + 1,
+                    drain_timeout=2.0, tick=0.02)
+    results = {}
+    try:
+        port = loop.bind("127.0.0.1", 0)
+        t = threading.Thread(target=loop.serve, daemon=True)
+        t.start()
+        addr = ("127.0.0.1", port)
+        threads = []
+        for i in range(N_SESSIONS):
+            if i == faulty:
+                th = threading.Thread(target=_faulty_client,
+                                      args=(addr, scenario), daemon=True)
+            else:
+                th = threading.Thread(target=_healthy_client,
+                                      args=(addr, results, i), daemon=True)
+            threads.append(th)
+            th.start()
+            time.sleep(0.02)  # deterministic admission order
+        for th in threads:
+            th.join(20)
+            assert not th.is_alive(), f"client HANG (seed {seed})"
+        # the faulted session RESUMES structurally: a fresh connection
+        # from the same peer completes a full clean session
+        resume = {}
+        _healthy_client(addr, resume, "resume")
+        t.join(timeout=15)
+        assert not t.is_alive(), f"loop HANG (seed {seed})"
+    finally:
+        hub.close()
+    # every healthy neighbor: byte-exact digests, flat completion tail
+    for i, (reply, elapsed) in results.items():
+        by_key = {ch.key: ch for ch in reply}
+        assert set(by_key) == {"blob-0", "change-0"}, (
+            f"seed {seed} ({scenario}): neighbor {i} reply perturbed")
+        assert by_key["blob-0"].value == _BLOB_DIGEST
+        assert by_key["change-0"].value == _CHANGE_DIGEST
+        assert elapsed < P99_BUDGET_S, (
+            f"seed {seed} ({scenario}): neighbor {i} p99 blown "
+            f"({elapsed:.2f}s)")
+    reply, _ = resume["resume"]
+    assert {ch.key for ch in reply} == {"blob-0", "change-0"}, (
+        f"seed {seed} ({scenario}): faulted session did not resume")
+
+
+def test_chaos_mixed_modes_fault_isolated_across_legs(tmp_path):
+    """A faulted HUB session next to a live RECONCILE responder in the
+    same table: the responder's exchange stays exact while the hub
+    neighbor is torn down — isolation holds ACROSS leg kinds, not just
+    between hub sessions."""
+    from dat_replication_protocol_tpu import sidecar
+    from dat_replication_protocol_tpu.runtime import replay
+    from dat_replication_protocol_tpu.runtime.reconcile_driver import (
+        RatelessReplica,
+        run_initiator,
+    )
+
+    logfile = tmp_path / "log.bin"
+    logfile.write_bytes(replay.encode_change_log(
+        [{"key": "srv-only", "change": 0, "from": 0, "to": 1,
+          "value": b"v"}]))
+    replica = sidecar.load_reconcile_replica(str(logfile))
+    client = RatelessReplica([])
+    hub = ReplicationHub(linger_s=0.002)
+    mode_of = lambda n, peer: \
+        "hub" if n in (1, 3) else "reconcile"  # noqa: E731
+    loop = EdgeLoop(hub, reconcile_replica=replica, mode_of=mode_of,
+                    max_sessions=3, drain_timeout=2.0, tick=0.02)
+    try:
+        port = loop.bind("127.0.0.1", 0)
+        t = threading.Thread(target=loop.serve, daemon=True)
+        t.start()
+        addr = ("127.0.0.1", port)
+        # n=1: the faulted hub session (corrupt wire)
+        fth = threading.Thread(target=_faulty_client,
+                               args=(addr, "flip"), daemon=True)
+        fth.start()
+        time.sleep(0.05)
+        # n=2: the reconcile responder, concurrent with the fault
+        c = socket.create_connection(addr, timeout=10)
+        out = run_initiator(
+            client, c.recv, c.sendall,
+            close_write=lambda: c.shutdown(socket.SHUT_WR))
+        c.close()
+        assert out["ok"]
+        assert {ch.key for ch in out["received"]} == {"srv-only"}
+        fth.join(15)
+        assert not fth.is_alive()
+        # n=3: a clean hub session after the fault — the table recovered
+        results = {}
+        _healthy_client(addr, results, "after")
+        t.join(timeout=15)
+        assert {ch.key for ch in results["after"][0]} == {"blob-0",
+                                                          "change-0"}
+    finally:
+        hub.close()

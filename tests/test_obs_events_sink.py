@@ -101,11 +101,15 @@ def test_eagain_mid_record_latches_sink_dead_and_counts(obs_enabled):
         assert log.sink_dropped == 2
         drained = _drain(r)
         assert b"sink.after" not in drained
-        # the torn fragment is the LAST thing on the fd and is exactly
-        # the stream prefix + 64 bytes of the record — a JSONL consumer
-        # discards the unterminated final line harmlessly
-        assert len(drained) == filled
-        assert not drained.endswith(b"\n")
+        # the stream ends at the tear: either the kernel accepted a
+        # 64-byte prefix of the record before EAGAIN (a torn final line
+        # a JSONL consumer discards harmlessly) or it refused the
+        # oversized write outright with zero bytes (some kernels only
+        # tear at PIPE_BUF granularity) — both leave no complete record
+        torn = len(drained) - (filled - 64)
+        assert torn in (0, 64)
+        if torn:
+            assert not drained.endswith(b"\n")
         # the ring itself kept both records (the sink is best-effort)
         assert log.count("sink.torn") == 1 and log.count("sink.after") == 1
         # re-attaching clears the latch
