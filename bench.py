@@ -2652,13 +2652,21 @@ def bench_edge_scaling(quick: bool, backend: str) -> dict:
     admission-ladder counts (rejected/shed) at ZERO — overload
     machinery must stay dark on a properly sized hub, at every scale.
     The client cohort runs in a subprocess (fd budget: N sessions are
-    N fds on EACH side)."""
+    N fds on EACH side).
+
+    ISSUE 18: the run is captured with the obs gate ON so the turn
+    profiler is lit — the loop's own per-turn accounting yields
+    ``loop_lag_max_s``/``p99_turn_s`` per cohort size, budget-gated at
+    the top N.  The flight-deck numbers are therefore measured WITH
+    profiler overhead included: the budget holds both the telemetry
+    and its cost."""
     import subprocess
     import threading
 
     import dat_replication_protocol_tpu as protocol
     from dat_replication_protocol_tpu.edge import EdgeLoop
     from dat_replication_protocol_tpu.hub import ReplicationHub
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
 
     ns_env = os.environ.get("BENCH_EDGE_N")
     counts = [int(x) for x in ns_env.split(",")] if ns_env else (
@@ -2696,62 +2704,75 @@ def bench_edge_scaling(quick: bool, backend: str) -> dict:
     wire = b"".join(parts)
 
     res: dict = {}
-    for n in counts:
-        hub = ReplicationHub(max_sessions=n + 8, linger_s=0.002)
-        qos_of = lambda i, peer, mode: \
-            "latency" if i % 2 else "throughput"  # noqa: E731
-        loop = EdgeLoop(hub, qos_of=qos_of, max_sessions=n, tick=0.02,
-                        drain_timeout=60.0)
-        port = loop.bind("127.0.0.1", 0)
-        server = threading.Thread(target=loop.serve, daemon=True)
-        server.start()
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__),
-             "--edge-client", str(n), str(port), wire.hex()],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL, text=True)
-        try:
-            line = proc.stdout.readline().strip()
-            if not line.startswith("HELD "):
-                raise RuntimeError(f"edge client died during ramp: "
-                                   f"{line!r}")
-            held = int(line.split()[1])
-            # peak occupancy: every held session sits in the ONE table
-            # — wait for the accept side to drain its backlog (held
-            # sessions cannot finish: half their wire is missing)
-            deadline = time.monotonic() + 120
-            peak = loop.snapshot()["sessions"]
-            while peak < held and time.monotonic() < deadline:
-                time.sleep(0.01)
-                peak = max(peak, loop.snapshot()["sessions"])
-            proc.stdin.write("GO\n")
-            proc.stdin.flush()
-            out = json.loads(proc.stdout.readline())
-            proc.wait(timeout=60)
-        finally:
-            if proc.poll() is None:
-                proc.kill()
-            loop.close()
-        server.join(30)
-        snap = loop.snapshot()
-        hub.close()
-        finish_s = out["finish_s"]
-        res[n] = {
-            "sessions_s": (round(out["done"] / finish_s, 1)
-                           if finish_s else 0.0),
-            "p99_s": out["p99_s"],
-            "ramp_s": out["ramp_s"],
-            "finish_s": finish_s,
-            "peak_sessions": peak,
-            "ok": out["ok"],
-            "admitted": snap["admitted"],
-            "rejected": snap["rejected"],
-            "shed": snap["shed"],
-        }
-        log(f"bench[edge_scaling]: n={n} peak={peak} "
-            f"{res[n]['sessions_s']}/s p99={out['p99_s'] * 1e3:.1f}ms "
-            f"(ramp {out['ramp_s']:.2f}s, finish {finish_s:.2f}s, "
-            f"ok {out['ok']})")
+    was_on = obs_metrics.OBS.on
+    obs_metrics.enable()  # lit turn profiler: measure WITH the flight deck on
+    try:
+        for n in counts:
+            hub = ReplicationHub(max_sessions=n + 8, linger_s=0.002)
+            qos_of = lambda i, peer, mode: \
+                "latency" if i % 2 else "throughput"  # noqa: E731
+            loop = EdgeLoop(hub, qos_of=qos_of, max_sessions=n,
+                            tick=0.02, drain_timeout=60.0)
+            port = loop.bind("127.0.0.1", 0)
+            server = threading.Thread(target=loop.serve, daemon=True)
+            server.start()
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--edge-client", str(n), str(port), wire.hex()],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+            try:
+                line = proc.stdout.readline().strip()
+                if not line.startswith("HELD "):
+                    raise RuntimeError(f"edge client died during ramp: "
+                                       f"{line!r}")
+                held = int(line.split()[1])
+                # peak occupancy: every held session sits in the ONE
+                # table — wait for the accept side to drain its backlog
+                # (held sessions cannot finish: half their wire is
+                # missing)
+                deadline = time.monotonic() + 120
+                peak = loop.snapshot()["sessions"]
+                while peak < held and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                    peak = max(peak, loop.snapshot()["sessions"])
+                proc.stdin.write("GO\n")
+                proc.stdin.flush()
+                out = json.loads(proc.stdout.readline())
+                proc.wait(timeout=60)
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                loop.close()
+            server.join(30)
+            snap = loop.snapshot()
+            hub.close()
+            finish_s = out["finish_s"]
+            prof = snap["loop"]  # the profiler's own turn accounting
+            res[n] = {
+                "sessions_s": (round(out["done"] / finish_s, 1)
+                               if finish_s else 0.0),
+                "p99_s": out["p99_s"],
+                "ramp_s": out["ramp_s"],
+                "finish_s": finish_s,
+                "peak_sessions": peak,
+                "ok": out["ok"],
+                "admitted": snap["admitted"],
+                "rejected": snap["rejected"],
+                "shed": snap["shed"],
+                "loop_lag_max_s": round(prof["lag_max_s"], 6),
+                "p99_turn_s": round(prof["p99_work_s"], 6),
+                "loop_turns": prof["turns"],
+            }
+            log(f"bench[edge_scaling]: n={n} peak={peak} "
+                f"{res[n]['sessions_s']}/s "
+                f"p99={out['p99_s'] * 1e3:.1f}ms "
+                f"(ramp {out['ramp_s']:.2f}s, finish {finish_s:.2f}s, "
+                f"ok {out['ok']}, lag_max "
+                f"{res[n]['loop_lag_max_s'] * 1e3:.1f}ms, p99 turn "
+                f"{res[n]['p99_turn_s'] * 1e3:.1f}ms)")
+    finally:
+        obs_metrics.OBS.on = was_on
     top = max(counts)
     total_ok = sum(res[n]["ok"] for n in counts)
     return {
@@ -2770,12 +2791,20 @@ def bench_edge_scaling(quick: bool, backend: str) -> dict:
         "p99_s_top": res[top]["p99_s"],
         "rejected_total": sum(res[n]["rejected"] for n in counts),
         "shed_total": sum(res[n]["shed"] for n in counts),
+        # ISSUE 18 flight-deck rows: worst loop overrun and p99 turn
+        # time at the top cohort, straight from the turn profiler
+        "loop_lag_max_s_top": res[top]["loop_lag_max_s"],
+        "p99_turn_s_top": res[top]["p99_turn_s"],
         **{f"sessions_s_{n}": res[n]["sessions_s"] for n in counts},
         **{f"p99_s_{n}": res[n]["p99_s"] for n in counts},
         **{f"peak_{n}": res[n]["peak_sessions"] for n in counts},
+        **{f"loop_lag_max_s_{n}": res[n]["loop_lag_max_s"]
+           for n in counts},
+        **{f"p99_turn_s_{n}": res[n]["p99_turn_s"] for n in counts},
         "reduced_config": top < 10000,
         "full_config": "1/100/1k/10k concurrent mixed-QoS sessions "
-                       "through one edge loop on host",
+                       "through one edge loop on host, turn profiler "
+                       "lit (obs gate ON)",
     }
 
 

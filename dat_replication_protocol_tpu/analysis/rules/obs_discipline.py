@@ -57,16 +57,19 @@ from ..engine import Finding, Project
 from .hub_isolation import _dispatchy_call, _is_lock_ctx
 
 _TELEMETRY_FNS = {"counter", "gauge", "histogram", "emit",
-                  "trace_span", "trace_instant", "jit_site", "track"}
+                  "trace_span", "trace_instant", "jit_site", "track",
+                  "phase", "account"}
 # attribute-call receivers that denote the obs layer (normalized:
 # underscores stripped, lowercased) — `EVENTS.emit(...)`,
-# `obs_metrics.counter(...)`, `registry.histogram(...)`.  Unrelated
-# APIs sharing a method name (`handler.emit(record)`,
+# `obs_metrics.counter(...)`, `registry.histogram(...)`,
+# `prof.phase(...)` (the ISSUE 18 loop profiler).  Unrelated APIs
+# sharing a method name (`handler.emit(record)`,
 # `np.histogram(data, bins)`) must NOT trip the rule.
 _TELEMETRY_RECEIVERS = {"events", "metrics", "obs", "obs_events",
                         "obs_metrics", "obs_tracing", "registry", "reg",
                         "spans", "tracing", "device", "obs_device",
-                        "watermarks", "obs_watermarks", "board"}
+                        "watermarks", "obs_watermarks", "board",
+                        "prof", "profiler", "loopprof"}
 # the obs plumbing itself: (parent dir, filename) pairs exempt from the
 # literal-name check (they forward `name` parameters by design; the
 # greppable sites are their callers)
@@ -74,7 +77,7 @@ _PLUMBING = {("obs", "metrics.py"), ("obs", "events.py"),
              ("obs", "tracing.py"), ("obs", "flight.py"),
              ("obs", "device.py"), ("obs", "__init__.py"),
              ("obs", "watermarks.py"), ("obs", "http.py"),
-             ("obs", "fleet.py")}
+             ("obs", "fleet.py"), ("obs", "loopprof.py")}
 # the /healthz lock-discipline check applies to the endpoint module
 _HEALTHZ_MODULE = ("obs", "http.py")
 

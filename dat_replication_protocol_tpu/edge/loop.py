@@ -34,6 +34,7 @@ threaded edge.
 
 from __future__ import annotations
 
+import itertools
 import os
 import selectors
 import socket
@@ -43,6 +44,7 @@ from typing import Callable, Optional
 
 from ..hub import HubBusy, SessionShed
 from ..obs.events import emit as _emit
+from ..obs.loopprof import LoopProfiler, SAMPLE_EVERY
 from ..obs.metrics import (
     OBS as _OBS,
     REGISTRY as _REGISTRY,
@@ -87,9 +89,16 @@ ACCEPT_BURST = 64
 
 _M_SESSIONS = _counter("sidecar.sessions")
 _M_STALLS = _counter("sidecar.stalls")
-_M_EDGE_ADMITTED = _counter("edge.admitted")
-_M_EDGE_REJECTED = _counter("edge.rejected")
-_M_EDGE_SHED = _counter("edge.shed")
+
+# edge.served/admitted/rejected/shed are exported by the loop's
+# registry COLLECTOR (labeled by loop name, read straight off the
+# admission attributes) rather than gate-dependent registered counters:
+# the gate-off path used to under-report them as zero while
+# admission_state() told the truth (ISSUE 18 satellite)
+
+# default loop names for telemetry labels when the owner passes none:
+# edge0, edge1, ... in construction order (deterministic per process)
+_LOOP_SEQ = itertools.count()
 
 
 class EdgeSession:
@@ -148,7 +157,9 @@ class EdgeLoop:
                  group_of: Optional[Callable] = None,
                  drain_timeout: Optional[float] = DEFAULT_DRAIN_TIMEOUT,
                  max_sessions: Optional[int] = None,
-                 tick: float = EDGE_TICK):
+                 tick: float = EDGE_TICK,
+                 name: Optional[str] = None,
+                 profile_every: int = SAMPLE_EVERY):
         self._hub = hub
         self._fanouts = dict(fanouts) if fanouts else {}
         self._reconcile_replica = reconcile_replica
@@ -161,6 +172,12 @@ class EdgeLoop:
         self._drain_timeout = drain_timeout
         self._max_sessions = max_sessions
         self._tick = float(tick)
+        # the flight deck (ISSUE 18): per-turn phase accounting, the
+        # loop-lag watermark, and the sampling turn profiler — only the
+        # lit dispatch twin ever touches it
+        self.profiler = LoopProfiler(name or f"edge{next(_LOOP_SEQ)}",
+                                     tick=self._tick,
+                                     sample_every=profile_every)
 
         self._sel = selectors.DefaultSelector()
         self._srv: Optional[socket.socket] = None
@@ -220,6 +237,7 @@ class EdgeLoop:
               file=sys.stderr, flush=True)
         if ready_cb is not None:
             ready_cb(self.port)
+        self.profiler.attach()
         try:
             self._dispatch_loop()
         finally:
@@ -234,6 +252,7 @@ class EdgeLoop:
             pass
 
     def _shutdown(self) -> None:
+        self.profiler.detach()
         _REGISTRY.unregister_collector("edge", self._collector_fn)
         for sess in list(self._table.values()):
             try:
@@ -258,22 +277,54 @@ class EdgeLoop:
     # -- the loop (the enforced dispatcher: edge-dispatch) ------------------
 
     def _dispatch_loop(self) -> None:
+        # one gate check per TURN forks the lit/dark twins: the dark
+        # twin is the certified dispatcher verbatim — disabled
+        # telemetry pays the one attribute load and nothing else (the
+        # PR 3 budget contract, enforced by a bytecode test)
         while not self._closed:
-            events = self._sel.select(self._tick)
-            now = time.monotonic()
-            for skey, mask in events:
-                tag = skey.data
-                if tag == "accept":
-                    self._accept_burst()
-                elif tag == "wake":
-                    self._drain_wake()
-                else:
-                    self._io_turn(tag, mask, now)
-            self._sweep(time.monotonic())
+            if _OBS.on:
+                self._lit_turn()
+            else:
+                self._dark_turn()
             if (self._max_sessions is not None
                     and self._served >= self._max_sessions
                     and not self._table):
                 return
+
+    def _dark_turn(self) -> None:
+        events = self._sel.select(self._tick)
+        now = time.monotonic()
+        for skey, mask in events:
+            tag = skey.data
+            if tag == "accept":
+                self._accept_burst()
+            elif tag == "wake":
+                self._drain_wake()
+            else:
+                self._io_turn(tag, mask, now)
+        self._sweep(time.monotonic())
+
+    def _lit_turn(self) -> None:
+        # the dark twin with the flight deck's monotonic splits: every
+        # timer is two time.monotonic() reads around work the loop was
+        # doing anyway — no new kernel calls, no new blocking surface
+        prof = self.profiler
+        prof.turn_begin(time.monotonic())
+        events = self._sel.select(self._tick)
+        now = time.monotonic()
+        prof.poll_done(now, len(events))
+        for skey, mask in events:
+            tag = skey.data
+            if tag == "accept":
+                t0 = time.monotonic()
+                self._accept_burst()
+                prof.phase("accept", time.monotonic() - t0)
+            elif tag == "wake":
+                self._drain_wake()
+            else:
+                self._io_turn(tag, mask, now, prof)
+        self._sweep(time.monotonic(), prof)
+        prof.turn_done(time.monotonic(), sessions=len(self._table))
 
     def _drain_wake(self) -> None:
         try:
@@ -383,7 +434,6 @@ class EdgeLoop:
                    "sessions": e.sessions, "parked_bytes": e.parked_bytes}
             self._rejected += 1
             if _OBS.on:
-                _M_EDGE_REJECTED.inc()
                 _emit("sidecar.session", **out)
             try:
                 conn.shutdown(socket.SHUT_WR)
@@ -419,7 +469,6 @@ class EdgeLoop:
                    "peers": e.peers, "max_peers": e.max_peers}
             self._rejected += 1
             if _OBS.on:
-                _M_EDGE_REJECTED.inc()
                 _emit("sidecar.session", **out)
             _send_refusal(conn, out)
             conn.close()
@@ -432,32 +481,48 @@ class EdgeLoop:
     def _install(self, sess: EdgeSession) -> None:
         self._table[sess.fd] = sess
         self._admitted += 1
-        if _OBS.on:
-            _M_EDGE_ADMITTED.inc()
         self._update_mask(sess)
 
     # -- per-session turns ---------------------------------------------------
 
-    def _io_turn(self, sess: EdgeSession, mask: int, now: float) -> None:
+    def _io_turn(self, sess: EdgeSession, mask: int, now: float,
+                 prof: Optional[LoopProfiler] = None) -> None:
         if sess.dead:
             return
         try:
             if mask & selectors.EVENT_READ:
                 if sess.kind == "subscriber":
                     self._probe_subscriber(sess)
+                elif prof is not None:
+                    t0 = time.monotonic()
+                    rx = self._read_turn(sess, now)
+                    prof.account("read", sess.key,
+                                 time.monotonic() - t0, rx)
                 else:
                     self._read_turn(sess, now)
             if mask & selectors.EVENT_WRITE and not sess.dead:
-                self._tx_turn(sess, now)
+                if prof is not None:
+                    t0 = time.monotonic()
+                    tx = self._tx_turn(sess, now)
+                    prof.account("tx", sess.key,
+                                 time.monotonic() - t0, tx)
+                else:
+                    self._tx_turn(sess, now)
         except Exception as e:
-            self._session_error(sess, e)
+            if prof is not None:
+                t0 = time.monotonic()
+                self._session_error(sess, e)
+                prof.account("overload-ladder", sess.key,
+                             time.monotonic() - t0, 0)
+            else:
+                self._session_error(sess, e)
         if not sess.dead:
             self._update_mask(sess)
 
-    def _read_turn(self, sess: EdgeSession, now: float) -> None:
+    def _read_turn(self, sess: EdgeSession, now: float) -> int:
         dec = sess.machine.dec
         if sess.rx_eof or dec.destroyed or not self._read_gate_open(sess):
-            return
+            return 0
         nbytes, eof = recv_step(sess.pump, dec, sess.tap)
         if eof:
             sess.rx_eof = True
@@ -465,6 +530,7 @@ class EdgeLoop:
                 dec.end()
         if nbytes or eof:
             sess.tx_ready = True  # machine hooks may have queued reply
+        return nbytes
 
     def _probe_subscriber(self, sess: EdgeSession) -> None:
         # the threaded run_subscriber's EOF/misroute probe, event-driven
@@ -487,10 +553,10 @@ class EdgeLoop:
             sess.not_source = True
         self._finish_session(sess)
 
-    def _tx_turn(self, sess: EdgeSession, now: float) -> None:
+    def _tx_turn(self, sess: EdgeSession, now: float) -> int:
         m = sess.machine
         if m is None or m.enc is None or sess.tx_done:
-            return
+            return 0
         sess.tx_ready = False
         accepted, finished, blocked = send_step(sess.pump, m.enc)
         sess.tx_blocked = blocked
@@ -503,6 +569,7 @@ class EdgeLoop:
                 sess.conn.shutdown(socket.SHUT_WR)  # reply EOF
             except OSError:
                 pass
+        return accepted
 
     def _read_gate_open(self, sess: EdgeSession) -> bool:
         m = sess.machine
@@ -527,20 +594,28 @@ class EdgeLoop:
 
     # -- the per-turn sweep --------------------------------------------------
 
-    def _sweep(self, now: float) -> None:
+    def _sweep(self, now: float,
+               prof: Optional[LoopProfiler] = None) -> None:
         for sess in list(self._table.values()):
             if sess.dead:
                 continue
             try:
-                self._sweep_one(sess, now)
+                self._sweep_one(sess, now, prof)
             except Exception as e:
-                self._session_error(sess, e)
+                if prof is not None:
+                    t0 = time.monotonic()
+                    self._session_error(sess, e)
+                    prof.account("overload-ladder", sess.key,
+                                 time.monotonic() - t0, 0)
+                else:
+                    self._session_error(sess, e)
             if not sess.dead:
                 self._maybe_finish(sess)
             if not sess.dead:
                 self._update_mask(sess)
 
-    def _sweep_one(self, sess: EdgeSession, now: float) -> None:
+    def _sweep_one(self, sess: EdgeSession, now: float,
+                   prof: Optional[LoopProfiler] = None) -> None:
         if sess.kind == "subscriber":
             p = sess.fanout_peer
             if p.wait_done(timeout=0):
@@ -564,21 +639,45 @@ class EdgeLoop:
                 # parked bytes grow, the window gate closes reads, and
                 # eventually the shed policy fires: the threaded leg's
                 # flushed.wait ladder, event-driven
-                if hs.poll():
+                if prof is not None:
+                    t0 = time.monotonic()
+                    polled = hs.poll()
+                    prof.account("hub-drain", sess.key,
+                                 time.monotonic() - t0, 0)
+                else:
+                    polled = hs.poll()
+                if polled:
                     sess.tx_ready = True
             if (getattr(m, "rx_finalized", False) and hs.drained
                     and not m.enc.finalized and not m.enc.destroyed):
                 # flush-before-finalize, the loop's half: every digest
                 # for submitted work is encoded before the reply seals
-                m.enc.finalize()
+                if prof is not None:
+                    t0 = time.monotonic()
+                    m.enc.finalize()
+                    prof.account("hub-drain", sess.key,
+                                 time.monotonic() - t0, 0)
+                else:
+                    m.enc.finalize()
                 sess.tx_ready = True
         if sess.tx_ready and not sess.tx_blocked and not sess.tx_done:
-            self._tx_turn(sess, now)
+            if prof is not None:
+                t0 = time.monotonic()
+                tx = self._tx_turn(sess, now)
+                prof.account("tx", sess.key, time.monotonic() - t0, tx)
+            else:
+                self._tx_turn(sess, now)
         if (self._drain_timeout is not None and not sess.tx_done
                 and m.enc is not None and not m.enc.destroyed
                 and (sess.tx_blocked or sess.rx_eof)
                 and now - sess.progress > self._drain_timeout):
-            self._teardown_stalled(sess)
+            if prof is not None:
+                t0 = time.monotonic()
+                self._teardown_stalled(sess)
+                prof.account("overload-ladder", sess.key,
+                             time.monotonic() - t0, 0)
+            else:
+                self._teardown_stalled(sess)
 
     def _teardown_stalled(self, sess: EdgeSession) -> None:
         # the client stopped reading its reply: the threaded leg's
@@ -633,8 +732,6 @@ class EdgeLoop:
                         self._src_claims[sess.group] = False
                 if out.get("shed") is not None:
                     self._shed += 1
-                    if _OBS.on:
-                        _M_EDGE_SHED.inc()
                 if _OBS.on:
                     _M_SESSIONS.inc()
                     _emit("sidecar.session", **out)
@@ -658,8 +755,6 @@ class EdgeLoop:
         p.close()
         if stats["shed"] is not None:
             self._shed += 1
-            if _OBS.on:
-                _M_EDGE_SHED.inc()
         if sess.not_source:
             out = {"fanout_peer": sess.key, "ok": False,
                    "not_source": True,
@@ -731,6 +826,7 @@ class EdgeLoop:
             "by_class": by_class,
             "by_kind": by_kind,
             "pump_route": effective_pump_route(),
+            "loop": self.profiler.state(),
         }
 
     def admission_state(self) -> dict:
@@ -749,7 +845,14 @@ class EdgeLoop:
 
     def _collect(self) -> dict:
         """Registry collector: per-QoS-class session gauges (bounded
-        cardinality: the class set is the preset table's)."""
+        cardinality: the class set is the preset table's) plus the
+        admission counters, labeled by loop and read straight off the
+        same attributes :meth:`admission_state` reports — the fleet
+        ``max_shed``/``max_rejected`` ceilings read the registry, so
+        these must be authoritative with or without the obs gate
+        (ISSUE 18 satellite: they used to be gate-dependent registered
+        counters that under-reported as zero)."""
+        loop = self.profiler.name
         gauges: dict = {"edge.sessions": float(len(self._table))}
         counts: dict = {}
         for sess in list(self._table.values()):
@@ -757,7 +860,13 @@ class EdgeLoop:
         for qos in QOS_PRESETS:
             gauges[f"edge.sessions{{class={qos}}}"] = float(
                 counts.get(qos, 0))
-        return {"counters": {}, "gauges": gauges}
+        counters = {
+            f"edge.served{{loop={loop}}}": self._served,
+            f"edge.admitted{{loop={loop}}}": self._admitted,
+            f"edge.rejected{{loop={loop}}}": self._rejected,
+            f"edge.shed{{loop={loop}}}": self._shed,
+        }
+        return {"counters": counters, "gauges": gauges}
 
 
 def serve_edge(host: str, port: int, *, hub=None, fanouts=None,
@@ -766,7 +875,8 @@ def serve_edge(host: str, port: int, *, hub=None, fanouts=None,
                group_of=None, max_sessions: Optional[int] = None,
                ready_cb=None,
                drain_timeout: Optional[float] = DEFAULT_DRAIN_TIMEOUT,
-               tick: float = EDGE_TICK) -> None:
+               tick: float = EDGE_TICK,
+               name: Optional[str] = None) -> None:
     """Bind + run one :class:`EdgeLoop` on the calling thread — the
     event-driven twin of :func:`~..sidecar.serve_tcp` (``max_sessions``
     bounds the loop for tests; ``ready_cb(port)`` fires once bound)."""
@@ -776,6 +886,6 @@ def serve_edge(host: str, port: int, *, hub=None, fanouts=None,
                     replica_node=replica_node, mode_of=mode_of,
                     qos_of=qos_of, group_of=group_of,
                     drain_timeout=drain_timeout,
-                    max_sessions=max_sessions, tick=tick)
+                    max_sessions=max_sessions, tick=tick, name=name)
     loop.bind(host, port)
     loop.serve(ready_cb)

@@ -3,6 +3,7 @@
     python -m dat_replication_protocol_tpu.obs timeline SENDER.jsonl RECEIVER.jsonl [PEER.jsonl ...]
     python -m dat_replication_protocol_tpu.obs export-trace LOG.jsonl|BUNDLE_DIR [-o OUT]
     python -m dat_replication_protocol_tpu.obs dump BUNDLE_DIR [--json]
+    python -m dat_replication_protocol_tpu.obs loopdoctor LOG.jsonl|BUNDLE_DIR [--threshold S] [--json]
     python -m dat_replication_protocol_tpu.obs perf-check BENCH.json [--budgets PATH] [--host-only]
     python -m dat_replication_protocol_tpu.obs fleet TARGET... [--check SLO.json | --watch]
 
@@ -36,6 +37,17 @@ timeline's conformance contract (tests/test_obs_timeline.py).
 into Chrome trace-event JSON, loadable in Perfetto.  ``dump`` renders
 a flight-recorder bundle (see obs/flight.py) for humans or, with
 ``--json``, for tools.
+
+``loopdoctor`` (ISSUE 18) ingests the same JSONL logs / flight
+bundles and reads the edge flight deck's ``edge.turn`` spans: it
+audits that recorded turns tile the loop's wall time exactly, totals
+per-phase seconds, finds stall turns (non-poll work past the
+threshold), and attributes their time to sessions from the profiler's
+top-K captures.  Exit 1 on any flag — a stall whose heaviest session
+the doctor can NAME (``stall-dominance``), a stall with no capture
+(``unattributed-stall``), or a tiling break (``tile-gap`` /
+``tile-overlap``).  A clean run reports final lag exactly 0 and
+flags nothing.
 
 ``perf-check`` is the perf-budget regression gate (ISSUE 5): it
 compares one bench artifact (the one JSON line ``bench.py`` prints)
@@ -420,6 +432,195 @@ def cmd_dump(args) -> int:
     return 0
 
 
+# -- loopdoctor (ISSUE 18): offline event-loop stall attribution -------------
+
+# the edge.turn span's phase field names, in the loop's phase order
+_TURN_PHASES = ("poll_wait", "accept", "read", "hub_drain", "tx",
+                "overload_ladder")
+
+# tiling tolerance: edge.turn spans are change-only but EXACT — each
+# span's ts is the previous recorded span's end, float-identical.  The
+# epsilon only absorbs JSON round-tripping of the floats.
+_TILE_TOL = 1e-6
+
+
+def _loopdoctor_analyze(spans: list[dict],
+                        threshold: Optional[float] = None) -> dict:
+    """Attribute loop stall time to phases and sessions from
+    ``edge.turn`` spans (the loopprof capture).  Returns
+    ``{"loops": {name: report}, "flags": [...]}``; flags:
+
+    * ``tile-gap`` / ``tile-overlap`` — consecutive turn spans do not
+      tile the loop's wall time (a profiler bug, not a workload one);
+    * ``stall-dominance`` — one session holds more than the stall
+      threshold of work inside overrun turns: the doctor names the
+      session AND the phase the time went to;
+    * ``unattributed-stall`` — an overrun turn carries no session
+      capture (the profiler should attach top-K on every lagging turn).
+
+    The stall threshold defaults to ``max(4 * tick, 0.03)`` per loop
+    (from the span's own ``tick`` field) — a turn is a stall when its
+    non-poll work alone spans multiple ticks."""
+    by_loop: dict = {}
+    for r in spans:
+        if r.get("span") != "edge.turn":
+            continue
+        f = r.get("fields") or {}
+        by_loop.setdefault(str(f.get("loop", "?")), []).append((r, f))
+    flags: list[dict] = []
+    loops: dict = {}
+    for lname, recs in sorted(by_loop.items()):
+        recs.sort(key=lambda rf: float(rf[0].get("ts") or 0.0))
+        tick = 0.05
+        for _r, f in recs:
+            if isinstance(f.get("tick"), (int, float)) and f["tick"] > 0:
+                tick = float(f["tick"])
+                break
+        thr = (float(threshold) if threshold is not None
+               else max(4.0 * tick, 0.03))
+        phase_s = {name: 0.0 for name in _TURN_PHASES}
+        sessions: dict = {}
+        stall_sessions: dict = {}
+        prev_end: Optional[float] = None
+        turns = 0
+        lag_max = 0.0
+        stall_s = 0.0
+        stall_turns = 0
+        for r, f in recs:
+            ts = float(r.get("ts") or 0.0)
+            dur = float(r.get("dur") or 0.0)
+            if prev_end is not None:
+                delta = ts - prev_end
+                if delta > _TILE_TOL:
+                    flags.append({
+                        "flag": "tile-gap", "loop": lname, "ts": ts,
+                        "detail": f"{delta:.6f}s of loop wall time "
+                                  f"missing before the span at "
+                                  f"ts={ts:.6f}"})
+                elif delta < -_TILE_TOL:
+                    flags.append({
+                        "flag": "tile-overlap", "loop": lname, "ts": ts,
+                        "detail": f"span at ts={ts:.6f} overlaps the "
+                                  f"previous turn by {-delta:.6f}s"})
+            prev_end = ts + dur
+            turns += int(f.get("turns") or 1)
+            for name in _TURN_PHASES:
+                v = f.get(name + "_s")
+                if isinstance(v, (int, float)):
+                    phase_s[name] += float(v)
+            work = float(f.get("work_s") or 0.0)
+            lag = float(f.get("lag_s") or 0.0)
+            lag_max = max(lag_max, lag)
+            top = f.get("top") or []
+            for ent in top:
+                key = str(ent.get("session", "?"))
+                s = sessions.setdefault(
+                    key, {"seconds": 0.0, "bytes": 0, "phases": {}})
+                sec = float(ent.get("seconds") or 0.0)
+                s["seconds"] += sec
+                s["bytes"] += int(ent.get("bytes") or 0)
+                ph = str(ent.get("phase", "?"))
+                s["phases"][ph] = s["phases"].get(ph, 0.0) + sec
+            if work > thr:
+                stall_s += work
+                stall_turns += 1
+                if not top:
+                    flags.append({
+                        "flag": "unattributed-stall", "loop": lname,
+                        "ts": ts,
+                        "detail": f"turn work {work:.3f}s exceeds the "
+                                  f"{thr:.3f}s stall threshold with no "
+                                  f"session capture"})
+                for ent in top:
+                    key = str(ent.get("session", "?"))
+                    s = stall_sessions.setdefault(
+                        key, {"seconds": 0.0, "bytes": 0, "phases": {}})
+                    sec = float(ent.get("seconds") or 0.0)
+                    s["seconds"] += sec
+                    s["bytes"] += int(ent.get("bytes") or 0)
+                    ph = str(ent.get("phase", "?"))
+                    s["phases"][ph] = s["phases"].get(ph, 0.0) + sec
+        for key, s in sorted(stall_sessions.items(),
+                             key=lambda kv: kv[1]["seconds"],
+                             reverse=True):
+            if s["seconds"] <= thr:
+                continue
+            phase = max(s["phases"].items(),
+                        key=lambda kv: kv[1])[0] if s["phases"] else "?"
+            flags.append({
+                "flag": "stall-dominance", "loop": lname,
+                "session": key, "phase": phase,
+                "seconds": round(s["seconds"], 6),
+                "detail": f"session {key} holds "
+                          f"{s['seconds']:.3f}s of stall work, "
+                          f"dominated by the {phase} phase"})
+        final_lag = float(recs[-1][1].get("lag_s") or 0.0) if recs \
+            else 0.0
+        wall = (prev_end - float(recs[0][0].get("ts") or 0.0)) \
+            if recs else 0.0
+        loops[lname] = {
+            "spans": len(recs),
+            "turns": turns,
+            "tick": tick,
+            "threshold_s": round(thr, 6),
+            "wall_s": round(wall, 6),
+            "phase_s": {k: round(v, 6) for k, v in phase_s.items()},
+            "final_lag_s": final_lag,
+            "lag_max_s": round(lag_max, 6),
+            "stall_s": round(stall_s, 6),
+            "stall_turns": stall_turns,
+            "sessions": {k: {"seconds": round(v["seconds"], 6),
+                             "bytes": v["bytes"],
+                             "phases": {p: round(sv, 6) for p, sv
+                                        in v["phases"].items()}}
+                         for k, v in sessions.items()},
+        }
+    return {"loops": loops, "flags": flags}
+
+
+def cmd_loopdoctor(args) -> int:
+    if os.path.isdir(args.log):
+        bundle = read_bundle(args.log)
+        spans = bundle["spans"]
+    else:
+        spans = [r for r in _load_jsonl(args.log) if "span" in r]
+    report = _loopdoctor_analyze(spans, threshold=args.threshold)
+    flags = report["flags"]
+    if args.json:
+        print(json.dumps(report))
+        return 1 if flags else 0
+    if not report["loops"]:
+        print("no edge.turn spans found: the loop either never ran lit "
+              "(obs gate off) or the log predates the flight deck")
+        return 0
+    for lname, rec in sorted(report["loops"].items()):
+        print(f"loop {lname}: {rec['turns']} turn(s) in "
+              f"{rec['spans']} span(s), wall {rec['wall_s']:.3f}s, "
+              f"tick {rec['tick']}s")
+        busy = {k: v for k, v in rec["phase_s"].items() if v}
+        print(f"  phases: " + (", ".join(
+            f"{k}={v:.3f}s" for k, v in sorted(
+                busy.items(), key=lambda kv: kv[1], reverse=True))
+            or "(idle)"))
+        print(f"  lag: final {rec['final_lag_s']:.3f}s, "
+              f"max {rec['lag_max_s']:.3f}s; stalls: "
+              f"{rec['stall_turns']} turn(s), {rec['stall_s']:.3f}s "
+              f"(threshold {rec['threshold_s']:.3f}s)")
+        heavy = sorted(rec["sessions"].items(),
+                       key=lambda kv: kv[1]["seconds"], reverse=True)[:5]
+        for key, s in heavy:
+            print(f"  session {key}: {s['seconds']:.3f}s, "
+                  f"{s['bytes']} byte(s)")
+    if flags:
+        for fl in flags:
+            where = fl.get("session") or fl.get("ts", "-")
+            print(f"FLAG {fl['flag']} [{fl['loop']}] {where}: "
+                  f"{fl['detail']}")
+    else:
+        print("-- clean: spans tile, no stall dominance")
+    return 1 if flags else 0
+
+
 def cmd_perf_check(args) -> int:
     from .perf import DEFAULT_BUDGETS_PATH, run_check
 
@@ -499,6 +700,20 @@ def main(argv=None) -> int:
     dp.add_argument("--json", action="store_true",
                     help="machine-readable output")
     dp.set_defaults(fn=cmd_dump)
+
+    ld = sub.add_parser(
+        "loopdoctor",
+        help="attribute event-loop stall time to phases and sessions "
+             "from edge.turn spans (JSONL log or flight bundle); "
+             "exit 1 on dominance or tiling flags")
+    ld.add_argument("log", help="JSONL log file, or a bundle directory")
+    ld.add_argument("--threshold", type=float, default=None,
+                    metavar="SECONDS",
+                    help="stall threshold per turn (default: "
+                         "max(4 * tick, 0.03) from each loop's spans)")
+    ld.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ld.set_defaults(fn=cmd_loopdoctor)
 
     pc = sub.add_parser(
         "perf-check",

@@ -84,7 +84,7 @@ DEFAULT_TIMEOUT = 5.0
 SLO_KEYS = frozenset({
     "max_lag_bytes", "max_lag_seconds", "require_converged",
     "max_shed", "max_rejected", "recompile_budget", "require_healthz",
-    "max_events_dropped", "gossip",
+    "max_events_dropped", "max_loop_lag_s", "gossip",
 })
 
 GOSSIP_SLO_KEYS = frozenset({
@@ -289,6 +289,20 @@ def _join_links(snaps: dict) -> dict:
     return links
 
 
+def _join_loops(snaps: dict) -> dict:
+    """Join every target's event-loop lag records (the watermark
+    snapshot's ``loops`` section, ISSUE 18) keyed ``target:loop`` —
+    two sidecars each running ``edge0`` must not shadow each other."""
+    out: dict = {}
+    for tname, snap in snaps.items():
+        wm = (snap or {}).get("watermarks") or {}
+        for lname, rec in sorted((wm.get("loops") or {}).items()):
+            if not isinstance(rec, dict):
+                continue
+            out[f"{tname}:{lname}"] = dict(rec, target=tname, loop=lname)
+    return out
+
+
 def _counter_sum(snaps: dict, names: tuple) -> int:
     total = 0
     for snap in snaps.values():
@@ -359,6 +373,7 @@ class FleetView:
             } for name, snap in snaps.items()},
             "errors": errors,
             "links": links,
+            "loops": _join_loops(snaps),
             "gossip": _join_gossip(snaps, self._gossip_baseline),
             "shed": _counter_sum(snaps, ("hub.shed", "fanout.peer.shed",
                                          "edge.shed")),
@@ -433,7 +448,8 @@ def load_slo(path: str) -> dict:
             f"SLO file {path}: no evaluable keys — an empty SLO would "
             "pass vacuously")
     for key in ("max_lag_bytes", "max_lag_seconds", "max_shed",
-                "max_rejected", "recompile_budget", "max_events_dropped"):
+                "max_rejected", "recompile_budget", "max_events_dropped",
+                "max_loop_lag_s"):
         if key in slo and not isinstance(slo[key], (int, float)):
             raise ValueError(f"SLO file {path}: {key} must be a number")
     for key in ("require_converged", "require_healthz"):
@@ -529,6 +545,23 @@ def evaluate_slo(slo: dict, sample: dict) -> list[dict]:
                 nq = len(r["quarantined"])
                 row("gossip.max_quarantined", tname, nq <= bound,
                     f"{nq} peer(s) quarantined, bound {bound}")
+    if "max_loop_lag_s" in slo:
+        bound = slo["max_loop_lag_s"]
+        loops = sample.get("loops") or {}
+        if not loops:
+            row("max_loop_lag_s", "-", False,
+                "no targets report event-loop lag: nothing to "
+                "evaluate against")
+        for lname, rec in sorted(loops.items()):
+            if rec.get("state") != "live":
+                row("max_loop_lag_s", lname, False,
+                    "loop telemetry dark (obs gate off): lag unknown")
+                continue
+            lag = float(rec.get("lag_s", 0.0))
+            row("max_loop_lag_s", lname, lag <= bound,
+                f"loop lag {lag:.3f}s "
+                f"(max {float(rec.get('lag_max_s', 0.0)):.3f}s), "
+                f"bound {bound}")
     if "max_shed" in slo:
         row("max_shed", "fleet", sample.get("shed", 0) <= slo["max_shed"],
             f"shed {sample.get('shed', 0)}, bound {slo['max_shed']}")
@@ -659,6 +692,22 @@ def render_dashboard(view: FleetView, sample: dict,
                 f"{_sparkline([b for _t, b, _s in ring])}")
     else:
         lines.append("  (no joined links yet)")
+    loops = sample.get("loops") or {}
+    if loops:
+        # the edge flight deck (ISSUE 18): per-loop lag watermarks
+        lines.append(bar)
+        lines.append(f"  {'loop':<28} {'lag_s':>8} {'max_s':>8} "
+                     f"{'oldest_s':>9} {'turns':>8}")
+        for lname, r in sorted(loops.items()):
+            if r.get("state") != "live":
+                lines.append(f"  {lname[:28]:<28} DARK (obs gate off)")
+                continue
+            lines.append(
+                f"  {lname[:28]:<28} "
+                f"{float(r.get('lag_s', 0.0)):>8.3f} "
+                f"{float(r.get('lag_max_s', 0.0)):>8.3f} "
+                f"{float(r.get('oldest_ready_s', 0.0)):>9.3f} "
+                f"{r.get('turns', 0):>8}")
     gossip = sample.get("gossip") or {}
     if gossip:
         # the per-replica convergence column (ISSUE 15): rounds-behind

@@ -113,7 +113,23 @@ def default_healthz(admission_fn: Optional[Callable[[], dict]] = None
             adm = {"open": False, "error": f"{type(e).__name__}: {e}"}
         stages["admission"] = {"ok": bool(adm.get("open")), **adm}
         ok = ok and bool(adm.get("open"))
-    # stage 3: observability itself (armed recorder, live gate)
+    # stage 3: event-loop lag (ISSUE 18) — a loop that has fallen
+    # behind its tick is degraded the same way a closed admission gate
+    # is: the flight deck's live lag view, plain attribute reads off
+    # each loop's profiler (lock-free, at worst one turn stale).  Dark
+    # loops (gate off) report state only — a stale zero must not read
+    # as healthy OR degraded
+    loops = _WATERMARKS.loops_now()
+    if loops:
+        behind = sorted(name for name, rec in loops.items()
+                        if rec.get("state") == "live"
+                        and rec.get("behind"))
+        lag = {name: rec.get("lag_s", 0.0) for name, rec in
+               loops.items() if rec.get("state") == "live"}
+        stages["loop_lag"] = {"ok": not behind, "behind": behind,
+                              "lag_s": lag}
+        ok = ok and not behind
+    # stage 4: observability itself (armed recorder, live gate)
     stages["flight_recorder"] = {"ok": True, "armed": _FLIGHT.armed}
     stages["obs_gate"] = {"ok": True, "on": _OBS.on}
     return {"ok": ok, "stages": stages, "ts": time.time(),

@@ -155,6 +155,10 @@ class WatermarkBoard:
         self._lock = threading.Lock()
         # datlint: guarded-by(self._lock): self._links
         self._links: dict[str, _Link] = {}
+        # datlint: guarded-by(self._lock): self._loops
+        # event-loop lag exporters (ISSUE 18): loop name -> zero-arg
+        # callable returning the loopprof export record
+        self._loops: dict[str, Callable[[], dict]] = {}
         self._collector_fn = self._collect
 
     # -- registration -------------------------------------------------------
@@ -191,6 +195,22 @@ class WatermarkBoard:
         stop appearing in snapshots; nothing leaks.  Idempotent."""
         with self._lock:
             self._links.pop(link, None)
+
+    def track_loop(self, name: str, fn: Callable[[], dict]) -> None:
+        """Track one event loop's lag exporter (ISSUE 18): ``fn()``
+        returns the :meth:`~.loopprof.LoopProfiler.export` record.
+        Same contract as :meth:`track` — idempotent replace, label
+        hygiene at the boundary, collector re-registration so a
+        ``Registry.reset()`` cannot dark the loop plane."""
+        _check_label("loop", name)
+        with self._lock:
+            self._loops[name] = fn
+        _REGISTRY.register_collector("watermarks", self._collector_fn)
+
+    def untrack_loop(self, name: str) -> None:
+        """Drop one loop's exporter (loop shutdown).  Idempotent."""
+        with self._lock:
+            self._loops.pop(name, None)
 
     def mark(self, link: str, end_offset: int) -> None:
         """Note that ``link``'s appended wire now ends at
@@ -233,6 +253,9 @@ class WatermarkBoard:
             links = {name: (entry, list(entry.marks), entry.marks_dropped)
                      for name, entry in self._links.items()}
         out: dict = {"monotonic": now, "links": {}}
+        loops = self.loops_now()
+        if loops:
+            out["loops"] = loops
         for name, (entry, marks, dropped) in links.items():
             offsets = self._read_cursors(entry)
             if not offsets:
@@ -264,9 +287,30 @@ class WatermarkBoard:
             out["links"][name] = rec
         return out
 
+    def loops_now(self) -> dict:
+        """Current per-loop lag records (the ``loops`` snapshot
+        section): loop name -> the exporter's dict.  Best-effort, the
+        same contract as cursor reads — a dying loop's exporter simply
+        goes missing."""
+        with self._lock:
+            loops = list(self._loops.items())
+        out: dict = {}
+        for name, fn in loops:
+            try:
+                # loop exporters are plain-attribute reads off the
+                # profiler (lock-free, one turn stale) — never blocking
+                # datlint: allow-callback-escape
+                rec = fn()
+            except Exception:
+                continue
+            if isinstance(rec, dict):
+                out[name] = rec
+        return out
+
     def _collect(self) -> dict:
         """Registry collector: one labeled gauge per tracked cursor
-        (bounded cardinality — untracked links stop appearing)."""
+        (bounded cardinality — untracked links stop appearing), plus
+        the per-loop lag gauges (``edge.loop.lag{loop=}``)."""
         gauges: dict = {}
         with self._lock:
             links = list(self._links.items())
@@ -274,13 +318,26 @@ class WatermarkBoard:
             for role, value in self._read_cursors(entry).items():
                 gauges[f"session.wire.offset{{link={name},role={role}}}"] = \
                     float(value)
+        for name, rec in self.loops_now().items():
+            if rec.get("state") != "live":
+                continue  # a dark loop exports nothing: stale zeros
+                #   would read as "caught up", the direction an SLO
+                #   gate must never err in
+            gauges[f"edge.loop.lag{{loop={name}}}"] = float(
+                rec.get("lag_s", 0.0))
+            gauges[f"edge.loop.lag_max{{loop={name}}}"] = float(
+                rec.get("lag_max_s", 0.0))
+            gauges[f"edge.loop.oldest_ready{{loop={name}}}"] = float(
+                rec.get("oldest_ready_s", 0.0))
         return {"gauges": gauges}
 
     def reset_for_tests(self) -> None:
-        """Drop every link (process-global state — test isolation is
-        explicit, the conftest ``obs_enabled`` contract)."""
+        """Drop every link and loop (process-global state — test
+        isolation is explicit, the conftest ``obs_enabled``
+        contract)."""
         with self._lock:
             self._links.clear()
+            self._loops.clear()
 
 
 WATERMARKS = WatermarkBoard()

@@ -1445,7 +1445,10 @@ def main(argv=None) -> int:
                 hub, fanouts={"main": fanout} if fanout else None,
                 reconcile_replica=replica,
                 snapshot_source=snapshot_source,
-                replica_node=replica_node, drain_timeout=drain)
+                replica_node=replica_node, drain_timeout=drain,
+                # a stable per-process loop label: the fleet joins
+                # edge.loop.lag{loop=} across targets by this name
+                name=f"edge:{host}:{int(port)}")
             set_active_edge(edge_loop)
             try:
                 edge_loop.bind(host, int(port))

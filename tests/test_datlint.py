@@ -923,6 +923,54 @@ def test_obs_discipline_ignores_unrelated_emit_and_histogram_apis(tmp_path):
     assert "obs-discipline" not in _rules_fired(findings)
 
 
+def test_obs_discipline_covers_loopprof_phase_accounting(tmp_path):
+    # ISSUE 18: phase names key the edge.turn.* histogram family, the
+    # turn-span fields, and loopdoctor's attribution — same greppable
+    # contract as metric names
+    findings = _lint(tmp_path, ("lp.py", '''
+        def f(prof, profiler, which, sess, dt, n):
+            prof.phase(which, dt)
+            profiler.account("over" + which, sess.key, dt, n)
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 2
+
+
+def test_obs_discipline_clean_on_literal_loopprof_phases(tmp_path):
+    # the SESSION argument of account() is runtime by design (a
+    # collector label, like a watermark LINK) — only the PHASE is held
+    # to the literal contract
+    assert _lint(tmp_path, ("lpok.py", '''
+        def f(prof, sess, dt, n):
+            prof.phase("accept", dt)
+            prof.account("read", sess.key, dt, n)
+            prof.account("overload-ladder", sess.key, dt, 0)
+    ''')) == []
+
+
+def test_obs_discipline_ignores_unrelated_phase_apis(tmp_path):
+    # `phase`/`account` on non-telemetry receivers: a state machine's
+    # phase setter, a billing API — out of scope
+    findings = _lint(tmp_path, ("phother.py", '''
+        def f(machine, billing, next_phase, user, amount):
+            machine.phase(next_phase)
+            billing.account(user, amount)
+    '''))
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
+def test_obs_discipline_exempts_the_loopprof_plumbing_itself(tmp_path):
+    # obs/loopprof.py accumulates forwarded phase names by design —
+    # the greppable literals live at the edge-loop call sites
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    (obs_dir / "loopprof.py").write_text(textwrap.dedent('''
+        def account(prof, name, session, seconds, nbytes):
+            prof.phase(name, seconds)
+    '''))
+    findings = run_paths([tmp_path])
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
 # -- hub-isolation (ISSUE 8: the shared-engine structural invariants) -------
 
 # the pre-discipline shape: a device dispatch while the hub lock is

@@ -119,12 +119,20 @@ def test_edge_hub_busy_rejection_is_structured(obs_enabled):
     """Overload stage 1 through the loop: past the hub's admission
     bound the client observes EOF with no reply bytes, the edge counts
     the rejection, and the hub's structured reject event fires — the
-    threaded leg's record, byte-for-byte."""
+    threaded leg's record, byte-for-byte.  The rejection shows up as
+    the loop's LABELED registry counter (collector-backed, read off
+    the admission attributes) cross-checked against
+    ``admission_state()`` — the ISSUE 18 satellite: the fleet
+    ``max_rejected`` ceiling reads the registry, so the count must be
+    there with the loop live, gate or no gate."""
     from dat_replication_protocol_tpu.obs.events import EVENTS
 
     hub = ReplicationHub(max_sessions=1)
     held = hub.register("occupant")
-    loop = EdgeLoop(hub, max_sessions=1)
+    # max_sessions=2 keeps the loop ALIVE after the rejection: the
+    # collector unregisters at shutdown, so the registry cross-check
+    # below must sample a live loop (the fleet poller's view)
+    loop = EdgeLoop(hub, max_sessions=2)
     try:
         port, t = _start_loop(loop)
         c = socket.create_connection(("127.0.0.1", port), timeout=10)
@@ -132,7 +140,10 @@ def test_edge_hub_busy_rejection_is_structured(obs_enabled):
         c.sendall(SESSION_1)
         assert _recv_all(c) == b""  # EOF, no decoder, no reply
         c.close()
-        t.join(timeout=10)
+        deadline = time.monotonic() + 5
+        while (loop.admission_state()["rejected"] < 1
+                and time.monotonic() < deadline):
+            time.sleep(0.01)
         snap = loop.snapshot()
         assert snap["rejected"] == 1 and snap["admitted"] == 0
         recs = [e["fields"] for e in EVENTS.events("sidecar.session")]
@@ -140,8 +151,18 @@ def test_edge_hub_busy_rejection_is_structured(obs_enabled):
             "changes": 0, "blobs": 0, "bytes": 0, "digests": 0,
             "ok": False, "rejected": True, "sessions": 1,
             "parked_bytes": 0}
-        assert obs_enabled.REGISTRY.counter("edge.rejected").value == 1
+        name = loop.profiler.name
+        counters = obs_enabled.REGISTRY.snapshot()["counters"]
+        assert counters[f"edge.rejected{{loop={name}}}"] == 1
+        assert counters[f"edge.served{{loop={name}}}"] == 1
+        assert counters[f"edge.admitted{{loop={name}}}"] == 0
+        assert counters[f"edge.shed{{loop={name}}}"] == 0
+        state = loop.admission_state()
+        assert state["rejected"] == 1 and state["shed"] == 0
         held.close()
+        loop.close()
+        t.join(timeout=10)
+        assert not t.is_alive()
     finally:
         hub.close()
 

@@ -1,0 +1,319 @@
+"""``obs loopdoctor`` (ISSUE 18): offline stall attribution, verified
+by a 20-seed chaos oracle over the live edge loop.
+
+The oracle: for each FaultPlan seed the sweep either injects a
+server-side read stall into the FaultPlan-elected session (the plan's
+``stall`` scenario) or runs fully clean.  The doctor, fed nothing but
+the ``edge.turn`` span JSONL the profiler wrote, must
+
+* on stall seeds — exit 1 with a ``stall-dominance`` flag naming the
+  faulted session AND the ``read`` phase, carrying at least the
+  injected stall duration;
+* on clean seeds — exit 0 with ZERO flags and a final lag of exactly
+  0.0 (the lag formula clamps clean turns to zero, not epsilon).
+
+A live ``/healthz`` integration run proves the loop-lag stage flips
+degraded DURING the stall and recovers after it, and CLI-level runs
+prove the exit codes end-to-end.
+"""
+
+import argparse
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dat_replication_protocol_tpu.edge import EdgeLoop
+from dat_replication_protocol_tpu.hub import ReplicationHub
+from dat_replication_protocol_tpu.obs.__main__ import (
+    _loopdoctor_analyze,
+    cmd_loopdoctor,
+)
+from dat_replication_protocol_tpu.obs.tracing import SPANS
+from dat_replication_protocol_tpu.session.faults import FaultPlan
+
+from test_wire_fixtures import SESSION_4
+
+N_SESSIONS = 4
+SEEDS = range(20)
+TICK = 0.05
+STALL_S = 0.35
+# explicit doctor threshold: far above any clean turn's work, well
+# under the injected stall
+THRESHOLD_S = 0.15
+
+
+def _recv_all(sock: socket.socket) -> bytes:
+    parts = []
+    while True:
+        try:
+            d = sock.recv(65536)
+        except OSError:
+            return b"".join(parts)
+        if not d:
+            return b"".join(parts)
+        parts.append(d)
+
+
+def _client(addr):
+    c = socket.create_connection(addr, timeout=10)
+    c.settimeout(20)
+    c.sendall(SESSION_4)
+    c.shutdown(socket.SHUT_WR)
+    assert _recv_all(c)
+    c.close()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warmup():
+    """One full session before the sweep: first-run compile/init costs
+    must not read as loop lag in the clean-seed oracle."""
+    hub = ReplicationHub(linger_s=0.002)
+    loop = EdgeLoop(hub, max_sessions=1, tick=TICK)
+    port = loop.bind("127.0.0.1", 0)
+    t = threading.Thread(target=loop.serve, daemon=True)
+    t.start()
+    try:
+        _client(("127.0.0.1", port))
+        t.join(timeout=15)
+    finally:
+        loop.close()
+        hub.close()
+
+
+def _stalling_read(faulty_key_prefix: str, fired: dict):
+    """An EdgeLoop._read_turn wrapper that parks the loop inside the
+    elected session's first read turn — the injected FaultPlan stall,
+    server-side, inside the phase-accounting window."""
+    orig = EdgeLoop._read_turn
+
+    def read_turn(self, sess, now):
+        if not fired.get("done") and sess.key.startswith(
+                faulty_key_prefix):
+            fired["done"] = True
+            time.sleep(STALL_S)
+        return orig(self, sess, now)
+
+    return read_turn
+
+
+def _run_sweep(monkeypatch, stall_session=None) -> tuple:
+    """N staggered sessions through one lit loop; returns (loop_name,
+    spans).  ``stall_session`` (0-based index) injects the read
+    stall into that session's turn."""
+    hub = ReplicationHub(linger_s=0.002)
+    loop = EdgeLoop(hub, max_sessions=N_SESSIONS, tick=TICK)
+    fired: dict = {}
+    if stall_session is not None:
+        # admission order is the 0.02s stagger below: session i is
+        # connection n=i+1, key c{n}:host:port
+        monkeypatch.setattr(
+            EdgeLoop, "_read_turn",
+            _stalling_read(f"c{stall_session + 1}:", fired))
+    port = loop.bind("127.0.0.1", 0)
+    t = threading.Thread(target=loop.serve, daemon=True)
+    t.start()
+    try:
+        addr = ("127.0.0.1", port)
+        threads = []
+        for _ in range(N_SESSIONS):
+            th = threading.Thread(target=_client, args=(addr,),
+                                  daemon=True)
+            threads.append(th)
+            th.start()
+            time.sleep(0.02)  # deterministic admission order
+        for th in threads:
+            th.join(20)
+            assert not th.is_alive(), "client HANG"
+        t.join(timeout=15)
+        assert not t.is_alive(), "loop HANG"
+    finally:
+        loop.close()
+        hub.close()
+    if stall_session is not None:
+        assert fired.get("done"), "stall was never injected"
+    name = loop.profiler.name
+    spans = [r for r in SPANS.spans("edge.turn")
+             if r["fields"]["loop"] == name]
+    return name, spans
+
+
+def _write_jsonl(tmp_path, spans) -> str:
+    path = tmp_path / "spans.jsonl"
+    with open(path, "w") as f:
+        for r in spans:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def _doctor(log: str, json_out=False) -> tuple:
+    args = argparse.Namespace(log=log, threshold=THRESHOLD_S,
+                              json=json_out)
+    return cmd_loopdoctor(args)
+
+
+# -- the 20-seed oracle ------------------------------------------------------
+
+def test_oracle_covers_both_arms():
+    scenarios = {FaultPlan.session_scenario(s, N_SESSIONS)
+                 for s in SEEDS}
+    assert "stall" in scenarios and len(scenarios) > 1
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_loopdoctor_oracle(seed, obs_enabled, monkeypatch, tmp_path,
+                           capsys):
+    faulty = FaultPlan.faulty_session(seed, N_SESSIONS)
+    scenario = FaultPlan.session_scenario(seed, N_SESSIONS)
+    stall = faulty if scenario == "stall" else None
+    name, spans = _run_sweep(monkeypatch, stall_session=stall)
+    assert spans, f"seed {seed}: no edge.turn spans recorded"
+    log = _write_jsonl(tmp_path, spans)
+    rc = _doctor(log)
+    out = capsys.readouterr().out
+    report = _loopdoctor_analyze(spans, threshold=THRESHOLD_S)
+    rec = report["loops"][name]
+    if scenario == "stall":
+        # the doctor names the faulted session, the read phase, and at
+        # least the injected stall duration — and exits 1
+        assert rc == 1, f"seed {seed}: doctored run passed clean"
+        dom = [fl for fl in report["flags"]
+               if fl["flag"] == "stall-dominance"]
+        assert dom, f"seed {seed}: no stall-dominance flag"
+        fl = dom[0]
+        assert fl["session"].startswith(f"c{faulty + 1}:"), (
+            f"seed {seed}: stall attributed to {fl['session']}, "
+            f"expected session c{faulty + 1}")
+        assert fl["phase"] == "read"
+        assert fl["seconds"] >= STALL_S
+        assert fl["session"] in out and "stall-dominance" in out
+        assert rec["lag_max_s"] >= STALL_S - TICK
+    else:
+        # clean seed: zero flags, exit 0, lag lands at EXACTLY zero
+        assert rc == 0, (
+            f"seed {seed} ({scenario}): clean run flagged: "
+            f"{report['flags']}")
+        assert report["flags"] == []
+        assert rec["final_lag_s"] == 0.0
+        assert "-- clean" in out
+
+
+# -- /healthz flips degraded during the stall and recovers -------------------
+
+def test_healthz_degrades_during_live_stall_and_recovers(
+        obs_enabled, monkeypatch):
+    from dat_replication_protocol_tpu.obs.http import default_healthz
+
+    hub = ReplicationHub(linger_s=0.002)
+    loop = EdgeLoop(hub, max_sessions=1, tick=TICK)
+    monkeypatch.setattr(EdgeLoop, "_read_turn",
+                        _stalling_read("c1:", {}))
+    port = loop.bind("127.0.0.1", 0)
+    t = threading.Thread(target=loop.serve, daemon=True)
+    t.start()
+    saw_degraded = False
+    try:
+        th = threading.Thread(target=_client,
+                              args=(("127.0.0.1", port),), daemon=True)
+        th.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            hz = default_healthz()
+            stage = hz["stages"].get("loop_lag")
+            if stage is not None and not stage["ok"]:
+                assert loop.profiler.name in stage["behind"]
+                assert not hz["ok"]
+                saw_degraded = True
+                break
+            time.sleep(0.01)
+        th.join(20)
+        t.join(timeout=15)
+        assert not t.is_alive()
+    finally:
+        loop.close()
+        hub.close()
+    assert saw_degraded, "/healthz never saw the stall"
+    # recovered: the loop detached at shutdown — no loops report, and
+    # a fresh clean loop reports ok
+    hz = default_healthz()
+    assert hz["stages"].get("loop_lag", {"ok": True})["ok"] is True
+
+
+# -- CLI end-to-end (exit codes through the real entrypoint) -----------------
+
+def _synthetic_spans(loop="edge-cli", stall=False) -> list:
+    """Hand-built tiling edge.turn spans: three clean turns, optionally
+    one stalled turn attributed to c2."""
+    base = 1000.0
+    spans = []
+    ts = base
+    turns = [(0.05, 0.001, None), (0.05, 0.002, None)]
+    if stall:
+        turns.append((0.001, 0.4, ("c2:127.0.0.1:5", 0.4, "read")))
+    turns.append((0.05, 0.001, None))
+    for poll, work, top in turns:
+        fields = {"loop": loop, "tick": 0.05, "turns": 1, "sessions": 1,
+                  "poll_wait_s": poll, "work_s": work,
+                  "lag_s": max(0.0, work - 0.05), "accept_s": 0.0,
+                  "read_s": work, "hub_drain_s": 0.0, "tx_s": 0.0,
+                  "overload_ladder_s": 0.0}
+        if top is not None:
+            key, sec, phase = top
+            fields["top"] = [{"session": key, "seconds": sec,
+                              "bytes": 512, "phase": phase}]
+        dur = poll + work
+        spans.append({"seq": 0, "ts": ts, "dur": dur, "span": "edge.turn",
+                      "id": len(spans) + 1, "parent": None, "tid": 1,
+                      "fields": fields})
+        ts += dur
+    return spans
+
+
+@pytest.mark.parametrize("stall,expect_rc", [(False, 0), (True, 1)])
+def test_loopdoctor_cli_exit_codes(tmp_path, stall, expect_rc):
+    path = tmp_path / "log.jsonl"
+    with open(path, "w") as f:
+        for r in _synthetic_spans(stall=stall):
+            f.write(json.dumps(r) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dat_replication_protocol_tpu.obs",
+         "loopdoctor", str(path), "--threshold", str(THRESHOLD_S)],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == expect_rc, proc.stdout + proc.stderr
+    if stall:
+        assert "c2:127.0.0.1:5" in proc.stdout
+        assert "stall-dominance" in proc.stdout
+    else:
+        assert "-- clean" in proc.stdout
+
+
+def test_loopdoctor_flags_broken_tiling():
+    spans = _synthetic_spans()
+    spans[2]["ts"] += 0.5  # tear a hole in the tiling
+    report = _loopdoctor_analyze(spans)
+    assert [fl["flag"] for fl in report["flags"]] == ["tile-gap"]
+    spans = _synthetic_spans()
+    spans[2]["ts"] -= 0.01
+    report = _loopdoctor_analyze(spans)
+    assert [fl["flag"] for fl in report["flags"]] == ["tile-overlap"]
+
+
+def test_loopdoctor_flags_unattributed_stall():
+    spans = _synthetic_spans(stall=True)
+    for r in spans:
+        r["fields"].pop("top", None)
+    report = _loopdoctor_analyze(spans, threshold=THRESHOLD_S)
+    assert any(fl["flag"] == "unattributed-stall"
+               for fl in report["flags"])
+
+
+def test_loopdoctor_empty_log_is_clean(tmp_path, capsys):
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    assert _doctor(str(path)) == 0
+    assert "no edge.turn spans" in capsys.readouterr().out

@@ -686,6 +686,91 @@ def test_unreachable_target_is_visible_not_fatal(tmp_path):
                for r in rows)
 
 
+# -- event-loop lag SLO (ISSUE 18) --------------------------------------------
+
+
+def _tracked_loop(name: str, lag_turn_s: float):
+    """One profiler on the board with a single finished turn of
+    ``lag_turn_s`` non-poll work (tick 0.05)."""
+    from dat_replication_protocol_tpu.obs.loopprof import LoopProfiler
+
+    prof = LoopProfiler(name, tick=0.05)
+    prof.attach()
+    prof.turn_begin(10.0)
+    prof.poll_done(10.001, 1)
+    prof.turn_done(10.001 + lag_turn_s, sessions=1)
+    return prof
+
+
+def test_loop_lag_slo_passes_on_caught_up_loop(obs_enabled):
+    prof = _tracked_loop("edge-ok", 0.001)  # clean: lag exactly 0
+    try:
+        view = FleetView([FleetTarget(default_snapshot, name="t0")])
+        sample = view.poll()
+        assert sample["loops"]["t0:edge-ok"]["lag_s"] == 0.0
+        rows = [r for r in evaluate_slo({"max_loop_lag_s": 0.25}, sample)
+                if r["check"] == "max_loop_lag_s"]
+        assert rows and all(r["status"] == "ok" for r in rows)
+    finally:
+        prof.detach()
+
+
+def test_loop_lag_slo_fails_on_loop_behind(obs_enabled):
+    prof = _tracked_loop("edge-slow", 0.6)  # 0.55s of lag
+    try:
+        view = FleetView([FleetTarget(default_snapshot, name="t0")])
+        rows = [r for r in
+                evaluate_slo({"max_loop_lag_s": 0.25}, view.poll())
+                if r["check"] == "max_loop_lag_s"]
+        assert rows and rows[0]["status"] == "fail"
+        assert rows[0]["subject"] == "t0:edge-slow"
+        assert "0.550" in rows[0]["detail"]
+    finally:
+        prof.detach()
+
+
+def test_loop_lag_slo_fails_loudly_on_dark_loop(obs_enabled):
+    """A loop whose gate is off must FAIL the check, not pass on stale
+    zeros — dark telemetry is an answer of 'unknown', and the SLO gate
+    treats unknown as breach."""
+    from dat_replication_protocol_tpu.obs import metrics
+
+    prof = _tracked_loop("edge-dark", 0.001)
+    try:
+        view = FleetView([FleetTarget(default_snapshot, name="t0")])
+        metrics.OBS.on = False
+        sample = view.poll()
+        metrics.enable()
+        assert sample["loops"]["t0:edge-dark"]["state"] == "dark"
+        rows = [r for r in
+                evaluate_slo({"max_loop_lag_s": 0.25}, sample)
+                if r["check"] == "max_loop_lag_s"]
+        assert rows and rows[0]["status"] == "fail"
+        assert "dark" in rows[0]["detail"]
+    finally:
+        metrics.enable()
+        prof.detach()
+
+
+def test_loop_lag_slo_fails_when_no_target_reports_loops(obs_enabled):
+    view = FleetView([FleetTarget(default_snapshot, name="t0")])
+    rows = [r for r in
+            evaluate_slo({"max_loop_lag_s": 0.25}, view.poll())
+            if r["check"] == "max_loop_lag_s"]
+    assert rows and rows[0]["status"] == "fail"
+    assert "no targets report" in rows[0]["detail"]
+
+
+def test_dashboard_renders_loop_lag_section(obs_enabled):
+    prof = _tracked_loop("edge-dash", 0.3)
+    try:
+        view = FleetView([FleetTarget(default_snapshot, name="t0")])
+        screen = render_dashboard(view, view.poll())
+        assert "t0:edge-dash" in screen
+    finally:
+        prof.detach()
+
+
 # -- SLO gate (the tier-1 live gate) ------------------------------------------
 
 
