@@ -331,3 +331,21 @@ if [ ! -f "$OUT/.leg_pump_done" ]; then
     && touch "$OUT/.leg_pump_done"
   commit_out "r06 watch: wire-pump device feed + hub scaling ladder ($STAMP)"
 fi
+
+# 11) ISSUE 19 mesh-convergence device leg: bench config 14 with the
+#     propagation plane lit (the bench lights it itself now) on the
+#     device host — exchange_p99_s and rounds_to_converge at N=64
+#     alongside the wall sweep, so the committed budget rows get a
+#     device-host reference next to the CI-host one.  The sim is
+#     host-group (in-process chaos transport), so config 3 rides along
+#     for the backend label, same as legs 5/6/9.
+if [ ! -f "$OUT/.leg_mesh_done" ]; then
+  BENCH_CONFIGS=3,14 BENCH_DEADLINE=900 timeout 1000 \
+    python bench.py --metrics >"$OUT/mesh_$STAMP.json" 2>"$OUT/mesh_$STAMP.log"
+  tail -c 16384 "$OUT/mesh_$STAMP.log" >"$OUT/mesh_$STAMP.log.tail" \
+    && rm -f "$OUT/mesh_$STAMP.log"
+  grep -q '"exchange_p99_s"' "$OUT/mesh_$STAMP.json" \
+    && device_artifact "$OUT/mesh_$STAMP.json" \
+    && touch "$OUT/.leg_mesh_done"
+  commit_out "r06 watch: gossip mesh propagation-plane device capture ($STAMP)"
+fi

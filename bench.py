@@ -2484,6 +2484,8 @@ def bench_gossip_converge(quick: bool, backend: str) -> dict:
     import time as _time
 
     from dat_replication_protocol_tpu.cluster import ClusterSim
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+    from dat_replication_protocol_tpu.obs.propagation import PROPAGATION
 
     ns_env = os.environ.get("BENCH_GOSSIP_N")
     ns = [int(x) for x in ns_env.split(",")] if ns_env else (
@@ -2493,30 +2495,45 @@ def bench_gossip_converge(quick: bool, backend: str) -> dict:
     divergence = int(os.environ.get("BENCH_GOSSIP_DIVERGENCE",
                                     "8" if quick else "24"))
     res: dict = {}
-    for n in ns:
-        # clean links: this config measures the protocol's cost, not
-        # its robustness (the chaos sweep in tests/ owns that); the
-        # fixed seed pins sampling so rounds are reproducible
-        sim = ClusterSim(n, seed=20_240, chaos=False,
-                         records_per=records, divergence=divergence)
-        t0 = _time.perf_counter()
-        out = sim.run()
-        dt = _time.perf_counter() - t0
-        if not out["converged"]:
-            return {"error": f"gossip mesh n={n} did not converge "
-                             f"within {out['bound']} rounds"}
-        # wire_x: total gossip wire over the divergence bytes that HAD
-        # to move — the O(diff) headline at mesh scale (1.0 would be a
-        # perfect oracle; rateless symbols + record framing ride on top)
-        wire_x = (sim.wire_bytes / sim.divergence_bytes
-                  if sim.divergence_bytes else 0.0)
-        res[n] = {"rounds": out["rounds"], "seconds": round(dt, 3),
-                  "wire_bytes": sim.wire_bytes,
-                  "divergence_bytes": sim.divergence_bytes,
-                  "wire_x": round(wire_x, 3)}
-        log(f"bench[gossip_converge]: n={n} rounds={out['rounds']} "
-            f"{dt:.2f}s wire={sim.wire_bytes} "
-            f"(divergence {sim.divergence_bytes}, x{wire_x:.2f})")
+    # the propagation plane LIT (ISSUE 19): this config prices its own
+    # overhead by its own gate — exchange_p99_s comes from the plane's
+    # board, and the seconds headline carries the lit-path cost
+    was_on = obs_metrics.OBS.on
+    obs_metrics.enable()
+    try:
+        for n in ns:
+            # clean links: this config measures the protocol's cost,
+            # not its robustness (the chaos sweep in tests/ owns
+            # that); the fixed seed pins sampling so rounds are
+            # reproducible
+            PROPAGATION.reset_for_tests()
+            sim = ClusterSim(n, seed=20_240, chaos=False,
+                             records_per=records, divergence=divergence)
+            t0 = _time.perf_counter()
+            out = sim.run()
+            dt = _time.perf_counter() - t0
+            if not out["converged"]:
+                return {"error": f"gossip mesh n={n} did not converge "
+                                 f"within {out['bound']} rounds"}
+            # wire_x: total gossip wire over the divergence bytes that
+            # HAD to move — the O(diff) headline at mesh scale (1.0
+            # would be a perfect oracle; rateless symbols + record
+            # framing ride on top)
+            wire_x = (sim.wire_bytes / sim.divergence_bytes
+                      if sim.divergence_bytes else 0.0)
+            p99 = PROPAGATION.exchange_p99()
+            res[n] = {"rounds": out["rounds"], "seconds": round(dt, 3),
+                      "wire_bytes": sim.wire_bytes,
+                      "divergence_bytes": sim.divergence_bytes,
+                      "wire_x": round(wire_x, 3),
+                      "exchange_p99_s": round(p99 or 0.0, 6)}
+            log(f"bench[gossip_converge]: n={n} rounds={out['rounds']} "
+                f"{dt:.2f}s wire={sim.wire_bytes} "
+                f"(divergence {sim.divergence_bytes}, x{wire_x:.2f}, "
+                f"exchange p99 {p99 or 0.0:.4f}s)")
+    finally:
+        PROPAGATION.reset_for_tests()
+        obs_metrics.OBS.on = was_on
     top = max(ns)
     return {
         "metric": "gossip_converge_seconds",
@@ -2530,13 +2547,21 @@ def bench_gossip_converge(quick: bool, backend: str) -> dict:
         "divergence_per": divergence,
         "rounds_top": res[top]["rounds"],
         "wire_x_top": res[top]["wire_x"],
+        # the convergence-plane budget fields (ISSUE 19): p99 wall
+        # seconds of one lit exchange at the top mesh size, and the
+        # rounds the top mesh took to converge — both gated in
+        # perf_budgets.json so the plane's own overhead is priced
+        "exchange_p99_s": res[top]["exchange_p99_s"],
+        "rounds_to_converge": res[top]["rounds"],
         **{f"rounds_{n}": res[n]["rounds"] for n in ns},
         **{f"seconds_{n}": res[n]["seconds"] for n in ns},
         **{f"wire_bytes_{n}": res[n]["wire_bytes"] for n in ns},
         **{f"wire_x_{n}": res[n]["wire_x"] for n in ns},
+        **{f"exchange_p99_s_{n}": res[n]["exchange_p99_s"] for n in ns},
         "reduced_config": top < 64 or records < 192 or divergence < 24,
         "full_config": "N in {4,16,64}, 192 base + 24 unique records "
-                       "per replica, clean links, fixed seed",
+                       "per replica, clean links, fixed seed, "
+                       "propagation plane lit",
     }
 
 

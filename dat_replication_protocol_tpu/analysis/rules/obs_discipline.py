@@ -77,7 +77,8 @@ _PLUMBING = {("obs", "metrics.py"), ("obs", "events.py"),
              ("obs", "tracing.py"), ("obs", "flight.py"),
              ("obs", "device.py"), ("obs", "__init__.py"),
              ("obs", "watermarks.py"), ("obs", "http.py"),
-             ("obs", "fleet.py"), ("obs", "loopprof.py")}
+             ("obs", "fleet.py"), ("obs", "loopprof.py"),
+             ("obs", "propagation.py")}
 # the /healthz lock-discipline check applies to the endpoint module
 _HEALTHZ_MODULE = ("obs", "http.py")
 

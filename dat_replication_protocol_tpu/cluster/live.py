@@ -25,8 +25,10 @@ import random
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
+from ..obs import propagation as _propagation
 from ..obs.metrics import OBS as _OBS, counter as _counter
 from ..runtime.reconcile_driver import run_initiator, run_responder
 from ..session.reconnect import BackoffPolicy
@@ -56,15 +58,39 @@ def absorb_responder_stats(node: ReplicaNode, stats: dict) -> dict:
 
 
 def serve_responder_session(node: ReplicaNode, read_bytes, write_bytes,
-                            close_write=None) -> dict:
+                            close_write=None, *,
+                            peer: str = "inbound") -> dict:
     """Serve one inbound anti-entropy session against the node's
     current replica state and absorb whatever the initiator shipped.
     Returns the responder stats dict (``run_responder``'s, plus
     ``applied``); raises the session's ONE structured ProtocolError on
-    a failed decode."""
-    stats = run_responder(node.replica, read_bytes, write_bytes,
-                          close_write=close_write)
-    return absorb_responder_stats(node, stats)
+    a failed decode.  ``peer`` labels the provenance record when the
+    transport knows the dialer (the event-driven edge passes the
+    remote address; the bare TCP leg cannot)."""
+    t0 = time.monotonic()
+    try:
+        stats = run_responder(node.replica, read_bytes, write_bytes,
+                              close_write=close_write)
+        out = absorb_responder_stats(node, stats)
+    except Exception as e:
+        if _OBS.on:
+            _propagation.record_exchange(
+                node.key, peer, role="responder", rnd=node.round,
+                outcome=classify_error(e),
+                seconds=time.monotonic() - t0,
+                error=f"{type(e).__name__}: {e}")
+        raise
+    if _OBS.on:
+        diff = out["applied"] + out.get("records_sent", 0)
+        _propagation.record_exchange(
+            node.key, peer, role="responder", rnd=node.round,
+            outcome="converged" if diff == 0 else "progress",
+            seconds=time.monotonic() - t0, diff=diff,
+            wire_bytes=len(out.get("received") or b""),
+            repair_bytes=len(out.get("received") or b""))
+        _propagation.note_frontier(node.key, node.content_digest().hex(),
+                                   node.record_count, node.round)
+    return out
 
 
 class GossipDriver:
@@ -95,6 +121,11 @@ class GossipDriver:
         self._failed_streak = 0
         self.peer_stats = {p: {"ok": 0, "transport": 0, "corrupt": 0}
                            for p in self.peers}
+        # monotonic stamp of the last SUCCESSFUL exchange per peer: a
+        # silently-dead link shows up as a growing age, not a frozen
+        # counter (ISSUE 19 satellite; surfaced by snapshot())
+        self._last_success: dict[str, Optional[float]] = {
+            p: None for p in self.peers}
         self._thread = threading.Thread(
             target=self._run, name=f"gossip-{node.key}", daemon=True)
 
@@ -119,15 +150,18 @@ class GossipDriver:
         if addr is None:
             return None
         host, _, port = addr.rpartition(":")
+        t0 = time.monotonic()
         if _OBS.on:
             _M_DIALS.inc()
         try:
             conn = socket.create_connection(
                 (host or "127.0.0.1", int(port)),
                 timeout=self._dial_timeout)
-        except OSError:
+        except OSError as e:
             node.note_transport_failure(addr)
             self.peer_stats[addr]["transport"] += 1
+            if _OBS.on:
+                self._record_lit("transport", addr, t0, error=str(e))
             return None
         try:
             # kernel-level timeouts, NOT settimeout(): Python's timeout
@@ -158,13 +192,20 @@ class GossipDriver:
             if classify_error(e) == "corruption":
                 self.peer_stats[addr]["corrupt"] += 1
                 node.note_corruption(addr, e)
+                if _OBS.on:
+                    self._record_lit("corruption", addr, t0,
+                                     error=f"{type(e).__name__}: {e}")
             else:
                 node.note_transport_failure(addr)
                 self.peer_stats[addr]["transport"] += 1
+                if _OBS.on:
+                    self._record_lit("transport", addr, t0, error=str(e))
             return None
-        except OSError:
+        except OSError as e:
             node.note_transport_failure(addr)
             self.peer_stats[addr]["transport"] += 1
+            if _OBS.on:
+                self._record_lit("transport", addr, t0, error=str(e))
             return None
         finally:
             try:
@@ -173,11 +214,32 @@ class GossipDriver:
                 pass
         node.note_success(addr)
         self.peer_stats[addr]["ok"] += 1
-        if stats["received"]:
-            node.absorb(stats["received"])
+        self._last_success[addr] = time.monotonic()
+        applied = node.absorb(stats["received"]) if stats["received"] \
+            else 0
         if stats.get("records_sent"):
             node.stats["repairs_sent"] += stats["records_sent"]
+        if _OBS.on:
+            diff = applied + stats.get("records_sent", 0)
+            self._record_lit(
+                "converged" if diff == 0 else "progress", addr, t0,
+                diff=diff, wire_bytes=len(stats.get("received") or b""))
+            _propagation.note_frontier(
+                node.key, node.content_digest().hex(),
+                node.record_count, node.round)
         return stats
+
+    def _record_lit(self, outcome: str, addr: str, t0: float, *,
+                    diff: Optional[int] = None, wire_bytes: int = 0,
+                    error: Optional[str] = None) -> None:
+        """One lit-path provenance record for the dial leg (the live
+        initiator never goes through :func:`~.node.gossip_exchange`,
+        so it records its own direction here)."""
+        _propagation.record_exchange(
+            self.node.key, addr, role="initiator", rnd=self.node.round,
+            outcome=outcome, seconds=time.monotonic() - t0, diff=diff,
+            wire_bytes=wire_bytes, repair_bytes=wire_bytes, t0=t0,
+            error=error)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -196,9 +258,21 @@ class GossipDriver:
     # -- telemetry -----------------------------------------------------------
 
     def snapshot(self) -> dict:
-        """The gossip record ``--stats-fd`` / ``/snapshot`` carry."""
+        """The gossip record ``--stats-fd`` / ``/snapshot`` carry.
+        Each peer entry grows ``last_success_age_s`` (None until the
+        first success — a silently-dead link is a growing age, not a
+        frozen counter) and the node's cumulative ``suspicion`` toward
+        that address (ISSUE 19 satellite)."""
         out = self.node.snapshot()
         out["interval"] = self.interval
-        out["peers"] = {addr: dict(st)
-                        for addr, st in self.peer_stats.items()}
+        now = time.monotonic()
+        peers = {}
+        for addr, st in self.peer_stats.items():
+            entry = dict(st)
+            last = self._last_success.get(addr)
+            entry["last_success_age_s"] = (
+                None if last is None else round(now - last, 6))
+            entry["suspicion"] = self.node._suspect.get(addr, 0)
+            peers[addr] = entry
+        out["peers"] = peers
         return out

@@ -46,11 +46,13 @@ from __future__ import annotations
 import hashlib
 import random
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
 from ..fanout.log import BroadcastLog, SnapshotNeeded
+from ..obs import propagation as _propagation
 from ..obs.events import emit as _emit
 from ..obs.metrics import OBS as _OBS, counter as _counter
 from ..runtime import replay
@@ -590,6 +592,17 @@ class ReplicaNode:
             "records": self.record_count,
             "digest": self.content_digest().hex(),
             "quarantined": sorted(self.quarantined),
+            # quarantine PROVENANCE (ISSUE 19): the structured
+            # ByzantineDivergence coordinates, so the fleet matrix can
+            # show not just THAT a peer is out but which arm caught it
+            # and where on the wire — checkable against the byzantine
+            # injector's ground truth
+            "quarantine": {
+                peer: {"arm": err.arm, "frame": err.frame,
+                       "offset": err.offset}
+                for peer, err in sorted(self.quarantined.items())
+            },
+            "suspicion": {k: v for k, v in sorted(self._suspect.items())},
             **{k: v for k, v in self.stats.items()},
         }
 
@@ -697,7 +710,14 @@ def gossip_exchange(initiator: ReplicaNode, responder: ReplicaNode, *,
     responder.refuse_if_quarantined(initiator.key)
     initiator.refuse_if_quarantined(responder.key)
     initiator.state = responder.state = "gossip"
+    # the ISSUE 19 lit/dark fork (PR 18 discipline): the dark twin
+    # `_exchange` references NO propagation symbol — asserted at the
+    # bytecode level — so the disabled cost of the whole convergence
+    # plane is the one `_OBS.on` attribute load below
     try:
+        if _OBS.on:
+            return _exchange_lit(initiator, responder, plan_out,
+                                 plan_back, engine, batch0, overhead_cap)
         return _exchange(initiator, responder, plan_out, plan_back,
                          engine, batch0, overhead_cap)
     finally:
@@ -816,7 +836,56 @@ def _exchange(initiator, responder, plan_out, plan_back, engine,
         "applied_responder": applied_b,
         "wire_initiator": for_initiator or b"",
         "wire_responder": for_responder or b"",
+        "want_digests": wants,
     }
+
+
+def _exchange_lit(initiator, responder, plan_out, plan_back, engine,
+                  batch0, overhead_cap) -> dict:
+    """The lit twin of :func:`_exchange` (ISSUE 19): same engine, plus
+    one ``gossip.exchange`` provenance record per direction and the
+    divergence/frontier watermarks — the diff size IS the exchange's
+    own peel result, the delivered digest prefixes are the edges of
+    the meshdoctor's propagation tree.  Reached only through the
+    ``_OBS.on`` fork in :func:`gossip_exchange`."""
+    rnd = max(initiator.round, responder.round)
+    t0 = time.monotonic()
+    try:
+        res = _exchange(initiator, responder, plan_out, plan_back,
+                        engine, batch0, overhead_cap)
+    except Exception as e:
+        seconds = time.monotonic() - t0
+        outcome = classify_error(e)
+        err = f"{type(e).__name__}: {e}"
+        for a, b, role in ((initiator, responder, "initiator"),
+                           (responder, initiator, "responder")):
+            _propagation.record_exchange(
+                a.key, b.key, role=role, rnd=rnd, outcome=outcome,
+                seconds=seconds, t0=t0, error=err)
+        raise
+    seconds = time.monotonic() - t0
+    outcome = "converged" if res["diff"] == 0 else "progress"
+    deliv_i = deliv_r = ()
+    if res["wire_responder"]:
+        deliv_r = _propagation.digest_prefixes(res["want_digests"])
+    if res["wire_initiator"]:
+        deliv_i = _propagation.digest_prefixes(RatelessReplica(
+            np.frombuffer(res["wire_initiator"], np.uint8)).digests)
+    repair = len(res["wire_initiator"]) + len(res["wire_responder"])
+    _propagation.record_exchange(
+        initiator.key, responder.key, role="initiator", rnd=rnd,
+        outcome=outcome, seconds=seconds, diff=res["diff"],
+        wire_bytes=res["wire_bytes"], repair_bytes=repair,
+        delivered=deliv_i, delivered_peer=deliv_r, t0=t0)
+    _propagation.record_exchange(
+        responder.key, initiator.key, role="responder", rnd=rnd,
+        outcome=outcome, seconds=seconds, diff=res["diff"],
+        wire_bytes=res["wire_bytes"], repair_bytes=repair,
+        delivered=deliv_r, delivered_peer=deliv_i, t0=t0)
+    for node in (initiator, responder):
+        _propagation.note_frontier(node.key, node.content_digest().hex(),
+                                   node.record_count, rnd)
+    return res
 
 
 def _decoded_rows(data: bytes, corrupt, side: str) -> int:

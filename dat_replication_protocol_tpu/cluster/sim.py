@@ -36,6 +36,8 @@ import random
 from typing import Optional
 
 from ..fanout.log import SnapshotNeeded
+from ..obs import propagation as _propagation
+from ..obs.metrics import OBS as _OBS
 from ..session.faults import FaultPlan, TransportFault
 from ..wire.framing import ProtocolError
 from .node import (
@@ -166,6 +168,15 @@ class ClusterSim:
             k: nd.checkpoint() for k, nd in self.nodes.items()}
         self._down: dict[str, ReplicaNode] = {}
         self._rng = rng
+        if _OBS.on:
+            # the meshdoctor's ground-truth frame + provenance roots:
+            # what each replica held BEFORE any exchange (round 0)
+            _propagation.note_mesh(n, seed, self.rounds_bound())
+            for key, nd in self.nodes.items():
+                _propagation.note_hold(
+                    key, _propagation.digest_prefixes(nd.replica.digests))
+                _propagation.note_frontier(
+                    key, nd.content_digest().hex(), nd.record_count, 0)
 
     # -- views ---------------------------------------------------------------
 
@@ -245,6 +256,13 @@ class ClusterSim:
                 node.note_transport_failure(peer_key)
                 rec["outcome"] = "transport"
                 rec["error"] = "peer crashed"
+                if _OBS.on:
+                    # never reaches gossip_exchange's lit fork: the
+                    # dial itself found a dead peer
+                    _propagation.record_exchange(
+                        key, peer_key, role="initiator", rnd=rnd,
+                        outcome="transport", seconds=0.0,
+                        error="peer crashed")
                 ev["exchanges"].append(rec)
                 continue
             plan_out = plan_back = None
@@ -264,6 +282,13 @@ class ClusterSim:
                 node.stats["refusals"] += 1
                 rec["outcome"] = "refused"
                 rec["error"] = str(e)
+                if _OBS.on:
+                    # refusal happens BEFORE the exchange engine's lit
+                    # fork (the quarantine check is the front door), so
+                    # the provenance record is made here
+                    _propagation.record_exchange(
+                        key, peer_key, role="initiator", rnd=rnd,
+                        outcome="refused", seconds=0.0, error=str(e))
             except TransportFault as e:
                 node.note_transport_failure(peer_key)
                 target.note_transport_failure(key)
@@ -329,6 +354,16 @@ class ClusterSim:
                 self.wire_bytes += res["wire_bytes"]
                 if self.fanout:
                     self._follows[key] = [donor.key]
+                if _OBS.on:
+                    # snapshot bootstrap is an out-of-band acquisition:
+                    # a provenance ROOT, not an exchange delivery
+                    _propagation.note_hold(
+                        key,
+                        _propagation.digest_prefixes(node.replica.digests),
+                        rnd=rnd)
+                    _propagation.note_frontier(
+                        key, node.content_digest().hex(),
+                        node.record_count, rnd)
                 ev["joined"].append({"replica": key, "donor": donor.key,
                                      "wire_bytes": res["wire_bytes"]})
 
@@ -359,6 +394,20 @@ class ClusterSim:
             log = self.nodes[key].log
             if log is not None:
                 log.enforce_retention()
+        if _OBS.on:
+            # feed drains deliver records OUTSIDE any exchange: record
+            # them as provenance holds (change-only via the frontier),
+            # or the meshdoctor would flag feed-spread digests as
+            # orphaned when a follower later re-ships them
+            for key in self.alive():
+                nd = self.nodes[key]
+                if _propagation.note_frontier(
+                        key, nd.content_digest().hex(),
+                        nd.record_count, rnd):
+                    _propagation.note_hold(
+                        key,
+                        _propagation.digest_prefixes(nd.replica.digests),
+                        rnd=rnd)
 
     # -- the driver ----------------------------------------------------------
 

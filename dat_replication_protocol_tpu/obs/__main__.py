@@ -4,6 +4,7 @@
     python -m dat_replication_protocol_tpu.obs export-trace LOG.jsonl|BUNDLE_DIR [-o OUT]
     python -m dat_replication_protocol_tpu.obs dump BUNDLE_DIR [--json]
     python -m dat_replication_protocol_tpu.obs loopdoctor LOG.jsonl|BUNDLE_DIR [--threshold S] [--json]
+    python -m dat_replication_protocol_tpu.obs meshdoctor LOG... [--json]
     python -m dat_replication_protocol_tpu.obs perf-check BENCH.json [--budgets PATH] [--host-only]
     python -m dat_replication_protocol_tpu.obs fleet TARGET... [--check SLO.json | --watch]
 
@@ -48,6 +49,21 @@ the doctor can NAME (``stall-dominance``), a stall with no capture
 (``unattributed-stall``), or a tiling break (``tile-gap`` /
 ``tile-overlap``).  A clean run reports final lag exactly 0 and
 flags nothing.
+
+``meshdoctor`` (ISSUE 19) is the loopdoctor's mesh sibling: it ingests
+N replicas' JSONL logs / flight bundles and reads the convergence
+plane's records (``gossip.mesh`` / ``gossip.hold`` /
+``gossip.exchange`` spans / ``gossip.frontier``), reconstructs the
+per-record propagation tree — which exchange first delivered each
+digest to each replica — and attributes slow convergence to the exact
+link, round, and quarantine.  Exit 1 on any flag: ``orphaned-digest``
+(a delivered digest its sender never held), ``stalled-link`` (>= 2
+distinct transport-failure rounds on one pair with no interleaved
+success — the partition signature), ``asymmetric-link`` (one direction
+persistently failing while the reverse succeeds), or
+``rounds-bound-exceeded`` (convergence past the ``gossip.mesh``
+record's ``rounds_bound()`` budget).  A clean converged log flags
+nothing and reports final divergence exactly 0.
 
 ``perf-check`` is the perf-budget regression gate (ISSUE 5): it
 compares one bench artifact (the one JSON line ``bench.py`` prints)
@@ -621,6 +637,317 @@ def cmd_loopdoctor(args) -> int:
     return 1 if flags else 0
 
 
+# -- meshdoctor (ISSUE 19): offline gossip-convergence attribution -----------
+
+# an exchange direction that moved (or proved empty) the diff vs one
+# that failed: the vocabulary obs/propagation.py records
+_X_OK = ("converged", "progress")
+_X_FAIL = ("transport",)
+
+
+def _mesh_records(paths: list[str]) -> tuple[list[dict], list[dict]]:
+    """Events + spans from N JSONL logs / flight bundles, merged."""
+    events: list[dict] = []
+    spans: list[dict] = []
+    for path in paths:
+        if os.path.isdir(path):
+            bundle = read_bundle(path)
+            events.extend(bundle["events"])
+            spans.extend(bundle["spans"])
+        else:
+            for r in _load_jsonl(path):
+                if "span" in r:
+                    spans.append(r)
+                elif "event" in r:
+                    events.append(r)
+    return events, spans
+
+
+def _dedupe_exchanges(spans: list[dict]) -> list[dict]:
+    """One record per exchange: the in-process engine records BOTH
+    directions of every exchange (initiator + responder views of the
+    same peel), keyed here by (round, dialer, dialee) with the
+    initiator's view preferred — its ``delivered``/``delivered_peer``
+    orientation is the canonical one.  One-sided records (live dials,
+    refusals, dead peers) pass through unchanged."""
+    best: dict = {}
+    order: list = []
+    for r in spans:
+        if r.get("span") != "gossip.exchange":
+            continue
+        f = r.get("fields") or {}
+        role = f.get("role")
+        me, peer = str(f.get("replica")), str(f.get("peer"))
+        dialer, dialee = (me, peer) if role == "initiator" else (peer, me)
+        key = (int(f.get("round") or 0), dialer, dialee)
+        cur = best.get(key)
+        if cur is None:
+            best[key] = r
+            order.append(key)
+        elif role == "initiator" and \
+                (cur.get("fields") or {}).get("role") != "initiator":
+            best[key] = r
+    out = []
+    for key in order:
+        r = best[key]
+        f = dict(r.get("fields") or {})
+        rnd, dialer, dialee = key
+        if f.get("role") == "initiator":
+            deliv_dialer = list(f.get("delivered") or ())
+            deliv_dialee = list(f.get("delivered_peer") or ())
+        else:
+            deliv_dialer = list(f.get("delivered_peer") or ())
+            deliv_dialee = list(f.get("delivered") or ())
+        out.append({
+            "round": rnd, "dialer": dialer, "dialee": dialee,
+            "outcome": f.get("outcome"), "error": f.get("error"),
+            "seconds": f.get("seconds"), "diff": f.get("diff"),
+            "wire_bytes": f.get("wire_bytes"),
+            "delivered_dialer": deliv_dialer,
+            "delivered_dialee": deliv_dialee,
+            "ts": float(r.get("ts") or 0.0),
+        })
+    out.sort(key=lambda x: (x["round"], x["ts"]))
+    return out
+
+
+def _link_runs(rounds_events: list[tuple[int, bool]]) -> list[list[int]]:
+    """Maximal runs of DISTINCT failure rounds uninterrupted by a
+    success, over (round, ok) observations sorted by round.  Rounds
+    with no observation do not break a run — a partitioned pair is
+    only sampled some rounds, and the stall spans the gap."""
+    runs: list[list[int]] = []
+    cur: list[int] = []
+    for rnd, ok in rounds_events:
+        if ok:
+            if cur:
+                runs.append(cur)
+            cur = []
+        elif not cur or cur[-1] != rnd:
+            cur.append(rnd)
+    if cur:
+        runs.append(cur)
+    return runs
+
+
+def _meshdoctor_analyze(events: list[dict], spans: list[dict]) -> dict:
+    """Reconstruct the per-record propagation tree and attribute
+    convergence (or its failure) to exact links/rounds/quarantines.
+    Flags:
+
+    * ``orphaned-digest`` — an exchange delivered a digest its sender
+      was never recorded holding (provenance break: a hold record is
+      missing, or the mesh shipped content from nowhere);
+    * ``stalled-link`` — an undirected pair failed transport in >= 2
+      DISTINCT rounds with no successful exchange in between (the
+      partition signature: one-shot chaos faults fire in at most one
+      round per link, so a repeat offender is a cut, not a bad cable);
+    * ``asymmetric-link`` — one DIRECTION failed >= 2 distinct rounds
+      while the reverse direction succeeded inside the same span (a
+      half-open link: NAT, a one-way filter, an asymmetric route);
+    * ``rounds-bound-exceeded`` — the mesh converged after the
+      ``gossip.mesh`` record's ``rounds_bound()`` budget, or never
+      converged within it.
+
+    A clean converged log flags nothing and reports final divergence
+    exactly 0 (``distinct_frontiers == 1``)."""
+    mesh = None
+    for r in events:
+        if r.get("event") == "gossip.mesh":
+            mesh = dict(r.get("fields") or {})
+    holds = [r for r in events if r.get("event") == "gossip.hold"]
+    frontiers = [r for r in events if r.get("event") == "gossip.frontier"]
+    quarantines = [dict((r.get("fields") or {}), ts=r.get("ts"))
+                   for r in events
+                   if r.get("event") == "gossip.quarantine"]
+    exchanges = _dedupe_exchanges(spans)
+    flags: list[dict] = []
+
+    # -- the propagation tree: first delivery of each digest ------------------
+    holding: dict[str, set] = {}
+    tree: dict[str, dict] = {}
+    check_provenance = bool(holds)
+
+    def acquire(replica: str, digest: str, rnd: int, via: str) -> None:
+        holding.setdefault(replica, set()).add(digest)
+        tree.setdefault(digest, {}).setdefault(
+            replica, {"round": rnd, "via": via})
+
+    items: list[tuple] = []
+    for r in holds:
+        f = r.get("fields") or {}
+        items.append((int(f.get("round") or 0), float(r.get("ts") or 0.0),
+                      0, ("hold", f)))
+    for x in exchanges:
+        items.append((x["round"], x["ts"], 1, ("exchange", x)))
+    items.sort(key=lambda it: it[:3])
+    for rnd, _ts, _k, (kind, payload) in items:
+        if kind == "hold":
+            rep = str(payload.get("replica"))
+            for d in payload.get("digests") or ():
+                acquire(rep, str(d), rnd, "hold")
+            continue
+        x = payload
+        for receiver, sender, digests in (
+                (x["dialer"], x["dialee"], x["delivered_dialer"]),
+                (x["dialee"], x["dialer"], x["delivered_dialee"])):
+            for d in digests:
+                d = str(d)
+                if check_provenance and sender in holding \
+                        and d not in holding[sender]:
+                    flags.append({
+                        "flag": "orphaned-digest", "digest": d,
+                        "link": f"{sender}->{receiver}", "round": rnd,
+                        "detail": f"exchange at round {rnd} delivered "
+                                  f"digest {d} to {receiver}, but sender "
+                                  f"{sender} was never recorded holding "
+                                  f"it (provenance break)"})
+                acquire(receiver, d, rnd,
+                        f"exchange:{sender}->{receiver}")
+
+    # -- link health: stalls and asymmetry ------------------------------------
+    by_dir: dict[tuple, list] = {}
+    for x in exchanges:
+        if x["outcome"] in _X_OK or x["outcome"] in _X_FAIL:
+            by_dir.setdefault((x["dialer"], x["dialee"]), []).append(
+                (x["round"], x["outcome"] in _X_OK))
+    pairs: dict[tuple, list] = {}
+    for (a, b), obs in by_dir.items():
+        pairs.setdefault(tuple(sorted((a, b))), []).extend(obs)
+    for pair, obs in sorted(pairs.items()):
+        obs.sort()
+        for run in _link_runs(obs):
+            if len(run) >= 2:
+                flags.append({
+                    "flag": "stalled-link",
+                    "link": f"{pair[0]}<->{pair[1]}", "rounds": run,
+                    "detail": f"link {pair[0]}<->{pair[1]} failed "
+                              f"transport in {len(run)} distinct "
+                              f"round(s) {run[0]}..{run[-1]} with no "
+                              f"successful exchange in between (the "
+                              f"partition signature: one-shot chaos "
+                              f"faults fire at most once per link)"})
+    for (a, b), obs in sorted(by_dir.items()):
+        obs.sort()
+        rev = sorted(by_dir.get((b, a), ()))
+        for run in _link_runs(obs):
+            if len(run) < 2:
+                continue
+            rev_ok = [rnd for rnd, ok in rev
+                      if ok and run[0] <= rnd <= run[-1]]
+            if rev_ok:
+                flags.append({
+                    "flag": "asymmetric-link", "link": f"{a}->{b}",
+                    "rounds": run,
+                    "detail": f"direction {a}->{b} failed transport in "
+                              f"{len(run)} distinct round(s) "
+                              f"{run[0]}..{run[-1]} while {b}->{a} "
+                              f"succeeded in round(s) {rev_ok} — a "
+                              f"half-open link, not a partition"})
+
+    # -- convergence vs the bound ---------------------------------------------
+    final: dict[str, dict] = {}
+    for r in frontiers:
+        f = r.get("fields") or {}
+        rep = str(f.get("replica"))
+        cur = final.get(rep)
+        if cur is None or int(f.get("round") or 0) >= cur["round"]:
+            final[rep] = {"round": int(f.get("round") or 0),
+                          "digest": f.get("digest"),
+                          "records": f.get("records")}
+    digests = {v["digest"] for v in final.values()}
+    converged = bool(final) and len(digests) == 1
+    convergence_round = (max(v["round"] for v in final.values())
+                         if converged else None)
+    bound = int(mesh["bound"]) if mesh and "bound" in mesh else None
+    last_round = max([x["round"] for x in exchanges]
+                     + [v["round"] for v in final.values()] + [0])
+    if bound is not None:
+        if converged and convergence_round > bound:
+            flags.append({
+                "flag": "rounds-bound-exceeded",
+                "round": convergence_round,
+                "detail": f"mesh converged at round {convergence_round}, "
+                          f"past the rounds_bound() budget of {bound}"})
+        elif not converged and final and last_round >= bound:
+            flags.append({
+                "flag": "rounds-bound-exceeded", "round": last_round,
+                "detail": f"mesh never converged: {len(digests)} "
+                          f"distinct frontiers at round {last_round}, "
+                          f"budget {bound}"})
+
+    # -- slow-convergence attribution -----------------------------------------
+    # the digests that arrived LAST, and the exact exchange that
+    # finally delivered each — the "which link, which round, which
+    # record" answer the plane exists for
+    last_arrivals = []
+    for d, deliveries in tree.items():
+        worst = max(deliveries.items(), key=lambda kv: kv[1]["round"])
+        last_arrivals.append({"digest": d, "replica": worst[0],
+                              "round": worst[1]["round"],
+                              "via": worst[1]["via"]})
+    last_arrivals.sort(key=lambda e: (-e["round"], e["digest"]))
+
+    return {
+        "mesh": mesh,
+        "replicas": final,
+        "converged": converged,
+        "convergence_round": convergence_round,
+        "distinct_frontiers": len(digests),
+        "bound": bound,
+        "exchanges": len(exchanges),
+        "quarantines": quarantines,
+        "slowest": last_arrivals[:8],
+        "tree_digests": len(tree),
+        "flags": flags,
+    }
+
+
+def cmd_meshdoctor(args) -> int:
+    events, spans = _mesh_records(args.logs)
+    report = _meshdoctor_analyze(events, spans)
+    if args.json:
+        print(json.dumps(report))
+        return 1 if report["flags"] else 0
+    if not report["exchanges"] and not report["replicas"]:
+        print("no gossip.exchange spans or gossip.frontier events "
+              "found: the mesh either never ran lit (obs gate off) or "
+              "the log predates the convergence plane")
+        return 0
+    mesh = report["mesh"] or {}
+    print(f"mesh: {mesh.get('n', '?')} replica(s), "
+          f"seed {mesh.get('seed', '?')}, "
+          f"bound {report['bound'] if report['bound'] is not None else '?'}"
+          f" — {report['exchanges']} exchange(s), "
+          f"{report['tree_digests']} digest(s) tracked")
+    if report["converged"]:
+        print(f"converged at round {report['convergence_round']} "
+              f"(final divergence exactly 0: every frontier "
+              f"byte-identical)")
+    else:
+        print(f"NOT converged: {report['distinct_frontiers']} distinct "
+              f"frontier digest(s)")
+    for rep, rec in sorted(report["replicas"].items()):
+        print(f"  {rep}: round {rec['round']}, "
+              f"{rec.get('records', '?')} record(s), "
+              f"{(rec.get('digest') or '?')[:16]}")
+    for q in report["quarantines"]:
+        print(f"  quarantine: {q.get('replica')} cut {q.get('peer')} "
+              f"(arm {q.get('arm')}, offset {q.get('offset')})")
+    for e in report["slowest"][:4]:
+        print(f"  slowest: digest {e['digest']} reached {e['replica']} "
+              f"at round {e['round']} via {e['via']}")
+    if report["flags"]:
+        for fl in report["flags"]:
+            where = fl.get("link") or fl.get("digest") or \
+                fl.get("round", "-")
+            print(f"FLAG {fl['flag']} [{where}]: {fl['detail']}")
+    else:
+        print("-- clean: provenance intact, no stalled or asymmetric "
+              "links, convergence within bound")
+    return 1 if report["flags"] else 0
+
+
 def cmd_perf_check(args) -> int:
     from .perf import DEFAULT_BUDGETS_PATH, run_check
 
@@ -714,6 +1041,20 @@ def main(argv=None) -> int:
     ld.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ld.set_defaults(fn=cmd_loopdoctor)
+
+    md = sub.add_parser(
+        "meshdoctor",
+        help="reconstruct the per-record propagation tree from "
+             "gossip.exchange spans (N JSONL logs / flight bundles), "
+             "attribute slow convergence to exact links/rounds/"
+             "quarantines; exit 1 on orphaned-digest / stalled-link / "
+             "asymmetric-link / rounds-bound-exceeded flags")
+    md.add_argument("logs", nargs="+", metavar="LOG",
+                    help="JSONL log file(s) and/or bundle directories "
+                         "from the mesh's replicas")
+    md.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    md.set_defaults(fn=cmd_meshdoctor)
 
     pc = sub.add_parser(
         "perf-check",

@@ -54,12 +54,23 @@ passes vacuously is NOT allowed, same contract as perf budgets):
     ``max_quarantined`` (per-replica quarantine-count bound).
     Evaluated over the ``gossip`` records ``--replica`` sidecars embed
     in their snapshots; no targets reporting gossip is a loud failure,
-    same contract as an unjoined link.
+    same contract as an unjoined link.  The mesh convergence plane
+    (ISSUE 19) adds ``max_convergence_rounds`` (validated against the
+    epidemic ``rounds_bound()`` floor — a bound below it is an
+    unreachable SLO and fails as a misconfiguration),
+    ``max_divergence_bytes`` (per undirected pair, from the exchange's
+    own peel watermark; frontier digest equality is authoritative for
+    "exactly 0"), ``max_exchange_age_s`` (per directed link, age of
+    the last SUCCESSFUL exchange), and ``max_exchange_p99_s``
+    (fleet-wide exchange-latency quantile).  These evaluate over the
+    ``propagation`` sections; no targets reporting the plane is a loud
+    failure (the PR 18 "lag unknown" rule).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -76,6 +87,9 @@ __all__ = [
     "load_slo",
     "render_dashboard",
     "SLO_KEYS",
+    "GOSSIP_SLO_KEYS",
+    "MESH_SLO_KEYS",
+    "mesh_rounds_floor",
 ]
 
 DEFAULT_HISTORY = 128
@@ -87,9 +101,18 @@ SLO_KEYS = frozenset({
     "max_events_dropped", "max_loop_lag_s", "gossip",
 })
 
+# the mesh convergence plane's SLO vocabulary (ISSUE 19): evaluated
+# over the ``propagation`` sections ``--replica`` sidecars embed — the
+# per-pair divergence watermarks, per-link last-success ages, and the
+# exchange-latency quantile the plane itself measures
+MESH_SLO_KEYS = frozenset({
+    "max_convergence_rounds", "max_divergence_bytes",
+    "max_exchange_age_s", "max_exchange_p99_s",
+})
+
 GOSSIP_SLO_KEYS = frozenset({
     "require_converged", "max_rounds_behind", "max_quarantined",
-})
+}) | MESH_SLO_KEYS
 
 
 def _join_gossip(snaps: dict, baselines: dict) -> dict:
@@ -131,9 +154,74 @@ def _join_gossip(snaps: dict, baselines: dict) -> dict:
             "records": r.get("records"),
             "digest": r.get("digest"),
             "quarantined": list(r.get("quarantined") or ()),
+            # structured quarantine PROVENANCE (ISSUE 19): which arm
+            # caught each quarantined peer and where on the wire —
+            # the byzantine oracle checks these against ground truth
+            "quarantine": dict(r.get("quarantine") or {}),
+            "suspicion": dict(r.get("suspicion") or {}),
             "state": r.get("state"),
         }
     return out
+
+
+def _join_mesh(snaps: dict) -> dict:
+    """Join every target's ``propagation`` section (ISSUE 19) into the
+    fleet convergence matrix: per directed link the freshest exchange
+    watermark across targets (by round), per replica the freshest
+    frontier, per UNDIRECTED pair the effective divergence — **frontier
+    digest equality is authoritative**: a link watermark is the diff at
+    the pair's LAST exchange, so a pair whose frontiers are
+    byte-identical has divergence exactly 0 whatever a stale watermark
+    says.  ``exchange_p99_s`` is the worst per-target p99 (quantiles
+    do not merge across windows; the max is the conservative fleet
+    bound)."""
+    links: dict = {}
+    frontier: dict = {}
+    p99 = None
+    count = 0
+    for tname, snap in sorted(snaps.items()):
+        prop = (snap or {}).get("propagation")
+        if not isinstance(prop, dict):
+            continue
+        for lname, rec in (prop.get("links") or {}).items():
+            cur = links.get(lname)
+            if cur is None or int(rec.get("round") or 0) >= \
+                    int(cur.get("round") or 0):
+                links[lname] = dict(rec, target=tname)
+        for rname, rec in (prop.get("frontier") or {}).items():
+            cur = frontier.get(rname)
+            if cur is None or int(rec.get("round") or 0) >= \
+                    int(cur.get("round") or 0):
+                frontier[rname] = dict(rec, target=tname)
+        xs = prop.get("exchange_seconds") or {}
+        if xs.get("p99") is not None:
+            p99 = xs["p99"] if p99 is None else max(p99, xs["p99"])
+            count += int(xs.get("count") or 0)
+    if not links and not frontier:
+        return {}
+    pairs: dict = {}
+    for lname, rec in links.items():
+        a, _, b = lname.partition("->")
+        key = "<->".join(sorted((a, b)))
+        cur = pairs.get(key)
+        if cur is not None and int(cur.get("round") or 0) > \
+                int(rec.get("round") or 0):
+            continue
+        da = (frontier.get(a) or {}).get("digest")
+        db = (frontier.get(b) or {}).get("digest")
+        conv = da is not None and da == db
+        pairs[key] = {
+            "round": rec.get("round"),
+            "converged": conv,
+            "divergence_records": 0 if conv
+            else rec.get("divergence_records"),
+            "divergence_bytes": 0 if conv
+            else rec.get("divergence_bytes"),
+            "last_success_age_s": rec.get("last_success_age_s"),
+            "outcome": rec.get("outcome"),
+        }
+    return {"links": links, "pairs": pairs, "frontier": frontier,
+            "exchange_p99_s": p99, "exchange_count": count}
 
 
 class FleetTarget:
@@ -375,6 +463,7 @@ class FleetView:
             "links": links,
             "loops": _join_loops(snaps),
             "gossip": _join_gossip(snaps, self._gossip_baseline),
+            "mesh": _join_mesh(snaps),
             "shed": _counter_sum(snaps, ("hub.shed", "fanout.peer.shed",
                                          "edge.shed")),
             "rejected": _counter_sum(snaps, ("hub.rejected",
@@ -468,7 +557,9 @@ def load_slo(path: str) -> dict:
             raise ValueError(
                 f"SLO file {path}: empty gossip object would pass "
                 "vacuously")
-        for key in ("max_rounds_behind", "max_quarantined"):
+        for key in ("max_rounds_behind", "max_quarantined",
+                    "max_convergence_rounds", "max_divergence_bytes",
+                    "max_exchange_age_s", "max_exchange_p99_s"):
             if key in g and not isinstance(g[key], (int, float)):
                 raise ValueError(
                     f"SLO file {path}: gossip.{key} must be a number")
@@ -478,6 +569,92 @@ def load_slo(path: str) -> dict:
                 f"SLO file {path}: gossip.require_converged must be a "
                 "boolean")
     return slo
+
+
+def mesh_rounds_floor(n_replicas: int) -> int:
+    """The epidemic rounds floor an SLO's ``max_convergence_rounds``
+    must clear: ``3*ceil(log2(n)) + 10`` — the no-chaos core of
+    :meth:`~..cluster.sim.ClusterSim.rounds_bound`.  A bound below what
+    epidemic spread mathematically needs is an unreachable SLO, and an
+    unreachable gate is a misconfiguration, not a standard."""
+    return 3 * math.ceil(math.log2(max(2, int(n_replicas)))) + 10
+
+
+def _evaluate_mesh_slo(g: dict, mesh: dict, row) -> None:
+    """The mesh-key rows of the gossip SLO (ISSUE 19), over a joined
+    ``mesh`` sample (:func:`_join_mesh`)."""
+    frontier = mesh.get("frontier") or {}
+    links = mesh.get("links") or {}
+    pairs = mesh.get("pairs") or {}
+    if "max_convergence_rounds" in g:
+        bound = g["max_convergence_rounds"]
+        n = len(frontier) or 2
+        floor = mesh_rounds_floor(n)
+        if bound < floor:
+            row("gossip.max_convergence_rounds", "slo", False,
+                f"bound {bound} is below the epidemic rounds_bound() "
+                f"floor {floor} for {n} replica(s) — an unreachable SLO")
+        else:
+            digests = {r.get("digest") for r in frontier.values()}
+            conv = len(digests) == 1 and None not in digests
+            last_change = max((int(r.get("round") or 0)
+                               for r in frontier.values()), default=0)
+            if conv:
+                row("gossip.max_convergence_rounds", "fleet",
+                    last_change <= bound,
+                    f"converged at round {last_change}, bound {bound}")
+            else:
+                cur = max([int(r.get("round") or 0)
+                           for r in links.values()] + [last_change],
+                          default=0)
+                row("gossip.max_convergence_rounds", "fleet",
+                    cur <= bound,
+                    f"not converged at round {cur} ({len(digests)} "
+                    f"distinct frontiers), bound {bound}")
+    if "max_divergence_bytes" in g:
+        bound = g["max_divergence_bytes"]
+        if not pairs:
+            row("gossip.max_divergence_bytes", "-", False,
+                "no exchange watermarks joined: divergence unknown")
+        for pname, p in sorted(pairs.items()):
+            db = p.get("divergence_bytes")
+            if p.get("converged"):
+                row("gossip.max_divergence_bytes", pname, True,
+                    "frontiers byte-identical (divergence exactly 0)")
+            elif db is None:
+                row("gossip.max_divergence_bytes", pname, False,
+                    "no completed peel yet: divergence unknown")
+            else:
+                row("gossip.max_divergence_bytes", pname, db <= bound,
+                    f"divergence {db} byte(s) "
+                    f"({p.get('divergence_records')} record(s)) at "
+                    f"round {p.get('round')}, bound {bound}")
+    if "max_exchange_age_s" in g:
+        bound = g["max_exchange_age_s"]
+        if not links:
+            row("gossip.max_exchange_age_s", "-", False,
+                "no exchange watermarks joined: link ages unknown")
+        for lname, rec in sorted(links.items()):
+            age = rec.get("last_success_age_s")
+            if age is None:
+                row("gossip.max_exchange_age_s", lname, False,
+                    "no successful exchange on this link yet: a "
+                    "silently-dead link, not a passing one")
+            else:
+                row("gossip.max_exchange_age_s", lname, age <= bound,
+                    f"last successful exchange {age:.3f}s ago, "
+                    f"bound {bound}")
+    if "max_exchange_p99_s" in g:
+        bound = g["max_exchange_p99_s"]
+        p99 = mesh.get("exchange_p99_s")
+        if p99 is None:
+            row("gossip.max_exchange_p99_s", "fleet", False,
+                "no completed exchanges: p99 unknown")
+        else:
+            row("gossip.max_exchange_p99_s", "fleet", p99 <= bound,
+                f"exchange p99 {p99:.4f}s over "
+                f"{mesh.get('exchange_count', 0)} exchange(s), "
+                f"bound {bound}")
 
 
 def evaluate_slo(slo: dict, sample: dict) -> list[dict]:
@@ -545,6 +722,19 @@ def evaluate_slo(slo: dict, sample: dict) -> list[dict]:
                 nq = len(r["quarantined"])
                 row("gossip.max_quarantined", tname, nq <= bound,
                     f"{nq} peer(s) quarantined, bound {bound}")
+        mesh_keys = MESH_SLO_KEYS & set(g)
+        if mesh_keys:
+            mesh = sample.get("mesh") or {}
+            if not mesh:
+                # the PR 18 "lag unknown" rule, applied to the mesh: an
+                # SLO over a plane nobody reports must fail loudly —
+                # a dark plane is indistinguishable from a broken one
+                row("gossip.mesh", "-", False,
+                    "no targets report propagation records: the mesh "
+                    "convergence plane is dark — nothing to evaluate "
+                    f"{sorted(mesh_keys)} against")
+            else:
+                _evaluate_mesh_slo(g, mesh, row)
     if "max_loop_lag_s" in slo:
         bound = slo["max_loop_lag_s"]
         loops = sample.get("loops") or {}
@@ -722,6 +912,34 @@ def render_dashboard(view: FleetView, sample: dict,
                 f"{str(r.get('records', '-')):>8} "
                 f"{len(r['quarantined']):>5}  "
                 f"{(r.get('digest') or '?')[:16]}")
+    mesh = sample.get("mesh") or {}
+    if mesh:
+        # the convergence matrix (ISSUE 19): per-pair divergence from
+        # the exchange's own peel, per-link success age, exchange p99
+        lines.append(bar)
+        lines.append(f"  {'pair':<16} {'div_rec':>8} {'div_B':>8} "
+                     f"{'ok_age_s':>9} {'round':>6}  outcome")
+        for pname, p in sorted((mesh.get("pairs") or {}).items()):
+            dr, db = p.get("divergence_records"), p.get("divergence_bytes")
+            age = p.get("last_success_age_s")
+            lines.append(
+                f"  {pname[:16]:<16} "
+                f"{('?' if dr is None else str(dr)):>8} "
+                f"{('?' if db is None else str(db)):>8} "
+                f"{('-' if age is None else f'{age:.2f}'):>9} "
+                f"{str(p.get('round', '-')):>6}  "
+                f"{'converged' if p.get('converged') else p.get('outcome') or '?'}")
+        p99 = mesh.get("exchange_p99_s")
+        lines.append(
+            f"  exchange p99 "
+            f"{('-' if p99 is None else f'{p99:.4f}s')} over "
+            f"{mesh.get('exchange_count', 0)} exchange(s)")
+        for tname, r in sorted(gossip.items()):
+            for peer, q in sorted((r.get("quarantine") or {}).items()):
+                lines.append(
+                    f"  quarantine {r.get('replica') or tname}: {peer} "
+                    f"arm={q.get('arm')} frame={q.get('frame')} "
+                    f"offset={q.get('offset')}")
     lines.append(bar)
     rec = sample.get("reconcile") or {}
     lines.append(

@@ -48,6 +48,7 @@ from .obs import tracing as obs_tracing
 from .obs.events import emit as _emit
 from .obs.metrics import OBS as _OBS, counter as _counter
 from .obs.tracing import trace_span as _trace_span
+from .obs.propagation import PROPAGATION as _PROPAGATION
 from .obs.watermarks import WATERMARKS as _WATERMARKS
 from .session import pump as session_pump
 from .session.transport import recv_over, send_over
@@ -1063,6 +1064,14 @@ def snapshot_stats() -> dict:
         # counters + the content digest — what `obs fleet` derives the
         # per-replica rounds-behind convergence column from
         out["gossip"] = _ACTIVE_GOSSIP.snapshot()
+        # the mesh convergence plane (ISSUE 19): per-link exchange
+        # provenance + divergence watermarks + frontier — the fleet
+        # matrix join input.  Empty boards (plane dark) are omitted so
+        # the loud-failure rule in `obs fleet` can tell "plane off"
+        # from "no exchanges yet".
+        prop = _PROPAGATION.snapshot()
+        if prop["links"] or prop["frontier"]:
+            out["propagation"] = prop
     if _ACTIVE_EDGE is not None:
         # edge mode (ISSUE 17): the unified session-table aggregate —
         # per-QoS-class and per-kind session counts, admission/shed

@@ -51,7 +51,7 @@ def obs_enabled():
     afterwards — all five are process-global, so isolation is
     explicit."""
     from dat_replication_protocol_tpu.obs import device, events, flight, \
-        metrics, tracing, watermarks
+        metrics, propagation, tracing, watermarks
 
     was_on = metrics.OBS.on
     metrics.REGISTRY.reset()
@@ -61,6 +61,7 @@ def obs_enabled():
     device.SENTINEL.reset_for_tests()
     device.reset_engine_notes()
     watermarks.WATERMARKS.reset_for_tests()
+    propagation.PROPAGATION.reset_for_tests()
     metrics.enable()
     try:
         yield metrics
@@ -75,3 +76,4 @@ def obs_enabled():
         device.SENTINEL.reset_for_tests()
         device.reset_engine_notes()
         watermarks.WATERMARKS.reset_for_tests()
+        propagation.PROPAGATION.reset_for_tests()

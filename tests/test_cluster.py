@@ -469,6 +469,92 @@ def test_fleet_gossip_slo_no_targets_is_a_failure(tmp_path):
     assert "no targets report gossip" in buf.getvalue()
 
 
+# -- quarantine provenance through the fleet plane (ISSUE 19 satellite) ------
+
+
+@pytest.mark.parametrize("arm,want_arm", [
+    ("wrong-symbol", "wrong-symbol"),
+    ("wrong-chunk", "wrong-chunk-digest"),
+])
+def test_fleet_reports_quarantine_provenance_matching_injector(arm,
+                                                               want_arm):
+    """The fleet join's per-replica ``quarantine`` record must equal
+    the injector's own ground truth — WHO was cut, on WHICH arm, at
+    which frame/offset — straight from each node's structured
+    :class:`ByzantineDivergence`, at every poll."""
+    sim = _byz_sim(arm)
+    out = sim.run()
+    assert out["converged"]
+    view = fleet.FleetView(_targets(sim))
+    for _ in range(2):  # provenance is stable across polls
+        sample = view.poll()
+        reporting = 0
+        for tname, row in sample["gossip"].items():
+            node = sim.nodes[tname]
+            truth = {peer: {"arm": d.arm, "frame": d.frame,
+                            "offset": d.offset}
+                     for peer, d in node.quarantined.items()}
+            assert row["quarantine"] == truth, tname
+            assert row["suspicion"] == dict(node._suspect), tname
+            if tname == sim.byzantine_key:
+                continue  # the liar also cuts honest peers it framed
+            if truth:
+                reporting += 1
+                assert set(truth) == {"r1"}, \
+                    "honest replicas cut only the liar on clean links"
+                assert all(v["arm"] == want_arm for v in truth.values())
+        assert reporting, "nobody quarantined the byzantine replica"
+    # the dashboard renders the provenance line when the mesh section
+    # is present (a mesh sample forces the section)
+    sample["mesh"] = {"pairs": {}, "exchange_p99_s": None,
+                      "exchange_count": 0}
+    frame = fleet.render_dashboard(view, sample)
+    assert f"arm={want_arm}" in frame
+    assert "quarantine" in frame
+
+
+# -- mesh convergence SLO against a live in-process mesh (tier-1 gate) -------
+
+
+def test_fleet_check_mesh_slo_on_in_process_mesh(obs_enabled, tmp_path):
+    """The ISSUE 19 live gate: ``obs fleet --check`` with the four
+    mesh SLO keys over a 3-replica in-process mesh that gossiped LIT —
+    per-pair divergence exactly 0, every link fresh, p99 bounded."""
+    from dat_replication_protocol_tpu.obs.propagation import PROPAGATION
+
+    sim = ClusterSim(3, seed=7, records_per=6, divergence=2, chaos=False)
+    assert sim.run()["converged"]
+
+    def target(key):
+        node = sim.nodes[key]
+        return lambda: {"ts": 0.0,
+                        "watermarks": {"monotonic": 0.0, "links": {}},
+                        "gossip": node.snapshot(),
+                        "propagation": PROPAGATION.snapshot()}
+
+    targets = [fleet.FleetTarget(target(k), name=k) for k in sim.nodes]
+    slo = _slo_file(tmp_path, {"gossip": {
+        "require_converged": True,
+        "max_convergence_rounds": fleet.mesh_rounds_floor(3),
+        "max_divergence_bytes": 0,
+        "max_exchange_age_s": 120.0,
+        "max_exchange_p99_s": 30.0,
+    }})
+    buf = io.StringIO()
+    assert fleet.run_fleet_check(targets, slo, polls=1,
+                                 out=buf) == 0, buf.getvalue()
+    text = buf.getvalue()
+    assert "gossip.max_convergence_rounds" in text
+    assert "divergence exactly 0" in text
+    assert "gossip.max_exchange_p99_s" in text
+    # the same SLO against a DARK mesh fails loudly: a plane nobody
+    # reports is indistinguishable from a broken one
+    dark = _targets(sim)
+    buf = io.StringIO()
+    assert fleet.run_fleet_check(dark, slo, polls=1, out=buf) == 1
+    assert "no targets report propagation records" in buf.getvalue()
+
+
 # -- live mode: sidecar --replica over real TCP ------------------------------
 
 

@@ -971,6 +971,34 @@ def test_obs_discipline_exempts_the_loopprof_plumbing_itself(tmp_path):
     assert "obs-discipline" not in _rules_fired(findings)
 
 
+def test_obs_discipline_exempts_the_propagation_plumbing_itself(tmp_path):
+    # obs/propagation.py (ISSUE 19) renders labeled divergence gauge
+    # names from board state and forwards event payloads — plumbing;
+    # the greppable `gossip.*` literals live at its own call sites
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    (obs_dir / "propagation.py").write_text(textwrap.dedent('''
+        def _collect(links):
+            return {f"cluster.divergence{{replica={r},peer={p}}}": v
+                    for (r, p), v in links.items()}
+
+        def record_exchange(board, emit, name, **fields):
+            emit(name, **fields)
+    '''))
+    findings = run_paths([tmp_path])
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
+def test_obs_discipline_still_covers_propagation_call_sites(tmp_path):
+    # the exemption is the module, not the plane: a CALLER forwarding
+    # a runtime event name still trips the rule
+    findings = _lint(tmp_path, ("exchange_site.py", '''
+        def lit_exchange(emit, name):
+            emit(name, peer="r1")
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 1
+
+
 # -- hub-isolation (ISSUE 8: the shared-engine structural invariants) -------
 
 # the pre-discipline shape: a device dispatch while the hub lock is

@@ -1034,3 +1034,163 @@ def test_sidecar_obs_http_flag_serves_session_watermarks(obs_enabled):
     # the session closed: its link must be GONE from the board
     assert not any(k.startswith("c1:")
                    for k in snap["watermarks"]["links"])
+
+
+# -- mesh convergence SLO plumbing (ISSUE 19) --------------------------------
+
+
+from dat_replication_protocol_tpu.obs.fleet import (  # noqa: E402
+    MESH_SLO_KEYS,
+    _join_mesh,
+    mesh_rounds_floor,
+)
+
+
+def _prop_snap(links=None, frontier=None, p99=None, count=0):
+    return {"monotonic": 0.0, "links": links or {},
+            "frontier": frontier or {},
+            "exchange_seconds": {"count": count, "p50": p99, "p99": p99}}
+
+
+def _link(rnd, *, outcome="progress", div_rec=2, div_b=128, ok_age=0.5):
+    return {"role": "initiator", "round": rnd, "outcome": outcome,
+            "divergence_records": div_rec, "divergence_bytes": div_b,
+            "wire_bytes": 256, "seconds": 0.01, "exchanges": 1,
+            "failures": 0, "error": None, "age_s": 0.1,
+            "last_success_age_s": ok_age}
+
+
+def test_join_mesh_freshest_link_wins_and_p99_is_the_max():
+    snaps = {
+        "t0": {"propagation": _prop_snap(
+            links={"r0->r1": _link(2, div_rec=5)},
+            frontier={"r0": {"digest": "aa", "records": 3, "round": 2}},
+            p99=0.02, count=4)},
+        "t1": {"propagation": _prop_snap(
+            links={"r0->r1": _link(4, div_rec=1)},
+            frontier={"r1": {"digest": "bb", "records": 2, "round": 4}},
+            p99=0.08, count=6)},
+    }
+    mesh = _join_mesh(snaps)
+    assert mesh["links"]["r0->r1"]["round"] == 4
+    assert mesh["links"]["r0->r1"]["divergence_records"] == 1
+    assert mesh["links"]["r0->r1"]["target"] == "t1"
+    assert mesh["exchange_p99_s"] == 0.08
+    assert mesh["exchange_count"] == 10
+    # frontiers differ: the pair is NOT converged, watermark stands
+    pair = mesh["pairs"]["r0<->r1"]
+    assert not pair["converged"]
+    assert pair["divergence_records"] == 1
+
+
+def test_join_mesh_frontier_equality_overrides_stale_watermark():
+    """A link watermark is the diff at the pair's LAST exchange; once
+    both frontiers are byte-identical the pair's divergence is exactly
+    0 whatever a stale watermark says (the smoke-test lesson: a link
+    that last exchanged at round 1 with diff 4 and never re-exchanged
+    must not read as diverged after the mesh converged)."""
+    snaps = {"t0": {"propagation": _prop_snap(
+        links={"r0->r1": _link(1, div_rec=4, div_b=400)},
+        frontier={"r0": {"digest": "cc", "records": 5, "round": 3},
+                  "r1": {"digest": "cc", "records": 5, "round": 3}})}}
+    pair = _join_mesh(snaps)["pairs"]["r0<->r1"]
+    assert pair["converged"]
+    assert pair["divergence_records"] == 0
+    assert pair["divergence_bytes"] == 0
+
+
+def test_join_mesh_empty_when_nothing_reports():
+    assert _join_mesh({"t0": {"gossip": {}}, "t1": None}) == {}
+
+
+@pytest.mark.parametrize("key", sorted(MESH_SLO_KEYS))
+def test_mesh_slo_keys_must_be_numeric(tmp_path, key):
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps({"gossip": {key: "fast"}}))
+    with pytest.raises(ValueError, match="must be a number"):
+        load_slo(str(path))
+    path.write_text(json.dumps({"gossip": {key: 10}}))
+    assert load_slo(str(path))["gossip"][key] == 10
+
+
+def test_mesh_slo_dark_plane_fails_loudly():
+    slo = {"gossip": {"max_divergence_bytes": 0}}
+    sample = {"links": {}, "gossip": {"t0": {
+        "replica": "r0", "round": 3, "rounds_behind": 0, "records": 1,
+        "digest": "aa", "quarantined": [], "quarantine": {},
+        "suspicion": {}}}, "mesh": {}}
+    rows = [r for r in evaluate_slo(slo, sample)
+            if r["check"] == "gossip.mesh"]
+    assert rows and rows[0]["status"] == "fail"
+    assert "no targets report propagation records" in rows[0]["detail"]
+
+
+def test_mesh_slo_unreachable_convergence_bound_is_a_misconfig():
+    """A max_convergence_rounds below the epidemic floor fails as an
+    SLO bug, not as a mesh failure — an unreachable gate is a
+    misconfiguration, never a standard."""
+    assert mesh_rounds_floor(2) == 13
+    assert mesh_rounds_floor(4) == 16
+    assert mesh_rounds_floor(64) == 28
+    mesh = {"frontier": {f"r{i}": {"digest": "aa", "round": 2}
+                         for i in range(4)},
+            "links": {}, "pairs": {}, "exchange_p99_s": None,
+            "exchange_count": 0}
+    slo = {"gossip": {"max_convergence_rounds": 15}}
+    rows = evaluate_slo(slo, {"links": {}, "gossip": {}, "mesh": mesh})
+    (row,) = [r for r in rows
+              if r["check"] == "gossip.max_convergence_rounds"]
+    assert row["status"] == "fail"
+    assert "unreachable SLO" in row["detail"]
+    # at the floor it evaluates for real — converged at round 2 passes
+    slo = {"gossip": {"max_convergence_rounds": 16}}
+    rows = evaluate_slo(slo, {"links": {}, "gossip": {}, "mesh": mesh})
+    (row,) = [r for r in rows
+              if r["check"] == "gossip.max_convergence_rounds"]
+    assert row["status"] == "ok"
+    assert "converged at round 2" in row["detail"]
+
+
+def test_mesh_slo_silently_dead_link_fails_age_check():
+    mesh = {"frontier": {"r0": {"digest": "aa", "round": 1},
+                         "r1": {"digest": "bb", "round": 1}},
+            "links": {"r0->r1": dict(_link(1), last_success_age_s=None)},
+            "pairs": {"r0<->r1": {"round": 1, "converged": False,
+                                  "divergence_records": 2,
+                                  "divergence_bytes": 128,
+                                  "last_success_age_s": None,
+                                  "outcome": "transport"}},
+            "exchange_p99_s": 0.01, "exchange_count": 1}
+    slo = {"gossip": {"max_exchange_age_s": 60}}
+    rows = evaluate_slo(slo, {"links": {}, "gossip": {}, "mesh": mesh})
+    (row,) = [r for r in rows
+              if r["check"] == "gossip.max_exchange_age_s"]
+    assert row["status"] == "fail"
+    assert "silently-dead link" in row["detail"]
+
+
+def test_dashboard_renders_the_mesh_matrix():
+    sample = {
+        "ts": 0.0, "targets": {}, "links": {}, "dropped_lines": {},
+        "gossip": {"t0": {"replica": "r0", "round": 3,
+                          "rounds_behind": 0, "records": 4,
+                          "digest": "aa" * 16, "quarantined": ["rX"],
+                          "quarantine": {"rX": {"arm": "wrong-symbol",
+                                                "frame": 2,
+                                                "offset": 17}},
+                          "suspicion": {}}},
+        "mesh": {"links": {}, "frontier": {},
+                 "pairs": {"r0<->r1": {"round": 3, "converged": True,
+                                       "divergence_records": 0,
+                                       "divergence_bytes": 0,
+                                       "last_success_age_s": 0.25,
+                                       "outcome": "converged"}},
+                 "exchange_p99_s": 0.0123, "exchange_count": 42},
+    }
+    view = FleetView([FleetTarget(lambda: {}, name="t0")])
+    frame = render_dashboard(view, sample)
+    assert "r0<->r1" in frame
+    assert "converged" in frame
+    assert "exchange p99 0.0123s over 42 exchange(s)" in frame
+    assert "quarantine r0: rX arm=wrong-symbol frame=2 offset=17" \
+        in frame

@@ -1,0 +1,440 @@
+"""Mesh convergence plane (ISSUE 19): the propagation board's
+divergence-watermark semantics, the dark-path bytecode contract on the
+exchange engine, the lit sim's provenance records, and the offline
+``obs meshdoctor`` — including the 20-seed chaos oracle that checks
+the doctor's stalled-link attribution against the fault injector's
+ground truth (the generator IS the oracle, tests never guess).
+"""
+
+import json
+
+import pytest
+
+from dat_replication_protocol_tpu.cluster import ClusterSim
+from dat_replication_protocol_tpu.cluster import node as cluster_node
+from dat_replication_protocol_tpu.obs import propagation
+from dat_replication_protocol_tpu.obs.__main__ import (
+    _dedupe_exchanges,
+    _link_runs,
+    _meshdoctor_analyze,
+    main as obs_main,
+)
+from dat_replication_protocol_tpu.obs.events import EVENTS
+from dat_replication_protocol_tpu.obs.metrics import REGISTRY
+from dat_replication_protocol_tpu.obs.tracing import (
+    SPANS,
+    attach_jsonl_sink,
+)
+from dat_replication_protocol_tpu.session.faults import FaultPlan
+
+
+# -- dark-path discipline (the PR 18 contract, at the bytecode level) --------
+
+
+def test_dark_twin_references_no_propagation_symbol():
+    """The dark `_exchange` twin must not mention the plane AT ALL:
+    the disabled cost of the whole convergence plane is one attribute
+    load in `gossip_exchange`, proven on the compiled code object, not
+    by reading the source."""
+    names = cluster_node._exchange.__code__.co_names
+    assert not any("propagation" in n for n in names), names
+    assert "record_exchange" not in names
+    assert "note_frontier" not in names
+
+
+def test_gossip_exchange_fork_is_one_attribute_load():
+    names = cluster_node.gossip_exchange.__code__.co_names
+    assert {"_OBS", "on", "_exchange", "_exchange_lit"} <= set(names)
+
+
+def test_lit_twin_does_reference_the_plane():
+    """The inverse direction: if a refactor quietly dropped the lit
+    twin's instrumentation, the dark test above would still pass."""
+    names = cluster_node._exchange_lit.__code__.co_names
+    assert any("propagation" in n for n in names), names
+
+
+def test_dark_run_leaves_board_and_rings_empty():
+    assert not propagation.OBS.on, "dark test needs the gate off"
+    propagation.PROPAGATION.reset_for_tests()
+    EVENTS.clear()
+    SPANS.clear()
+    sim = ClusterSim(3, seed=5, records_per=4, divergence=2, chaos=False)
+    assert sim.run()["converged"]
+    snap = propagation.PROPAGATION.snapshot()
+    assert snap["links"] == {}
+    assert snap["frontier"] == {}
+    assert snap["exchange_seconds"]["count"] == 0
+    assert SPANS.spans("gossip.exchange") == []
+    assert EVENTS.events("gossip.mesh") == []
+
+
+# -- board unit semantics -----------------------------------------------------
+
+
+def test_success_sets_watermark_failure_keeps_it():
+    board = propagation.PropagationBoard()
+    board.record("r0", "r1", role="initiator", rnd=1, outcome="progress",
+                 seconds=0.01, diff=7, wire_bytes=900, repair_bytes=640)
+    rec = board.snapshot()["links"]["r0->r1"]
+    assert rec["divergence_records"] == 7
+    assert rec["divergence_bytes"] == 640
+    assert rec["failures"] == 0
+    # a failed exchange did NOT heal the divergence: the watermark
+    # stays (fabricating 0 would read as converged — the direction an
+    # SLO gate must never err in), only the failure count moves
+    board.record("r0", "r1", role="initiator", rnd=2, outcome="transport",
+                 seconds=0.02, error="link cut")
+    rec = board.snapshot()["links"]["r0->r1"]
+    assert rec["divergence_records"] == 7
+    assert rec["divergence_bytes"] == 640
+    assert rec["failures"] == 1
+    assert rec["outcome"] == "transport"
+    assert rec["error"] == "link cut"
+    assert rec["exchanges"] == 2
+    # convergence zeroes it
+    board.record("r0", "r1", role="initiator", rnd=3, outcome="converged",
+                 seconds=0.01, diff=0)
+    rec = board.snapshot()["links"]["r0->r1"]
+    assert rec["divergence_records"] == 0
+    assert rec["divergence_bytes"] == 0
+
+
+def test_failure_before_any_peel_reports_unknown_not_zero():
+    board = propagation.PropagationBoard()
+    board.record("r0", "r1", role="initiator", rnd=1, outcome="transport",
+                 seconds=0.0)
+    rec = board.snapshot()["links"]["r0->r1"]
+    assert rec["divergence_records"] is None
+    assert rec["divergence_bytes"] is None
+    assert rec["last_success_age_s"] is None
+    # and the collector skips the link: unknown is not a gauge value
+    assert board._collect()["gauges"] == {}
+
+
+def test_refused_exchanges_stay_out_of_the_seconds_window():
+    board = propagation.PropagationBoard()
+    assert board.exchange_p99() is None
+    board.record("r0", "r1", role="initiator", rnd=1, outcome="refused",
+                 seconds=9.9, error="quarantined")
+    assert board.exchange_p99() is None
+    board.record("r0", "r1", role="initiator", rnd=2, outcome="progress",
+                 seconds=0.25, diff=1)
+    assert board.exchange_p99() == 0.25
+
+
+def test_exchange_quantiles_over_known_window():
+    board = propagation.PropagationBoard()
+    for i in range(100):
+        board.record("r0", "r1", role="initiator", rnd=i,
+                     outcome="progress", seconds=(i + 1) / 100.0, diff=1)
+    assert board._quantile(0.50) == pytest.approx(0.50)
+    assert board.exchange_p99() == pytest.approx(0.99)
+    xs = board.snapshot()["exchange_seconds"]
+    assert xs["count"] == 100
+    assert xs["p50"] == pytest.approx(0.50)
+    assert xs["p99"] == pytest.approx(0.99)
+
+
+def test_snapshot_ages_are_monotonic_clock_relative():
+    board = propagation.PropagationBoard()
+    board.record("r0", "r1", role="initiator", rnd=1, outcome="converged",
+                 seconds=0.01, diff=0)
+    rec = board.snapshot()["links"]["r0->r1"]
+    assert rec["age_s"] >= 0.0
+    assert rec["last_success_age_s"] >= 0.0
+    assert rec["last_success_age_s"] <= rec["age_s"] + 0.001
+
+
+def test_note_frontier_is_change_only():
+    board = propagation.PropagationBoard()
+    assert board.note_frontier("r0", "aa" * 16, 3, 0)
+    assert not board.note_frontier("r0", "aa" * 16, 3, 1)
+    assert board.note_frontier("r0", "bb" * 16, 4, 2)
+    assert board.snapshot()["frontier"]["r0"] == {
+        "digest": "bb" * 16, "records": 4, "round": 2}
+
+
+def test_collector_exports_divergence_and_frontier_gauges():
+    board = propagation.PropagationBoard()
+    board.record("r0", "r1", role="initiator", rnd=1, outcome="progress",
+                 seconds=0.01, diff=3, repair_bytes=300)
+    board.note_frontier("r0", "ff" * 16, 5, 1)
+    gauges = board._collect()["gauges"]
+    assert gauges["cluster.divergence{replica=r0,peer=r1}"] == 3.0
+    assert gauges["cluster.divergence_bytes{replica=r0,peer=r1}"] == 300.0
+    assert gauges["cluster.frontier{replica=r0}"] == \
+        propagation.frontier_fingerprint("ff" * 16)
+
+
+def test_frontier_fingerprint_is_an_exact_equality_token():
+    a = propagation.frontier_fingerprint("f" * 64)
+    assert a == float(int("f" * 13, 16))
+    assert a == propagation.frontier_fingerprint("f" * 13)
+    assert a != propagation.frontier_fingerprint("e" + "f" * 12)
+    # 52 bits: exactly representable, no rounding collisions
+    assert float(int("f" * 13, 16)) != float(int("f" * 13, 16) - 1)
+
+
+def test_digest_prefixes_hex16():
+    rows = [bytes(range(32)), b"\xff" * 32]
+    assert propagation.digest_prefixes(rows) == [
+        bytes(range(32)).hex()[:16], "ff" * 8]
+
+
+def test_reset_for_tests_drops_everything():
+    board = propagation.PropagationBoard()
+    board.record("r0", "r1", role="initiator", rnd=1, outcome="progress",
+                 seconds=0.5, diff=1)
+    board.note_frontier("r0", "aa" * 16, 1, 1)
+    board.reset_for_tests()
+    snap = board.snapshot()
+    assert snap["links"] == {} and snap["frontier"] == {}
+    assert board.exchange_p99() is None
+
+
+# -- lit integration: the sim records provenance ------------------------------
+
+
+def test_lit_sim_populates_board_spans_and_gauges(obs_enabled):
+    sim = ClusterSim(4, seed=3, records_per=6, divergence=2, chaos=False)
+    assert sim.run()["converged"]
+    snap = propagation.PROPAGATION.snapshot()
+    assert snap["links"], "lit exchanges must leave link watermarks"
+    digests = {rec["digest"] for rec in snap["frontier"].values()}
+    assert len(snap["frontier"]) == 4
+    assert len(digests) == 1, "converged mesh: one frontier digest"
+    assert snap["exchange_seconds"]["p99"] is not None
+    spans = SPANS.spans("gossip.exchange")
+    assert spans
+    for r in spans:
+        f = r["fields"]
+        assert f["outcome"] in propagation.OUTCOMES
+        assert f["role"] in ("initiator", "responder")
+        assert {"replica", "peer", "round", "seconds",
+                "wire_bytes"} <= set(f)
+    # both directions of each in-process exchange are recorded
+    roles = {r["fields"]["role"] for r in spans}
+    assert roles == {"initiator", "responder"}
+    # the registry exports the matrix through the collector
+    gauges = REGISTRY.snapshot()["gauges"]
+    frontier_g = {k: v for k, v in gauges.items()
+                  if k.startswith("cluster.frontier{")}
+    assert len(frontier_g) == 4
+    assert len(set(frontier_g.values())) == 1
+    assert any(k.startswith("cluster.divergence{") for k in gauges)
+    mesh_ev = EVENTS.events("gossip.mesh")
+    assert len(mesh_ev) == 1
+    assert mesh_ev[0]["fields"] == {"n": 4, "seed": 3,
+                                    "bound": sim.rounds_bound()}
+    # provenance roots: one hold per replica at round 0
+    holds = EVENTS.events("gossip.hold")
+    assert {h["fields"]["replica"] for h in holds} == set(sim.nodes)
+
+
+# -- meshdoctor: offline attribution ------------------------------------------
+
+
+def _run_lit_sim(seed, *, chaos, n=4):
+    propagation.PROPAGATION.reset_for_tests()
+    EVENTS.clear()
+    SPANS.clear()
+    sim = ClusterSim(n, seed, records_per=6, divergence=2, chaos=chaos)
+    out = sim.run()
+    return sim, out, EVENTS.events(), SPANS.spans()
+
+
+def test_meshdoctor_clean_seed_exits_zero(obs_enabled, tmp_path, capsys):
+    log = tmp_path / "mesh.jsonl"
+    sink = attach_jsonl_sink(str(log))
+    try:
+        sim, out, _ev, _sp = _run_lit_sim(3, chaos=False)
+    finally:
+        EVENTS.attach_sink(None)
+        SPANS.attach_sink(None)
+        sink.close()
+    assert out["converged"]
+    assert obs_main(["meshdoctor", str(log)]) == 0
+    text = capsys.readouterr().out
+    assert "final divergence exactly 0" in text
+    assert "FLAG" not in text
+    assert "slowest: digest" in text
+    assert obs_main(["meshdoctor", "--json", str(log)]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["converged"] and rep["flags"] == []
+    assert rep["distinct_frontiers"] == 1
+    assert rep["convergence_round"] <= rep["bound"] == sim.rounds_bound()
+    assert rep["mesh"]["n"] == 4 and rep["mesh"]["seed"] == 3
+    assert rep["tree_digests"] > 0
+
+
+def _predicted_stalls(sim):
+    """Ground truth straight from the sim's event log: undirected
+    pairs that failed transport in >= 2 DISTINCT rounds with no
+    successful exchange in between — the same rule the doctor applies
+    to its reconstructed spans, computed from the injector side."""
+    by_pair: dict = {}
+    for ev in sim.events:
+        for x in ev["exchanges"]:
+            if x["outcome"] not in ("ok", "transport"):
+                continue
+            pair = tuple(sorted((x["initiator"], x["responder"])))
+            by_pair.setdefault(pair, []).append(
+                (ev["round"], x["outcome"] == "ok"))
+    stalled = set()
+    for pair, obs in by_pair.items():
+        obs.sort()
+        if any(len(run) >= 2 for run in _link_runs(obs)):
+            stalled.add(pair)
+    return stalled
+
+
+def test_meshdoctor_chaos_oracle_20_seeds(obs_enabled):
+    """The acceptance oracle: 20 chaos seeds, every stalled-link flag
+    the doctor raises must name EXACTLY the links the fault injector's
+    own event log predicts, every flagged link must cross the
+    partition cut, every flagged round must fall inside
+    [cut_round, heal_round), and clean/healed seeds must converge with
+    final divergence exactly 0 within rounds_bound()."""
+    total_flags = 0
+    for seed in range(20):
+        sim, out, events, spans = _run_lit_sim(seed, chaos=True)
+        rep = _meshdoctor_analyze(events, spans)
+        stalls = {tuple(sorted(f["link"].split("<->")))
+                  for f in rep["flags"] if f["flag"] == "stalled-link"}
+        assert stalls == _predicted_stalls(sim), f"seed {seed}"
+        # only the partition produces repeat offenders: one-shot link
+        # chaos fires at most one round per link
+        other = [f["flag"] for f in rep["flags"]
+                 if f["flag"] != "stalled-link"]
+        assert other == [], f"seed {seed}: unexpected flags {other}"
+        sc = FaultPlan.partition_scenario(seed, 4)
+        minority = sc["groups"][0]
+        for f in rep["flags"]:
+            a, b = f["link"].split("<->")
+            assert (int(a[1:]) in minority) != (int(b[1:]) in minority), \
+                f"seed {seed}: {f['link']} does not cross the cut"
+            assert all(sc["cut_round"] <= r < sc["heal_round"]
+                       for r in f["rounds"]), f"seed {seed}: {f}"
+        # the mesh HEALS: convergence within the budget, divergence 0
+        assert out["converged"], f"seed {seed} never converged"
+        assert rep["converged"], f"seed {seed}"
+        assert rep["distinct_frontiers"] == 1, f"seed {seed}"
+        assert rep["convergence_round"] <= sim.rounds_bound(), \
+            f"seed {seed}"
+        total_flags += len(stalls)
+    assert total_flags > 0, \
+        "vacuous oracle: no seed produced a stalled link"
+
+
+def _span(rnd, replica, peer, role, outcome, ts, **fields):
+    f = {"replica": replica, "peer": peer, "role": role, "round": rnd,
+         "outcome": outcome, "wire_bytes": 0, "repair_bytes": 0,
+         "seconds": 0.001, **fields}
+    return {"seq": 0, "ts": ts, "dur": 0.001, "span": "gossip.exchange",
+            "id": int(ts * 1000), "parent": None, "tid": 0, "fields": f}
+
+
+def test_meshdoctor_flags_asymmetric_link():
+    """One direction fails 2 distinct rounds while the reverse
+    succeeds inside the same span: a half-open link, not a
+    partition."""
+    spans = [
+        _span(1, "r0", "r1", "initiator", "transport", 1.0),
+        _span(1, "r1", "r0", "initiator", "progress", 1.1, diff=1),
+        _span(2, "r0", "r1", "initiator", "transport", 2.0),
+    ]
+    rep = _meshdoctor_analyze([], spans)
+    kinds = {f["flag"] for f in rep["flags"]}
+    assert "asymmetric-link" in kinds
+    (fl,) = [f for f in rep["flags"] if f["flag"] == "asymmetric-link"]
+    assert fl["link"] == "r0->r1"
+    assert fl["rounds"] == [1, 2]
+    # NOT a stalled pair: the undirected view saw a success at round 1
+    assert "stalled-link" not in kinds
+
+
+def test_meshdoctor_flags_orphaned_digest():
+    """An exchange delivered a digest its sender was never recorded
+    holding: a provenance break, only checkable when hold records
+    exist (bare live logs without roots are not accused)."""
+    holds = [
+        {"seq": 0, "ts": 0.0, "event": "gossip.hold",
+         "fields": {"replica": "r0", "round": 0, "digests": ["aa" * 8]}},
+        {"seq": 1, "ts": 0.0, "event": "gossip.hold",
+         "fields": {"replica": "r1", "round": 0, "digests": ["bb" * 8]}},
+    ]
+    spans = [_span(1, "r0", "r1", "initiator", "progress", 1.0,
+                   diff=1, delivered=["cc" * 8])]
+    rep = _meshdoctor_analyze(holds, spans)
+    (fl,) = [f for f in rep["flags"] if f["flag"] == "orphaned-digest"]
+    assert fl["digest"] == "cc" * 8
+    assert fl["link"] == "r1->r0"
+    # without the hold roots the same spans pass clean
+    rep2 = _meshdoctor_analyze([], spans)
+    assert not [f for f in rep2["flags"]
+                if f["flag"] == "orphaned-digest"]
+
+
+def test_meshdoctor_flags_rounds_bound_exceeded():
+    mesh = {"seq": 0, "ts": 0.0, "event": "gossip.mesh",
+            "fields": {"n": 2, "seed": 0, "bound": 3}}
+    frontiers = [
+        {"seq": 1, "ts": 0.1, "event": "gossip.frontier",
+         "fields": {"replica": "r0", "round": 5, "digest": "aa" * 16,
+                    "records": 3}},
+        {"seq": 2, "ts": 0.2, "event": "gossip.frontier",
+         "fields": {"replica": "r1", "round": 5, "digest": "bb" * 16,
+                    "records": 2}},
+    ]
+    spans = [_span(5, "r0", "r1", "initiator", "progress", 5.0, diff=1)]
+    rep = _meshdoctor_analyze([mesh] + frontiers, spans)
+    assert not rep["converged"] and rep["distinct_frontiers"] == 2
+    (fl,) = [f for f in rep["flags"]
+             if f["flag"] == "rounds-bound-exceeded"]
+    assert "never converged" in fl["detail"]
+    # the converged-but-late arm
+    late = [dict(f, fields=dict(f["fields"], digest="aa" * 16))
+            for f in frontiers]
+    rep2 = _meshdoctor_analyze([mesh] + late, spans)
+    (fl2,) = [f for f in rep2["flags"]
+              if f["flag"] == "rounds-bound-exceeded"]
+    assert "converged at round 5" in fl2["detail"]
+
+
+def test_meshdoctor_exit_codes_and_graceful_empty(tmp_path, capsys):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert obs_main(["meshdoctor", str(empty)]) == 0
+    assert "never ran lit" in capsys.readouterr().out
+    # a flagged log exits 1 (the CI-gate contract)
+    bad = tmp_path / "bad.jsonl"
+    with open(bad, "w") as f:
+        for rec in (_span(1, "r0", "r1", "initiator", "transport", 1.0),
+                    _span(2, "r0", "r1", "initiator", "transport", 2.0)):
+            f.write(json.dumps(rec) + "\n")
+    assert obs_main(["meshdoctor", str(bad)]) == 1
+    assert "FLAG stalled-link" in capsys.readouterr().out
+
+
+def test_dedupe_prefers_the_initiator_view():
+    spans = [
+        _span(1, "r1", "r0", "responder", "progress", 1.0,
+              diff=2, delivered=["aa" * 8], delivered_peer=["bb" * 8]),
+        _span(1, "r0", "r1", "initiator", "progress", 1.1,
+              diff=2, delivered=["bb" * 8], delivered_peer=["aa" * 8]),
+    ]
+    (x,) = _dedupe_exchanges(spans)
+    assert (x["dialer"], x["dialee"]) == ("r0", "r1")
+    assert x["delivered_dialer"] == ["bb" * 8]
+    assert x["delivered_dialee"] == ["aa" * 8]
+
+
+def test_link_runs_gaps_do_not_break_a_stall():
+    # rounds 2 and 5 failed, nothing observed between: one run — a
+    # partitioned pair is only sampled some rounds
+    assert _link_runs([(2, False), (5, False)]) == [[2, 5]]
+    # a success between failures splits the runs
+    assert _link_runs([(2, False), (3, True), (5, False)]) == [[2], [5]]
+    # duplicate failures in one round count once
+    assert _link_runs([(2, False), (2, False)]) == [[2]]

@@ -1268,3 +1268,60 @@ def test_refusal_send_to_wedged_subscriber_is_bounded(monkeypatch):
     finally:
         a.close()
         b.close()
+
+
+def test_replica_stats_record_carries_link_age_and_suspicion():
+    """The ISSUE 19 satellite: the gossip record snapshot_stats carries
+    grows per-peer ``last_success_age_s`` (None until the first
+    success, then a growing age — a silently-dead link is an age, not
+    a frozen counter) and the node's cumulative ``suspicion``, both
+    cross-checked against the driver's own state."""
+    from dat_replication_protocol_tpu.cluster import ReplicaNode
+    from dat_replication_protocol_tpu.cluster.live import GossipDriver
+
+    node = ReplicaNode("stats-live", ())
+    driver = GossipDriver(node, ["127.0.0.1:1", "127.0.0.1:2"],
+                          interval=0.05, seed=0)  # never .start()ed
+    driver._last_success["127.0.0.1:1"] = time.monotonic() - 2.0
+    node._suspect["127.0.0.1:2"] = 3
+    sidecar.set_active_gossip(driver)
+    try:
+        snap = sidecar.snapshot_stats()
+        peers = snap["gossip"]["peers"]
+        age = peers["127.0.0.1:1"]["last_success_age_s"]
+        assert age is not None and 1.9 <= age < 30.0
+        assert peers["127.0.0.1:1"]["suspicion"] == 0
+        assert peers["127.0.0.1:2"]["last_success_age_s"] is None
+        assert peers["127.0.0.1:2"]["suspicion"] == 3
+        # ages GROW between snapshots (same driver, no new success)
+        snap2 = sidecar.snapshot_stats()
+        assert snap2["gossip"]["peers"]["127.0.0.1:1"][
+            "last_success_age_s"] >= age
+    finally:
+        sidecar.set_active_gossip(None)
+
+
+def test_snapshot_stats_propagation_section_is_presence_gated():
+    """The propagation section rides the replica-mode gossip record
+    only: an empty board stays OUT (so the fleet's loud-failure rule
+    can tell a dark plane from "no exchanges yet"), a populated board
+    rides along verbatim."""
+    from dat_replication_protocol_tpu.cluster import ReplicaNode
+    from dat_replication_protocol_tpu.obs.propagation import PROPAGATION
+
+    PROPAGATION.reset_for_tests()
+    sidecar.set_active_gossip(ReplicaNode("stats-prop", ()))
+    try:
+        snap = sidecar.snapshot_stats()
+        assert "gossip" in snap and "propagation" not in snap
+        PROPAGATION.record("stats-a", "stats-b", role="initiator",
+                           rnd=1, outcome="progress", seconds=0.01,
+                           diff=2, repair_bytes=64)
+        snap = sidecar.snapshot_stats()
+        link = snap["propagation"]["links"]["stats-a->stats-b"]
+        assert link["divergence_records"] == 2
+        assert link["divergence_bytes"] == 64
+        assert snap["propagation"]["exchange_seconds"]["count"] == 1
+    finally:
+        sidecar.set_active_gossip(None)
+        PROPAGATION.reset_for_tests()
