@@ -349,3 +349,23 @@ if [ ! -f "$OUT/.leg_mesh_done" ]; then
     && touch "$OUT/.leg_mesh_done"
   commit_out "r06 watch: gossip mesh propagation-plane device capture ($STAMP)"
 fi
+
+# 12) ISSUE 20 wire-cost device leg: the cost-bearing configs
+#     (7 wire_batch, 10 fanout, 12 snapshot_bootstrap) with the wire
+#     cost plane lit on the device host — goodput_ratio /
+#     overhead_ratio next to the throughput numbers, so the committed
+#     budget rows get a device-host reference and the fan-out leg's
+#     amplification watermark rides a real device decode.  All three
+#     are host-group; config 3 rides along for the backend label.
+if [ ! -f "$OUT/.leg_wirecost_done" ]; then
+  BENCH_CONFIGS=3,7,10,12 BENCH_DEADLINE=1200 timeout 1400 \
+    python bench.py --metrics >"$OUT/wirecost_$STAMP.json" \
+    2>"$OUT/wirecost_$STAMP.log"
+  tail -c 16384 "$OUT/wirecost_$STAMP.log" \
+    >"$OUT/wirecost_$STAMP.log.tail" \
+    && rm -f "$OUT/wirecost_$STAMP.log"
+  grep -q '"goodput_ratio"' "$OUT/wirecost_$STAMP.json" \
+    && device_artifact "$OUT/wirecost_$STAMP.json" \
+    && touch "$OUT/.leg_wirecost_done"
+  commit_out "r06 watch: wire-cost plane device capture ($STAMP)"
+fi

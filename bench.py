@@ -375,6 +375,58 @@ def _merge_fallback(configs: dict, fallback: dict) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# wire cost plane capture (ISSUE 20): goodput_ratio / overhead_ratio fields
+# for configs 7/10/11/12/14, read off the WireCostBoard with the plane lit —
+# the same watermarks `obs fleet` gates in production, so the checked-in
+# snapshot is the wire_ratio baseline ROADMAP item 4's compression tier
+# will be diffed against
+# ---------------------------------------------------------------------------
+
+
+def _wirecost_ratios(*links) -> tuple:
+    """(goodput_ratio, overhead_ratio) aggregated over the named board
+    links (both directions), or over EVERY link when none are named —
+    payload-weighted, from the live WireCostBoard ledger."""
+    from dat_replication_protocol_tpu.obs.wirecost import WIRECOST
+
+    snap = WIRECOST.snapshot()["links"]
+    payload = framing = total = 0
+    for name, rec in snap.items():
+        if links and name.split("|", 1)[0] not in links:
+            continue
+        payload += rec["payload_bytes"]
+        framing += rec["framing_bytes"]
+        total += rec["ledger_bytes"]
+    if not total:
+        return None, None
+    return round(payload / total, 5), round(framing / total, 5)
+
+
+def _wirecost_decode_ratios(wire: bytes) -> tuple:
+    """(goodput_ratio, overhead_ratio) of one recorded wire: the bytes
+    are replayed through a LIT session decoder and the board's per-link
+    watermarks are the ratios.  Obs state is saved/restored; the board
+    is reset so the ledger holds exactly this wire."""
+    import dat_replication_protocol_tpu as protocol
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+    from dat_replication_protocol_tpu.obs.wirecost import WIRECOST
+
+    was_on = obs_metrics.OBS.on
+    obs_metrics.enable()
+    WIRECOST.reset_for_tests()
+    try:
+        dec = protocol.decode()
+        dec.on_error(lambda e: None)
+        step = 1 << 20
+        for off in range(0, len(wire), step):
+            dec.write(wire[off:off + step])
+        return _wirecost_ratios("session")
+    finally:
+        WIRECOST.reset_for_tests()
+        obs_metrics.OBS.on = was_on
+
+
+# ---------------------------------------------------------------------------
 # config 1: test/basic.js-shaped roundtrip (reference: test/basic.js:1-127)
 # ---------------------------------------------------------------------------
 
@@ -1371,6 +1423,10 @@ def bench_wire_batch(quick: bool, backend: str) -> dict:
     assert len(a_cols) == total_rows
 
     ratio = len(b_wire) / len(per_record_wire)
+    # wire cost plane (ISSUE 20): the batch wire replayed through a lit
+    # session decoder — goodput/overhead of the bytes the A/B actually
+    # compares, off the board's own ledger
+    goodput, overhead = _wirecost_decode_ratios(b_wire)
     log(
         f"bench[wire_batch]: {total_rows} rows — encode "
         f"{total_rows / a_dt:,.0f} rows/s per-record vs "
@@ -1395,6 +1451,8 @@ def bench_wire_batch(quick: bool, backend: str) -> dict:
         "per_record_bytes": len(per_record_wire),
         "batch_bytes": len(b_wire),
         "bytes_ratio": round(ratio, 4),
+        "goodput_ratio": goodput,
+        "overhead_ratio": overhead,
     }
 
 
@@ -1754,6 +1812,8 @@ def bench_fanout(quick: bool, backend: str) -> dict:
 
     was_on = obs_metrics.OBS.on
     obs_metrics.enable()
+    from dat_replication_protocol_tpu.obs.wirecost import WIRECOST
+    WIRECOST.reset_for_tests()
     try:
         matrix: dict = {}
         p99_by_n: dict = {}
@@ -1798,6 +1858,10 @@ def bench_fanout(quick: bool, backend: str) -> dict:
         hash_vals = [v for v in hash_by_n.values() if v > 0]
         hash_ratio = (round(max(hash_vals) / min(hash_vals), 4)
                       if hash_vals else None)
+        # wire cost plane (ISSUE 20): the matrix ran lit, so the board's
+        # session ledger already holds the digest leg's wire — read the
+        # goodput/overhead watermarks straight off it
+        goodput, overhead = _wirecost_ratios("session")
 
         # stalled-peer arm: one of 8 peers stops accepting for stall_s
         # seconds at the half-way byte (below the shed timeout — it
@@ -1846,6 +1910,7 @@ def bench_fanout(quick: bool, backend: str) -> dict:
         log(f"bench[fanout]: stalled arm ({stall_s}s) — healthy p99 "
             f"{stalled_p99} ms")
     finally:
+        WIRECOST.reset_for_tests()
         obs_metrics.OBS.on = was_on
 
     top = str(max(peer_counts))
@@ -1864,6 +1929,8 @@ def bench_fanout(quick: bool, backend: str) -> dict:
         "hash_ratio": hash_ratio,
         "stall_s": stall_s,
         "stalled_arm_p99_ms": stalled_p99,
+        "goodput_ratio": goodput,
+        "overhead_ratio": overhead,
         "reduced_config": rows < 16_384 or int(top) < 256,
         "full_config": "1/8/64/256 peers x (16384 rows + 2 MiB blob), "
                        "3 s stalled-peer arm",
@@ -2034,6 +2101,38 @@ def bench_reconcile_rateless(quick: bool, backend: str) -> dict:
             f"{out['rounds']} rounds) vs sketch {sk_wire} B / "
             f"{sk_wall:.2f}s vs tree {tr_wire} B / {tr_wall:.2f}s")
 
+    # wire cost plane leg (ISSUE 20): one LIT two-replica exchange at a
+    # scaled shape (same fixed-width key/value records) prices the
+    # reconcile wire's framing overhead on the board's own ledger —
+    # symbols + repair batches per direction, transport-tiled
+    from dat_replication_protocol_tpu.cluster import (
+        ReplicaNode,
+        gossip_exchange,
+    )
+    from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+    from dat_replication_protocol_tpu.obs.wirecost import WIRECOST
+
+    n_cost = min(n, 4096)
+    k_cost = max(2, min(128, n_cost // 8))
+    ka_c, kb_c = k_cost // 2, k_cost - k_cost // 2
+
+    def _cost_recs(lo: int, hi: int) -> list:
+        return [{"key": "r-%08d" % i, "change": i, "from": i, "to": i + 1,
+                 "value": b"value-of-%07x" % (i & 0xFFFFFFF)}
+                for i in range(lo, hi)]
+
+    was_on = obs_metrics.OBS.on
+    obs_metrics.enable()
+    WIRECOST.reset_for_tests()
+    try:
+        ra = ReplicaNode("a", _cost_recs(0, n_cost))
+        rb = ReplicaNode("b", _cost_recs(ka_c, n_cost + kb_c))
+        gossip_exchange(ra, rb)
+        goodput, overhead = _wirecost_ratios()
+    finally:
+        WIRECOST.reset_for_tests()
+        obs_metrics.OBS.on = was_on
+
     mid = str(ks[min(1, len(ks) - 1)])
     m = arms[mid]
     return {
@@ -2048,6 +2147,8 @@ def bench_reconcile_rateless(quick: bool, backend: str) -> dict:
         "arms": arms,
         "wire_ratio_mid": m["wire_ratio_vs_sketch"],
         "speedup_vs_sketch_mid": m["speedup_vs_sketch"],
+        "goodput_ratio": goodput,
+        "overhead_ratio": overhead,
         "reduced_config": n < 1_000_000,
         "full_config": "1M+1M replicas, k in {10, 1000, 100000}",
     }
@@ -2141,7 +2242,14 @@ def bench_snapshot_bootstrap(quick: bool, backend: str) -> dict:
         hash_ratio = (hash_once + crowd_hash) / max(1, hash_once)
 
         # -- chaos arm: torn mid-chunk, resumed exactly-once ---------------
+        # the arm records a REAL session snapshot wire with the plane
+        # already lit: reset the board first so its tx ledger holds
+        # exactly that wire's goodput/overhead (ISSUE 20)
+        from dat_replication_protocol_tpu.obs.wirecost import WIRECOST
+        WIRECOST.reset_for_tests()
         chaos = _snapshot_chaos_arm(src, data)
+        goodput, overhead = _wirecost_ratios("session")
+        WIRECOST.reset_for_tests()
     finally:
         obs_metrics.OBS.on = was_on
 
@@ -2171,6 +2279,8 @@ def bench_snapshot_bootstrap(quick: bool, backend: str) -> dict:
         "crowd_hash_bytes": crowd_hash,
         "hash_ratio": round(hash_ratio, 4),
         "chaos": chaos,
+        "goodput_ratio": goodput,
+        "overhead_ratio": overhead,
         "reduced_config": mib < 1024,
         "full_config": "1 GiB dataset, 2% stale chunks, 8-joiner cold "
                        "crowd, torn-wire resume",
@@ -2486,6 +2596,7 @@ def bench_gossip_converge(quick: bool, backend: str) -> dict:
     from dat_replication_protocol_tpu.cluster import ClusterSim
     from dat_replication_protocol_tpu.obs import metrics as obs_metrics
     from dat_replication_protocol_tpu.obs.propagation import PROPAGATION
+    from dat_replication_protocol_tpu.obs.wirecost import WIRECOST
 
     ns_env = os.environ.get("BENCH_GOSSIP_N")
     ns = [int(x) for x in ns_env.split(",")] if ns_env else (
@@ -2507,6 +2618,7 @@ def bench_gossip_converge(quick: bool, backend: str) -> dict:
             # that); the fixed seed pins sampling so rounds are
             # reproducible
             PROPAGATION.reset_for_tests()
+            WIRECOST.reset_for_tests()
             sim = ClusterSim(n, seed=20_240, chaos=False,
                              records_per=records, divergence=divergence)
             t0 = _time.perf_counter()
@@ -2522,17 +2634,23 @@ def bench_gossip_converge(quick: bool, backend: str) -> dict:
             wire_x = (sim.wire_bytes / sim.divergence_bytes
                       if sim.divergence_bytes else 0.0)
             p99 = PROPAGATION.exchange_p99()
+            # wire cost plane (ISSUE 20): every exchange of this mesh
+            # ran lit, so the board ledger holds exactly this n's wire
+            goodput, overhead = _wirecost_ratios()
             res[n] = {"rounds": out["rounds"], "seconds": round(dt, 3),
                       "wire_bytes": sim.wire_bytes,
                       "divergence_bytes": sim.divergence_bytes,
                       "wire_x": round(wire_x, 3),
-                      "exchange_p99_s": round(p99 or 0.0, 6)}
+                      "exchange_p99_s": round(p99 or 0.0, 6),
+                      "goodput_ratio": goodput,
+                      "overhead_ratio": overhead}
             log(f"bench[gossip_converge]: n={n} rounds={out['rounds']} "
                 f"{dt:.2f}s wire={sim.wire_bytes} "
                 f"(divergence {sim.divergence_bytes}, x{wire_x:.2f}, "
                 f"exchange p99 {p99 or 0.0:.4f}s)")
     finally:
         PROPAGATION.reset_for_tests()
+        WIRECOST.reset_for_tests()
         obs_metrics.OBS.on = was_on
     top = max(ns)
     return {
@@ -2553,6 +2671,8 @@ def bench_gossip_converge(quick: bool, backend: str) -> dict:
         # perf_budgets.json so the plane's own overhead is priced
         "exchange_p99_s": res[top]["exchange_p99_s"],
         "rounds_to_converge": res[top]["rounds"],
+        "goodput_ratio": res[top]["goodput_ratio"],
+        "overhead_ratio": res[top]["overhead_ratio"],
         **{f"rounds_{n}": res[n]["rounds"] for n in ns},
         **{f"seconds_{n}": res[n]["seconds"] for n in ns},
         **{f"wire_bytes_{n}": res[n]["wire_bytes"] for n in ns},

@@ -69,7 +69,7 @@ _TELEMETRY_RECEIVERS = {"events", "metrics", "obs", "obs_events",
                         "obs_metrics", "obs_tracing", "registry", "reg",
                         "spans", "tracing", "device", "obs_device",
                         "watermarks", "obs_watermarks", "board",
-                        "prof", "profiler", "loopprof"}
+                        "prof", "profiler", "loopprof", "wirecost"}
 # the obs plumbing itself: (parent dir, filename) pairs exempt from the
 # literal-name check (they forward `name` parameters by design; the
 # greppable sites are their callers)
@@ -78,7 +78,7 @@ _PLUMBING = {("obs", "metrics.py"), ("obs", "events.py"),
              ("obs", "device.py"), ("obs", "__init__.py"),
              ("obs", "watermarks.py"), ("obs", "http.py"),
              ("obs", "fleet.py"), ("obs", "loopprof.py"),
-             ("obs", "propagation.py")}
+             ("obs", "propagation.py"), ("obs", "wirecost.py")}
 # the /healthz lock-discipline check applies to the endpoint module
 _HEALTHZ_MODULE = ("obs", "http.py")
 

@@ -53,6 +53,7 @@ import numpy as np
 
 from ..fanout.log import BroadcastLog, SnapshotNeeded
 from ..obs import propagation as _propagation
+from ..obs import wirecost as _wirecost
 from ..obs.events import emit as _emit
 from ..obs.metrics import OBS as _OBS, counter as _counter
 from ..runtime import replay
@@ -65,7 +66,8 @@ from ..runtime.reconcile_driver import (
 from ..session.faults import FaultPlan, FaultyReader, TransportFault
 from ..wire import reconcile_codec as rc
 from ..wire.change_codec import Change
-from ..wire.framing import ProtocolError, frame_wire_len
+from ..wire.framing import ProtocolError, frame_wire_len, \
+    header_len as _header_len
 
 __all__ = [
     "ByzantineDivergence",
@@ -731,7 +733,12 @@ def _exchange(initiator, responder, plan_out, plan_back, engine,
     state = ResponderState(rep_b, engine=engine, overhead_cap=overhead_cap)
     out_link = _ChaosLink(plan_out)
     back_link = _ChaosLink(plan_back)
-    wire = {"a2b": 0, "b2a": 0}
+    # per-direction byte meter; the *_framing/*_msgs halves are plain
+    # arithmetic the lit twin turns into the wire cost ledger's
+    # payload/framing split (this function stays the DARK twin: no
+    # telemetry symbol, just two integer adds per message)
+    wire = {"a2b": 0, "b2a": 0, "a2b_framing": 0, "b2a_framing": 0,
+            "a2b_msgs": 0, "b2a_msgs": 0}
     msg_i = {"n": 0}
 
     def corrupt(side: str, e: Exception) -> ProtocolError:
@@ -744,6 +751,8 @@ def _exchange(initiator, responder, plan_out, plan_back, engine,
         replies that survived the back link."""
         msg_i["n"] += 1
         wire["a2b"] += frame_wire_len(len(payload))
+        wire["a2b_framing"] += _header_len(len(payload))
+        wire["a2b_msgs"] += 1
         got = out_link.send(payload)
         try:
             msg = rc.decode_reconcile(got)
@@ -753,6 +762,8 @@ def _exchange(initiator, responder, plan_out, plan_back, engine,
         out = []
         for r in replies:
             wire["b2a"] += frame_wire_len(len(r))
+            wire["b2a_framing"] += _header_len(len(r))
+            wire["b2a_msgs"] += 1
             got_r = back_link.send(r)
             try:
                 out.append(rc.decode_reconcile(got_r))
@@ -829,6 +840,12 @@ def _exchange(initiator, responder, plan_out, plan_back, engine,
     return {
         "ok": True,
         "wire_bytes": total,
+        "wire_a2b": wire["a2b"],
+        "wire_b2a": wire["b2a"],
+        "framing_a2b": wire["a2b_framing"],
+        "framing_b2a": wire["b2a_framing"],
+        "msgs_a2b": wire["a2b_msgs"],
+        "msgs_b2a": wire["b2a_msgs"],
         "symbols": sent,
         "rounds": rounds,
         "diff": int(len(wants) + len(b_rows)),
@@ -862,6 +879,10 @@ def _exchange_lit(initiator, responder, plan_out, plan_back, engine,
             _propagation.record_exchange(
                 a.key, b.key, role=role, rnd=rnd, outcome=outcome,
                 seconds=seconds, t0=t0, error=err)
+            # the wire cost doctrine (ISSUE 20): a faulted exchange
+            # leaves every watermark where it was — only the failure
+            # counter moves (fabricated ratios would read as healthy)
+            _wirecost.note_failure(f"{a.key}->{b.key}", "tx", err)
         raise
     seconds = time.monotonic() - t0
     outcome = "converged" if res["diff"] == 0 else "progress"
@@ -885,6 +906,26 @@ def _exchange_lit(initiator, responder, plan_out, plan_back, engine,
     for node in (initiator, responder):
         _propagation.note_frontier(node.key, node.content_digest().hex(),
                                    node.record_count, rnd)
+    # -- wire cost ledger (ISSUE 20): the exchange meter's per-direction
+    # totals, split exactly — symbol/control traffic is class
+    # `reconcile` (payload vs framing from the dark twin's arithmetic),
+    # shipped repair batches are class `change_batch` (already-framed
+    # journal bytes), and the direction total anchors the tiling audit
+    # as transport ground truth.  Directed link names match the
+    # propagation board's (`replica->peer`).
+    rep_r = len(res["wire_responder"])  # repair bytes a->b
+    rep_i = len(res["wire_initiator"])  # repair bytes b->a
+    for link, wire_total, framing, msgs, rep in (
+            (f"{initiator.key}->{responder.key}", res["wire_a2b"],
+             res["framing_a2b"], res["msgs_a2b"], rep_r),
+            (f"{responder.key}->{initiator.key}", res["wire_b2a"],
+             res["framing_b2a"], res["msgs_b2a"], rep_i)):
+        _wirecost.account("reconcile", link, "tx",
+                          wire_total - framing - rep, framing, msgs)
+        if rep:
+            _wirecost.account("change_batch", link, "tx", rep, 0)
+            _wirecost.note_diff(link, "tx", rep)
+        _wirecost.note_transport(link, "tx", wire_total)
     return res
 
 

@@ -56,6 +56,7 @@ from ..obs.metrics import (
 )
 from ..obs.tracing import trace_span as _trace_span
 from ..obs.watermarks import WATERMARKS as _WATERMARKS
+from ..obs import wirecost as _wirecost
 from ..session import pump as _pump
 from .log import BroadcastLog, SnapshotNeeded
 
@@ -291,6 +292,21 @@ class FanoutServer:
             self._marks.append((end, now))
         if _OBS.on:
             _WATERMARKS.mark(_WM_LINK, end)
+            self._lit_cost_published(len(data))
+
+    # -- wire cost lit helpers (ISSUE 20) ------------------------------------
+    # The fan-out choke points fork ONCE on `_OBS.on`; these helpers
+    # hold the plane's symbols so the hot paths' bytecode provably
+    # references no wirecost symbol (tests/test_wirecost.py).  The
+    # fan-out ledger is the amplification pair — source bytes in,
+    # per-peer delivered bytes out; frame classes were already
+    # attributed by the session encoder that produced the bytes.
+
+    def _lit_cost_published(self, nbytes: int) -> None:
+        _wirecost.note_source(_WM_LINK, nbytes)
+
+    def _lit_cost_served(self, peer: str, nbytes: int) -> None:
+        _wirecost.note_delivered(_WM_LINK, peer, nbytes)
 
     def seal(self) -> None:
         """No more bytes: peers complete once fully delivered."""
@@ -704,6 +720,7 @@ class FanoutServer:
         if _OBS.on:
             _M_SENT.inc(accepted)
             _M_WRITEV.inc()
+            self._lit_cost_served(st.key, accepted)
         return accepted
 
     def _consume_marks_locked(self, st: _PeerState, now: float) -> None:

@@ -41,6 +41,14 @@ Four parts:
   ``bench.py --metrics`` artifact against checked-in per-metric
   budgets (``artifacts/perf_budgets.json``); the CLI's ``perf-check``
   exits nonzero on regression.
+* :mod:`.wirecost` — the wire cost plane (ISSUE 20): a per-link byte
+  ledger attributing EVERY wire byte to a frame class (change,
+  change_batch, blob, reconcile, snapshot, framing-overhead) at the
+  existing choke points, with derived goodput/overhead/amplification
+  watermarks, the ``obs fleet`` cost-matrix join, and the offline
+  ``obs costdoctor`` auditor.  The headline invariant: the ledger
+  EXACTLY TILES the wire (residual vs transport ground truth is 0 at
+  convergence).
 * :mod:`.watermarks` / :mod:`.http` / :mod:`.fleet` — the fleet plane
   (ISSUE 11): wire-position cursors exported as labeled gauges
   (``append − parsed`` is exact replication lag in bytes; append

@@ -5,6 +5,7 @@
     python -m dat_replication_protocol_tpu.obs dump BUNDLE_DIR [--json]
     python -m dat_replication_protocol_tpu.obs loopdoctor LOG.jsonl|BUNDLE_DIR [--threshold S] [--json]
     python -m dat_replication_protocol_tpu.obs meshdoctor LOG... [--json]
+    python -m dat_replication_protocol_tpu.obs costdoctor LOG... [--max-overhead R] [--json]
     python -m dat_replication_protocol_tpu.obs perf-check BENCH.json [--budgets PATH] [--host-only]
     python -m dat_replication_protocol_tpu.obs fleet TARGET... [--check SLO.json | --watch]
 
@@ -64,6 +65,21 @@ persistently failing while the reverse succeeds), or
 ``rounds-bound-exceeded`` (convergence past the ``gossip.mesh``
 record's ``rounds_bound()`` budget).  A clean converged log flags
 nothing and reports final divergence exactly 0.
+
+``costdoctor`` (ISSUE 20) audits the wire cost plane offline: it
+rebuilds the per-stream byte ledger from the same frame instants the
+timeline merges (``encoder.frame`` / ``decoder.frame`` /
+``decoder.frame.run``), splits framing from payload by inverting the
+framing arithmetic (exact for single frames, a per-header lower bound
+for native dispatch runs), and audits coverage tiling, overhead, and
+the amplification series from any ``--stats-fd`` records in the same
+logs.  Exit 1 on any flag: ``unattributed-bytes`` (coverage holes,
+double-attributed overlaps, or a nonzero live-ledger residual — wire
+no class accounts for), ``overhead-anomaly`` (framing overhead past
+``--max-overhead`` even at its minimum possible value, or goodput
+under ``--min-goodput``), or ``amplification-regression`` (a fan-out
+link's delivered/source ratio collapsing from its peak — peers not
+draining the published stream).  A clean lit log flags nothing.
 
 ``perf-check`` is the perf-budget regression gate (ISSUE 5): it
 compares one bench artifact (the one JSON line ``bench.py`` prints)
@@ -948,6 +964,197 @@ def cmd_meshdoctor(args) -> int:
     return 1 if report["flags"] else 0
 
 
+# -- costdoctor (ISSUE 20): offline wire-cost ledger audit -------------------
+
+
+def _cost_records(paths: list[str]) -> tuple[list, list]:
+    """Per-origin span records + stats-fd ``wirecost`` sections from N
+    JSONL logs / flight bundles.  Unlike :func:`_mesh_records` the file
+    origin is kept: frame streams without an explicit ``link`` label
+    are keyed by origin (one log = one peer), and the amplification
+    series is read per origin in file order."""
+    streams: list = []
+    stats: list = []
+    for path in paths:
+        origin = os.path.basename(path.rstrip("/"))
+        if os.path.isdir(path):
+            bundle = read_bundle(path)
+            streams.append((origin, bundle["spans"]))
+        else:
+            records = _load_jsonl(path)
+            streams.append((origin, records))
+            for r in records:
+                if isinstance(r.get("wirecost"), dict):
+                    stats.append((origin, r["wirecost"]))
+    return streams, stats
+
+
+def _split_framing(wire_len: int) -> int:
+    """Invert the framing arithmetic: the header length a single frame
+    of ``wire_len`` total bytes must carry (header_len is monotone in
+    payload length, so the inversion is exact and unique)."""
+    from ..wire.framing import header_len
+    for hl in range(2, 11):
+        p = wire_len - hl
+        if p >= 0 and header_len(p) == hl:
+            return hl
+    return 2
+
+
+def _costdoctor_analyze(streams: list, stats: list,
+                        max_overhead: float,
+                        min_goodput: Optional[float]) -> dict:
+    """Rebuild the per-stream wire cost ledger from frame instants and
+    audit it: coverage must tile (no unattributed bytes), the framing
+    overhead must stay under the threshold, and the amplification
+    series from stats records must not regress.  Framing is EXACT for
+    single-frame records (header inversion); a native dispatch run of
+    k frames contributes the 2-byte-per-header lower bound — the
+    overhead flag therefore only fires when even the minimum possible
+    framing breaches, never on an estimate."""
+    flags: list[dict] = []
+    ledgers: dict = {}
+    for origin, records in streams:
+        frames = _frames(records)
+        by_stream: dict = {}
+        for fr in frames:
+            key = (fr.get("link") or origin, fr["action"])
+            by_stream.setdefault(key, []).append(fr)
+        for (link, action), frs in by_stream.items():
+            name = f"{link}|{'tx' if action == 'emit' else 'rx'}"
+            classes: dict = {}
+            framing_lb = 0
+            exact = True
+            for fr in frs:
+                c = classes.setdefault(
+                    fr["kind"] or "?", {"wire": 0, "frames": 0})
+                c["wire"] += int(fr["wire_len"])
+                c["frames"] += int(fr["frames"])
+                if int(fr["frames"]) == 1:
+                    framing_lb += _split_framing(int(fr["wire_len"]))
+                else:
+                    framing_lb += 2 * int(fr["frames"])
+                    exact = False
+            total = sum(c["wire"] for c in classes.values())
+            # coverage audit: frames must tile [start, end) exactly —
+            # a hole is wire the ledger cannot attribute to any class
+            gaps = overlaps = 0
+            cur = None
+            for fr in sorted(frs, key=lambda f: f["offset"]):
+                off, end = fr["offset"], fr["offset"] + fr["wire_len"]
+                if cur is None:
+                    cur = end
+                elif off > cur:
+                    gaps += off - cur
+                    cur = end
+                else:
+                    overlaps += cur - off
+                    cur = max(cur, end)
+            overhead = (framing_lb / total) if total else None
+            ledgers[name] = {
+                "classes": classes, "wire_bytes": total,
+                "framing_bytes_min": framing_lb,
+                "framing_exact": exact,
+                "overhead_ratio": overhead,
+                "goodput_fraction": (1 - overhead)
+                if overhead is not None else None,
+                "unattributed_bytes": gaps,
+                "overlapping_bytes": overlaps,
+            }
+            if gaps:
+                flags.append({
+                    "flag": "unattributed-bytes", "link": name,
+                    "detail": f"{gaps} wire byte(s) on {name} fall in "
+                              "coverage holes between frame instants — "
+                              "bytes no class can account for"})
+            if overlaps:
+                flags.append({
+                    "flag": "unattributed-bytes", "link": name,
+                    "detail": f"{overlaps} wire byte(s) on {name} are "
+                              "attributed twice (overlapping frames): "
+                              "the ledger over-counts the wire"})
+            if overhead is not None and overhead > max_overhead:
+                qual = "" if exact else "at least "
+                flags.append({
+                    "flag": "overhead-anomaly", "link": name,
+                    "detail": f"framing overhead {qual}{overhead:.4f} "
+                              f"on {name} exceeds {max_overhead} "
+                              f"({framing_lb}/{total} byte(s))"})
+            if min_goodput is not None and overhead is not None \
+                    and (1 - overhead) < min_goodput:
+                flags.append({
+                    "flag": "overhead-anomaly", "link": name,
+                    "detail": f"goodput {1 - overhead:.4f} on {name} "
+                              f"below the {min_goodput} floor"})
+    # amplification series per link, in stats record order: the
+    # cumulative delivered/source ratio recovers after transients, so a
+    # FINAL value well under the peak means peers stopped draining what
+    # the source kept publishing — the under-delivery regression
+    amp_series: dict = {}
+    residuals: dict = {}
+    for _origin, wc in stats:
+        for link, view in (wc.get("amplification") or {}).items():
+            a = view.get("amplification")
+            if a is not None:
+                amp_series.setdefault(link, []).append(float(a))
+        for lname, rec in (wc.get("links") or {}).items():
+            residuals[lname] = rec.get("residual_bytes")
+    for link, series in sorted(amp_series.items()):
+        peak, final = max(series), series[-1]
+        if len(series) >= 2 and final < 0.75 * peak:
+            flags.append({
+                "flag": "amplification-regression", "link": link,
+                "detail": f"amplification on {link} fell to "
+                          f"{final:.2f}x from a {peak:.2f}x peak — "
+                          "peers are not draining the published "
+                          "stream"})
+    for lname, rb in sorted(residuals.items()):
+        # the live board's own tiling verdict, from the LAST stats
+        # record: a nonzero residual at rest is unattributed wire
+        if rb is not None and rb != 0:
+            flags.append({
+                "flag": "unattributed-bytes", "link": lname,
+                "detail": f"live ledger residual {rb} byte(s) on "
+                          f"{lname}: transport moved wire no class "
+                          "accounts for"})
+    return {"ledgers": ledgers, "amplification": amp_series,
+            "residuals": residuals, "flags": flags}
+
+
+def cmd_costdoctor(args) -> int:
+    streams, stats = _cost_records(args.logs)
+    report = _costdoctor_analyze(streams, stats,
+                                 max_overhead=args.max_overhead,
+                                 min_goodput=args.min_goodput)
+    if args.json:
+        print(json.dumps(report))
+        return 1 if report["flags"] else 0
+    if not report["ledgers"] and not report["residuals"] \
+            and not report["amplification"]:
+        print("no frame instants or wirecost sections found: the wire "
+              "cost plane either never ran lit (obs gate off) or the "
+              "log predates it")
+        return 0
+    for name, led in sorted(report["ledgers"].items()):
+        ov = led["overhead_ratio"]
+        qual = "" if led["framing_exact"] else ">="
+        print(f"{name}: {led['wire_bytes']} wire byte(s), "
+              f"overhead {qual}"
+              f"{('?' if ov is None else f'{ov:.4f}')} — "
+              + ", ".join(f"{cls}:{c['wire']}B/{c['frames']}f"
+                          for cls, c in sorted(led["classes"].items())))
+    for link, series in sorted(report["amplification"].items()):
+        print(f"amplification {link}: "
+              + " -> ".join(f"{a:.2f}x" for a in series[-6:]))
+    if report["flags"]:
+        for fl in report["flags"]:
+            print(f"FLAG {fl['flag']} [{fl['link']}]: {fl['detail']}")
+    else:
+        print("-- clean: every wire byte attributed, overhead within "
+              "bounds, amplification steady")
+    return 1 if report["flags"] else 0
+
+
 def cmd_perf_check(args) -> int:
     from .perf import DEFAULT_BUDGETS_PATH, run_check
 
@@ -1055,6 +1262,28 @@ def main(argv=None) -> int:
     md.add_argument("--json", action="store_true",
                     help="machine-readable output")
     md.set_defaults(fn=cmd_meshdoctor)
+
+    cd = sub.add_parser(
+        "costdoctor",
+        help="rebuild the per-link wire cost ledger from frame "
+             "instants (N JSONL logs / flight bundles) and audit it; "
+             "exit 1 on unattributed-bytes / overhead-anomaly / "
+             "amplification-regression flags")
+    cd.add_argument("logs", nargs="+", metavar="LOG",
+                    help="JSONL log file(s), --stats-fd JSONL files, "
+                         "and/or bundle directories")
+    cd.add_argument("--max-overhead", type=float, default=0.5,
+                    metavar="RATIO",
+                    help="framing-overhead flag threshold per stream "
+                         "(default: 0.5; the flag only fires when even "
+                         "the minimum possible framing breaches)")
+    cd.add_argument("--min-goodput", type=float, default=None,
+                    metavar="FRACTION",
+                    help="optional goodput floor per stream (off by "
+                         "default)")
+    cd.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    cd.set_defaults(fn=cmd_costdoctor)
 
     pc = sub.add_parser(
         "perf-check",

@@ -65,6 +65,18 @@ passes vacuously is NOT allowed, same contract as perf budgets):
     (fleet-wide exchange-latency quantile).  These evaluate over the
     ``propagation`` sections; no targets reporting the plane is a loud
     failure (the PR 18 "lag unknown" rule).
+``min_goodput_fraction`` / ``max_overhead_ratio``
+    the wire cost plane's SLO keys (ISSUE 20): per directed link,
+    payload/total and framing/total from the joined ``wirecost``
+    ledgers.  A link with no transport ground truth yet reports its
+    ratio as None — evaluated as a FAILURE, never as a free pass
+    (unknown is not zero).
+``max_egress_bytes_per_peer``
+    per-peer delivered-byte bound over the fan-out amplification
+    ledgers — the ROADMAP item 4 egress cost model as a gate.
+    All three cost keys fail loudly when NO target reports a
+    ``wirecost`` section: a dark cost plane is indistinguishable from
+    an unmetered one.
 """
 
 from __future__ import annotations
@@ -89,6 +101,7 @@ __all__ = [
     "SLO_KEYS",
     "GOSSIP_SLO_KEYS",
     "MESH_SLO_KEYS",
+    "WIRECOST_SLO_KEYS",
     "mesh_rounds_floor",
 ]
 
@@ -99,6 +112,17 @@ SLO_KEYS = frozenset({
     "max_lag_bytes", "max_lag_seconds", "require_converged",
     "max_shed", "max_rejected", "recompile_budget", "require_healthz",
     "max_events_dropped", "max_loop_lag_s", "gossip",
+    # the wire cost plane (ISSUE 20): evaluated over joined
+    # ``wirecost`` sections; dark plane = loud failure
+    "min_goodput_fraction", "max_overhead_ratio",
+    "max_egress_bytes_per_peer",
+})
+
+# the cost keys evaluated over the joined wirecost sections — grouped
+# so evaluate_slo can apply the one dark-plane rule to all of them
+WIRECOST_SLO_KEYS = frozenset({
+    "min_goodput_fraction", "max_overhead_ratio",
+    "max_egress_bytes_per_peer",
 })
 
 # the mesh convergence plane's SLO vocabulary (ISSUE 19): evaluated
@@ -222,6 +246,36 @@ def _join_mesh(snaps: dict) -> dict:
         }
     return {"links": links, "pairs": pairs, "frontier": frontier,
             "exchange_p99_s": p99, "exchange_count": count}
+
+
+def _join_wirecost(snaps: dict) -> dict:
+    """Join every target's ``wirecost`` section (ISSUE 20) into the
+    fleet cost matrix: per directed link the freshest ledger across
+    targets (by ledger total — the counters are monotonic, so the
+    largest ledger IS the latest view of that link), per fan-out link
+    the freshest amplification record (by source bytes, same
+    monotonicity argument).  Targets with no section contribute
+    nothing; an empty join is the dark-plane signal the SLO rows fail
+    loudly on."""
+    links: dict = {}
+    amp: dict = {}
+    for tname, snap in sorted(snaps.items()):
+        wc = (snap or {}).get("wirecost")
+        if not isinstance(wc, dict):
+            continue
+        for lname, rec in (wc.get("links") or {}).items():
+            cur = links.get(lname)
+            if cur is None or int(rec.get("ledger_bytes") or 0) >= \
+                    int(cur.get("ledger_bytes") or 0):
+                links[lname] = dict(rec, target=tname)
+        for aname, rec in (wc.get("amplification") or {}).items():
+            cur = amp.get(aname)
+            if cur is None or int(rec.get("source_bytes") or 0) >= \
+                    int(cur.get("source_bytes") or 0):
+                amp[aname] = dict(rec, target=tname)
+    if not links and not amp:
+        return {}
+    return {"links": links, "amplification": amp}
 
 
 class FleetTarget:
@@ -464,6 +518,7 @@ class FleetView:
             "loops": _join_loops(snaps),
             "gossip": _join_gossip(snaps, self._gossip_baseline),
             "mesh": _join_mesh(snaps),
+            "wirecost": _join_wirecost(snaps),
             "shed": _counter_sum(snaps, ("hub.shed", "fanout.peer.shed",
                                          "edge.shed")),
             "rejected": _counter_sum(snaps, ("hub.rejected",
@@ -538,12 +593,19 @@ def load_slo(path: str) -> dict:
             "pass vacuously")
     for key in ("max_lag_bytes", "max_lag_seconds", "max_shed",
                 "max_rejected", "recompile_budget", "max_events_dropped",
-                "max_loop_lag_s"):
+                "max_loop_lag_s", "min_goodput_fraction",
+                "max_overhead_ratio", "max_egress_bytes_per_peer"):
         if key in slo and not isinstance(slo[key], (int, float)):
             raise ValueError(f"SLO file {path}: {key} must be a number")
     for key in ("require_converged", "require_healthz"):
         if key in slo and not isinstance(slo[key], bool):
             raise ValueError(f"SLO file {path}: {key} must be a boolean")
+    if "min_goodput_fraction" in slo \
+            and not 0 <= slo["min_goodput_fraction"] <= 1:
+        raise ValueError(
+            f"SLO file {path}: min_goodput_fraction must be in [0, 1] — "
+            "a fraction above 1 is an unreachable SLO, and an "
+            "unreachable gate is a misconfiguration")
     if "gossip" in slo:
         g = slo["gossip"]
         if not isinstance(g, dict):
@@ -735,6 +797,64 @@ def evaluate_slo(slo: dict, sample: dict) -> list[dict]:
                     f"{sorted(mesh_keys)} against")
             else:
                 _evaluate_mesh_slo(g, mesh, row)
+    cost_keys = WIRECOST_SLO_KEYS & set(slo)
+    if cost_keys:
+        wc = sample.get("wirecost") or {}
+        if not wc:
+            # the dark-plane rule (ISSUE 20, same shape as the mesh):
+            # a cost SLO over a plane nobody reports must fail loudly —
+            # an unmetered wire is indistinguishable from a free one
+            row("wirecost", "-", False,
+                "no targets report wire cost records: the wire cost "
+                "plane is dark — nothing to evaluate "
+                f"{sorted(cost_keys)} against")
+        else:
+            wlinks = wc.get("links") or {}
+            if ("min_goodput_fraction" in slo
+                    or "max_overhead_ratio" in slo) and not wlinks:
+                row("wirecost.links", "-", False,
+                    "no per-link ledgers joined: goodput/overhead "
+                    "unknown")
+            for lname, rec in sorted(wlinks.items()):
+                if "min_goodput_fraction" in slo:
+                    bound = slo["min_goodput_fraction"]
+                    gf = rec.get("goodput_fraction")
+                    if gf is None:
+                        row("min_goodput_fraction", lname, False,
+                            "no bytes attributed yet: goodput unknown "
+                            "(unknown is not a pass)")
+                    else:
+                        row("min_goodput_fraction", lname, gf >= bound,
+                            f"goodput {gf:.4f} "
+                            f"({rec.get('payload_bytes')}/"
+                            f"{rec.get('ledger_bytes')} byte(s)), "
+                            f"floor {bound}")
+                if "max_overhead_ratio" in slo:
+                    bound = slo["max_overhead_ratio"]
+                    ov = rec.get("overhead_ratio")
+                    if ov is None:
+                        row("max_overhead_ratio", lname, False,
+                            "no bytes attributed yet: overhead unknown "
+                            "(unknown is not a pass)")
+                    else:
+                        row("max_overhead_ratio", lname, ov <= bound,
+                            f"overhead {ov:.4f} "
+                            f"({rec.get('framing_bytes')}/"
+                            f"{rec.get('ledger_bytes')} byte(s)), "
+                            f"bound {bound}")
+            if "max_egress_bytes_per_peer" in slo:
+                bound = slo["max_egress_bytes_per_peer"]
+                amp = wc.get("amplification") or {}
+                if not amp:
+                    row("max_egress_bytes_per_peer", "-", False,
+                        "no fan-out amplification ledgers joined: "
+                        "per-peer egress unknown")
+                for aname, view_ in sorted(amp.items()):
+                    for peer, nbytes in sorted(
+                            (view_.get("peers") or {}).items()):
+                        row("max_egress_bytes_per_peer",
+                            f"{aname}:{peer}", nbytes <= bound,
+                            f"delivered {nbytes} byte(s), bound {bound}")
     if "max_loop_lag_s" in slo:
         bound = slo["max_loop_lag_s"]
         loops = sample.get("loops") or {}
@@ -940,6 +1060,32 @@ def render_dashboard(view: FleetView, sample: dict,
                     f"  quarantine {r.get('replica') or tname}: {peer} "
                     f"arm={q.get('arm')} frame={q.get('frame')} "
                     f"offset={q.get('offset')}")
+    wc = sample.get("wirecost") or {}
+    if wc:
+        # the wire cost matrix (ISSUE 20): per directed link the
+        # goodput/overhead split and the tiling residual; per fan-out
+        # link the amplification factor
+        lines.append(bar)
+        lines.append(f"  {'cost link':<22} {'bytes':>10} {'goodput':>8} "
+                     f"{'overhead':>9} {'resid':>6} {'saved':>8}")
+        for lname, r in sorted((wc.get("links") or {}).items()):
+            gf, ov = r.get("goodput_fraction"), r.get("overhead_ratio")
+            rb = r.get("residual_bytes")
+            lines.append(
+                f"  {lname[:22]:<22} "
+                f"{r.get('ledger_bytes', 0):>10} "
+                f"{('?' if gf is None else f'{gf:.3f}'):>8} "
+                f"{('?' if ov is None else f'{ov:.3f}'):>9} "
+                f"{('?' if rb is None else str(rb)):>6} "
+                f"{r.get('batch_saved_bytes', 0):>8}")
+        for aname, a in sorted((wc.get("amplification") or {}).items()):
+            ampf = a.get("amplification")
+            lines.append(
+                f"  amplification {aname}: "
+                f"{('?' if ampf is None else f'{ampf:.2f}x')} "
+                f"({a.get('delivered_bytes', 0)} delivered / "
+                f"{a.get('source_bytes', 0)} source, "
+                f"{len(a.get('peers') or {})} peer(s))")
     lines.append(bar)
     rec = sample.get("reconcile") or {}
     lines.append(

@@ -1,0 +1,414 @@
+"""Wire cost plane: per-link byte ledger + amplification watermarks
+(ISSUE 20).
+
+At millions of users egress bytes ARE the cost model (ROADMAP item 4),
+yet before this module no plane could say where a link's bytes went:
+``wire.batch.bytes_saved`` priced one layer, fan-out counted delivered
+bytes, reconcile counted symbols — nothing joined them into goodput vs
+overhead per link.  This board is the simple, exact ledger the
+negotiated-compression tier will be judged against ("Simplicity
+Scales"): every wire byte on every link is attributed to exactly ONE
+frame class at the existing choke points —
+
+* encoder header push (``session/encoder.py``) — tx attribution at
+  frame build time, payload and framing split exactly;
+* both decoder dispatch loops + ``write_indexed``
+  (``session/decoder.py``) — rx attribution at frame delivery;
+* the fan-out gather (``fanout/server.py``) — source intake vs
+  per-peer delivered bytes (the amplification numerator);
+* the gossip exchange wire meter (``cluster/node.py``) — symbol
+  traffic (class ``reconcile``) vs repair batches (``change_batch``);
+* the pump send/recv steps (``session/pump.py``) — the TRANSPORT
+  ground truth the ledger is audited against.
+
+The headline invariant (the chaos oracle in
+``tests/test_wirecost.py``): the ledger EXACTLY TILES the wire — the
+sum of per-class bytes (payload + framing) equals the transport/
+journal byte ground truth at every poll, and the unattributed residual
+is EXACTLY 0 at convergence.  Faults leave the last watermark in place
+and bump ``failures`` (unknown is reported as unknown, never zero —
+the ISSUE 19 doctrine: fabricating 0 reads as healthy, the direction
+an SLO gate must never err in).
+
+Frame classes: ``change``, ``change_batch``, ``blob``, ``reconcile``,
+``snapshot`` — plus the synthetic export class ``framing`` (the sum of
+header bytes across all classes).  Derived per-link watermarks:
+
+``goodput_fraction``
+    payload bytes / total wire bytes (None until bytes flow);
+``overhead_ratio``
+    framing bytes / total wire bytes;
+``batch_saved_bytes``
+    batch savings realized (exact arithmetic vs the per-record
+    encoding — mirrored on BOTH ends since ISSUE 20 satellite 1);
+``reconcile_wire_per_diff_byte``
+    reconcile-class wire bytes per delivered diff byte (None until a
+    peel completes);
+``snapshot_cold_ratio``
+    snapshot-class wire bytes per dataset byte (None until the
+    dataset size is known);
+``amplification`` (per fan-out link)
+    delivered bytes summed over peers / source bytes published;
+``residual_bytes``
+    transport ground truth − ledger total (None until the transport
+    reports; exactly 0 at convergence).
+
+Export surface (the PR 8 collector machinery):
+``wire.cost.bytes{link=,dir=,class=}``,
+``wire.cost.frames{link=,dir=,class=}``,
+``wire.cost.saved_bytes{link=,dir=}``,
+``wire.cost.failures{link=,dir=}``,
+``wire.cost.source_bytes{link=}``,
+``wire.cost.delivered_bytes{link=,peer=}`` as counters;
+``wire.cost.goodput_fraction{link=,dir=}``,
+``wire.cost.overhead_ratio{link=,dir=}``,
+``wire.cost.reconcile_wire_per_diff_byte{link=,dir=}``,
+``wire.cost.snapshot_cold_ratio{link=,dir=}``,
+``wire.cost.amplification{link=}``,
+``wire.cost.residual_bytes{link=,dir=}`` as gauges (None-valued
+watermarks are SKIPPED, never exported as 0).
+
+Dark-path discipline (the PR 18/19 contract): NOTHING here runs unless
+``OBS.on`` — every instrumented hot path forks once on the gate into a
+dark twin whose bytecode provably references no symbol of this module
+(asserted in ``tests/test_wirecost.py``), so the disabled cost of the
+whole plane is one attribute load per fork point.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .metrics import REGISTRY as _REGISTRY, OBS as _OBS
+
+__all__ = [
+    "WIRECOST",
+    "WireCostBoard",
+    "CLASSES",
+    "account",
+    "note_saved",
+    "note_diff",
+    "note_dataset",
+    "note_source",
+    "note_delivered",
+    "note_transport",
+    "note_failure",
+]
+
+# the frame-class vocabulary (OBSERVABILITY.md "Wire cost plane"); the
+# synthetic class ``framing`` exists only in the export — every
+# account() call carries its framing bytes alongside its payload, so
+# the ledger tiles by construction
+CLASSES = ("change", "change_batch", "blob", "reconcile", "snapshot")
+
+_DIRS = ("tx", "rx")
+
+
+def _new_rec(now: float) -> dict:
+    return {
+        # cls -> {"payload": int, "framing": int, "frames": int}
+        "classes": {},
+        # transport ground truth (pump/journal); 0 = not reporting yet,
+        # and residual_bytes stays None until it does
+        "transport": 0,
+        "saved": 0,
+        "diff_bytes": None,
+        "dataset_bytes": None,
+        "failures": 0,
+        "error": None,
+        "_mono": now,
+    }
+
+
+class WireCostBoard:
+    """Process-global per-(link, direction) wire byte ledger +
+    amplification watermarks.  See module docstring; the instance is
+    :data:`WIRECOST`."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # datlint: guarded-by(self._lock): self._links, self._amp
+        # (link, dir) -> ledger record, monotonic-stamped
+        self._links: dict[tuple, dict] = {}
+        # link -> {"source": int, "delivered": {peer: int}} — the
+        # fan-out amplification inputs (one publisher, many peers)
+        self._amp: dict[str, dict] = {}
+        self._collector_fn = self._collect
+
+    # -- recording -----------------------------------------------------------
+
+    def account(self, cls: str, link: str, direction: str,
+                payload_len: int, framing_len: int,
+                frames: int = 1) -> None:
+        """Attribute one frame (or a run of ``frames`` frames) to a
+        class on a directed link.  ``payload_len``/``framing_len`` are
+        the run TOTALS — the tiling invariant is that their sum over
+        all account() calls equals the transport byte count.  ``cls``
+        is a literal at every call site (the datlint obs-discipline
+        contract: the class vocabulary must stay greppable)."""
+        if cls not in CLASSES:
+            raise ValueError(f"unknown wire cost class: {cls!r}")
+        if direction not in _DIRS:
+            raise ValueError(f"unknown wire cost direction: {direction!r}")
+        now = time.monotonic()
+        with self._lock:
+            rec = self._links.setdefault((link, direction), _new_rec(now))
+            c = rec["classes"].setdefault(
+                cls, {"payload": 0, "framing": 0, "frames": 0})
+            c["payload"] += int(payload_len)
+            c["framing"] += int(framing_len)
+            c["frames"] += int(frames)
+            rec["_mono"] = now
+        _REGISTRY.register_collector("wirecost", self._collector_fn)
+
+    def note_saved(self, link: str, direction: str, saved: int) -> None:
+        """Batch savings realized on a directed link (exact arithmetic:
+        per-record estimate − batch wire bytes, from
+        ``batch_codec.estimate_per_record_bytes``).  Recorded on BOTH
+        ends (satellite 1) so the sender==receiver cross-check is an
+        equality, not a proxy."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._links.setdefault((link, direction), _new_rec(now))
+            rec["saved"] += int(saved)
+            rec["_mono"] = now
+        _REGISTRY.register_collector("wirecost", self._collector_fn)
+
+    def note_diff(self, link: str, direction: str,
+                  diff_bytes: int) -> None:
+        """Diff bytes a completed reconcile exchange delivered — the
+        denominator of ``reconcile_wire_per_diff_byte`` (None until
+        the first completed peel; a failed exchange never touches it)."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._links.setdefault((link, direction), _new_rec(now))
+            rec["diff_bytes"] = (rec["diff_bytes"] or 0) + int(diff_bytes)
+            rec["_mono"] = now
+        _REGISTRY.register_collector("wirecost", self._collector_fn)
+
+    def note_dataset(self, link: str, direction: str,
+                     dataset_bytes: int) -> None:
+        """Dataset (cold) bytes a snapshot bootstrap covered — the
+        denominator of ``snapshot_cold_ratio``."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._links.setdefault((link, direction), _new_rec(now))
+            rec["dataset_bytes"] = (
+                (rec["dataset_bytes"] or 0) + int(dataset_bytes))
+            rec["_mono"] = now
+        _REGISTRY.register_collector("wirecost", self._collector_fn)
+
+    def note_source(self, link: str, nbytes: int) -> None:
+        """Source bytes published into a fan-out link (the
+        amplification denominator)."""
+        with self._lock:
+            amp = self._amp.setdefault(link, {"source": 0, "delivered": {}})
+            amp["source"] += int(nbytes)
+        _REGISTRY.register_collector("wirecost", self._collector_fn)
+
+    def note_delivered(self, link: str, peer: str, nbytes: int) -> None:
+        """Bytes a fan-out link delivered to one peer (the
+        amplification numerator, summed over peers)."""
+        with self._lock:
+            amp = self._amp.setdefault(link, {"source": 0, "delivered": {}})
+            amp["delivered"][peer] = (
+                amp["delivered"].get(peer, 0) + int(nbytes))
+        _REGISTRY.register_collector("wirecost", self._collector_fn)
+
+    def note_transport(self, link: str, direction: str,
+                       nbytes: int) -> None:
+        """Transport ground truth: raw bytes the pump moved on a
+        directed link.  The ledger is audited against this — residual
+        = transport − sum(classes), exported only once the transport
+        reports (0 transport = unknown, not a free pass)."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._links.setdefault((link, direction), _new_rec(now))
+            rec["transport"] += int(nbytes)
+            rec["_mono"] = now
+        _REGISTRY.register_collector("wirecost", self._collector_fn)
+
+    def note_failure(self, link: str, direction: str,
+                     error: Optional[str] = None) -> None:
+        """A wire fault on a directed link: every watermark keeps its
+        last value (the cost did not heal; fabricating fresh ratios
+        would read as healthy) — only the failure counter and the
+        error string move."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._links.setdefault((link, direction), _new_rec(now))
+            rec["failures"] += 1
+            if error is not None:
+                rec["error"] = error
+            rec["_mono"] = now
+        _REGISTRY.register_collector("wirecost", self._collector_fn)
+
+    # -- export --------------------------------------------------------------
+
+    @staticmethod
+    def _watermarks(rec: dict) -> dict:
+        """Derived per-ledger watermarks; None wherever a denominator
+        is not yet known (unknown, not zero)."""
+        payload = sum(c["payload"] for c in rec["classes"].values())
+        framing = sum(c["framing"] for c in rec["classes"].values())
+        total = payload + framing
+        wm = {
+            "ledger_bytes": total,
+            "payload_bytes": payload,
+            "framing_bytes": framing,
+            "goodput_fraction": (payload / total) if total else None,
+            "overhead_ratio": (framing / total) if total else None,
+            "batch_saved_bytes": rec["saved"],
+            "residual_bytes": ((rec["transport"] - total)
+                               if rec["transport"] else None),
+        }
+        rc = rec["classes"].get("reconcile")
+        wm["reconcile_wire_per_diff_byte"] = (
+            (rc["payload"] + rc["framing"]) / rec["diff_bytes"]
+            if rc and rec["diff_bytes"] else None)
+        sn = rec["classes"].get("snapshot")
+        wm["snapshot_cold_ratio"] = (
+            (sn["payload"] + sn["framing"]) / rec["dataset_bytes"]
+            if sn and rec["dataset_bytes"] else None)
+        return wm
+
+    @staticmethod
+    def _amp_view(amp: dict) -> dict:
+        delivered = sum(amp["delivered"].values())
+        return {
+            "source_bytes": amp["source"],
+            "delivered_bytes": delivered,
+            "peers": dict(amp["delivered"]),
+            "amplification": ((delivered / amp["source"])
+                              if amp["source"] else None),
+        }
+
+    def snapshot(self) -> dict:
+        """The ``wirecost`` section of the sidecar snapshot record
+        (JSON-able): per-directed-link ledger + watermarks with ages on
+        THIS process's monotonic clock, plus per-link amplification."""
+        now = time.monotonic()
+        with self._lock:
+            links = {f"{link}|{d}": {
+                "classes": {k: dict(v) for k, v in rec["classes"].items()},
+                "transport_bytes": rec["transport"],
+                "diff_bytes": rec["diff_bytes"],
+                "dataset_bytes": rec["dataset_bytes"],
+                "failures": rec["failures"],
+                "error": rec["error"],
+                "age_s": round(now - rec["_mono"], 6),
+                **self._watermarks(rec),
+            } for (link, d), rec in self._links.items()}
+            amp = {link: self._amp_view(a) for link, a in self._amp.items()}
+        return {"monotonic": now, "links": links, "amplification": amp}
+
+    def _collect(self) -> dict:
+        """Registry collector: the ledger as labeled counters and the
+        watermarks as labeled gauges (bounded cardinality — one entry
+        per live directed link per class; None watermarks skipped)."""
+        counters: dict = {}
+        gauges: dict = {}
+        with self._lock:
+            links = [(k, {
+                "classes": {c: dict(v) for c, v in rec["classes"].items()},
+                "transport": rec["transport"], "saved": rec["saved"],
+                "diff_bytes": rec["diff_bytes"],
+                "dataset_bytes": rec["dataset_bytes"],
+                "failures": rec["failures"], "error": rec["error"],
+            }) for k, rec in self._links.items()]
+            amps = [(link, self._amp_view(a))
+                    for link, a in self._amp.items()]
+        for (link, d), rec in links:
+            framing_total = 0
+            for cls, c in rec["classes"].items():
+                counters[f"wire.cost.bytes{{link={link},dir={d},"
+                         f"class={cls}}}"] = c["payload"]
+                counters[f"wire.cost.frames{{link={link},dir={d},"
+                         f"class={cls}}}"] = c["frames"]
+                framing_total += c["framing"]
+            if rec["classes"]:
+                counters[f"wire.cost.bytes{{link={link},dir={d},"
+                         "class=framing}"] = framing_total
+            if rec["saved"]:
+                counters[f"wire.cost.saved_bytes{{link={link},dir={d}}}"] \
+                    = rec["saved"]
+            if rec["failures"]:
+                counters[f"wire.cost.failures{{link={link},dir={d}}}"] \
+                    = rec["failures"]
+            wm = self._watermarks(rec)
+            for key in ("goodput_fraction", "overhead_ratio",
+                        "reconcile_wire_per_diff_byte",
+                        "snapshot_cold_ratio", "residual_bytes"):
+                if wm[key] is None:
+                    continue  # denominator unknown: skipped, not zero
+                gauges[f"wire.cost.{key}{{link={link},dir={d}}}"] = \
+                    float(wm[key])
+        for link, view in amps:
+            counters[f"wire.cost.source_bytes{{link={link}}}"] = \
+                view["source_bytes"]
+            for peer, nbytes in view["peers"].items():
+                counters[f"wire.cost.delivered_bytes{{link={link},"
+                         f"peer={peer}}}"] = nbytes
+            if view["amplification"] is not None:
+                gauges[f"wire.cost.amplification{{link={link}}}"] = \
+                    float(view["amplification"])
+        return {"counters": counters, "gauges": gauges}
+
+    def reset_for_tests(self) -> None:
+        """Drop every ledger and amplification record (process-global
+        state — test isolation is explicit, the conftest
+        ``obs_enabled`` contract)."""
+        with self._lock:
+            self._links.clear()
+            self._amp.clear()
+
+
+WIRECOST = WireCostBoard()
+
+
+# -- the instrumentation surface (callers hold the OBS.on gate) --------------
+
+
+def account(cls: str, link: str, direction: str, payload_len: int,
+            framing_len: int, frames: int = 1) -> None:
+    """Module-level forwarder for lit helpers that hoist the module
+    (``from ..obs import wirecost as _wirecost``); same literal-class
+    contract as :meth:`WireCostBoard.account`."""
+    WIRECOST.account(cls, link, direction, payload_len, framing_len,
+                     frames)
+
+
+def note_saved(link: str, direction: str, saved: int) -> None:
+    WIRECOST.note_saved(link, direction, saved)
+
+
+def note_diff(link: str, direction: str, diff_bytes: int) -> None:
+    WIRECOST.note_diff(link, direction, diff_bytes)
+
+
+def note_dataset(link: str, direction: str, dataset_bytes: int) -> None:
+    WIRECOST.note_dataset(link, direction, dataset_bytes)
+
+
+def note_source(link: str, nbytes: int) -> None:
+    WIRECOST.note_source(link, nbytes)
+
+
+def note_delivered(link: str, peer: str, nbytes: int) -> None:
+    WIRECOST.note_delivered(link, peer, nbytes)
+
+
+def note_transport(link: str, direction: str, nbytes: int) -> None:
+    WIRECOST.note_transport(link, direction, nbytes)
+
+
+def note_failure(link: str, direction: str,
+                 error: Optional[str] = None) -> None:
+    WIRECOST.note_failure(link, direction, error)
+
+
+# re-exported so instrumentation call sites can assert the plane's own
+# gate state in tests without importing metrics twice
+OBS = _OBS

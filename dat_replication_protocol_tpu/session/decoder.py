@@ -37,6 +37,7 @@ from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
 from ..obs.tracing import trace_instant as _trace_instant
 from ..obs.watermarks import WATERMARKS as _WATERMARKS
+from ..obs import wirecost as _wirecost
 from ..wire.change_codec import Change, decode_change
 from ..wire.framing import LOCAL_CAPS, MAX_HEADER_LEN, TYPE_BLOB, \
     TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_HEADER, TYPE_RECONCILE, \
@@ -57,6 +58,10 @@ _M_DEC_REQUEUES = _counter("decoder.requeues")
 _M_DEC_ERRORS = _counter("decoder.errors")
 # columnar ChangeBatch frames dispatched (rows ride decoder.changes)
 _M_DEC_BATCH_FRAMES = _counter("decoder.batch.frames")
+# receiver-side mirror of wire.batch.bytes_saved (ISSUE 20 satellite):
+# the SAME exact arithmetic run against the decoded columns, so sender
+# and receiver agree to the byte (tests/test_wirecost.py cross-check)
+_M_BATCH_SAVED_RX = _counter("wire.batch.bytes_saved_rx")
 # reconcile protocol frames dispatched (OBSERVABILITY.md "reconcile.*")
 _M_DEC_RC_FRAMES = _counter("decoder.reconcile.frames")
 # snapshot protocol frames dispatched (OBSERVABILITY.md "snapshot.*")
@@ -207,6 +212,11 @@ def _drain_blob(blob: BlobReader, done: Callable[[], None]) -> None:
 
 class Decoder:
     """Push-based incremental wire parser. See module docstring."""
+
+    # the wire cost plane's link label (ISSUE 20): owners carrying more
+    # than one session overwrite it per instance (the sidecar names it
+    # after the session key) — a collector label, runtime by design
+    cost_link = "session"
 
     def __init__(self):
         self.bytes = 0
@@ -537,6 +547,7 @@ class Decoder:
             _M_DEC_ERRORS.inc()
             _emit("protocol.error", frame=err.frame, offset=err.offset,
                   message=message)
+            self._lit_cost_failure(message)
         if _FLIGHT.armed:
             _FLIGHT.dump("protocol-error", error=err,
                          checkpoint=self.checkpoint(emit_event=False))
@@ -1189,6 +1200,8 @@ class Decoder:
                         _trace_instant("decoder.frame.run", offset=off0,
                                        kind="change", frames=k,
                                        wire_len=end - off0)
+                        self._lit_cost_change_run(
+                            end - off0, sum(st["lens"][f0:f0 + k]), k)
                 if use_tap:
                     self._note_change_payloads(sink, st["row"] - row0)
             if status == 2:
@@ -1268,6 +1281,10 @@ class Decoder:
             self._state = TYPE_HEADER
             if _OBS.on and row > row0:
                 _M_DEC_CHANGES.inc(row - row0)
+                ptot = sum(flens[f0:f])
+                self._lit_cost_change_run(
+                    ptot + sum(_header_len(x) for x in flens[f0:f]),
+                    ptot, f - f0)
             if use_tap:
                 self._note_change_payloads(sink, row - row0)
         return f
@@ -1386,6 +1403,59 @@ class Decoder:
                 raise
         return rest
 
+    # -- wire cost lit helpers (ISSUE 20) ------------------------------------
+    # Each hot path forks ONCE on `_OBS.on`; the helper below the fork
+    # holds every wirecost symbol, so the dark twin's bytecode provably
+    # references none of them (tests/test_wirecost.py asserts it) and
+    # the disabled cost stays one attribute load.  The frame CLASS is a
+    # string literal at every call (the datlint obs-discipline
+    # contract).
+
+    def _lit_cost_change(self, plen: int) -> None:
+        _wirecost.account("change", self.cost_link, "rx", plen,
+                          _header_len(plen))
+
+    def _lit_cost_change_run(self, wire_total: int, payload_total: int,
+                             frames: int) -> None:
+        _wirecost.account("change", self.cost_link, "rx", payload_total,
+                          wire_total - payload_total, frames)
+
+    def _lit_cost_batch(self, plen: int, cols, rows: int) -> None:
+        from ..wire import batch_codec
+
+        hl = _header_len(plen)
+        _wirecost.account("change_batch", self.cost_link, "rx", plen, hl)
+        # satellite: the receiver prices the batch savings with the SAME
+        # exact arithmetic the encoder ran pre-encode — decoded column
+        # lengths feed the identical per-record estimate, so the two
+        # counters agree to the byte
+        est = batch_codec.estimate_per_record_bytes(
+            cols.key_len, cols.sub_len, cols.val_len,
+            cols.change, cols.from_, cols.to)
+        saved = int(est) - (hl + plen)
+        if saved > 0:
+            _M_BATCH_SAVED_RX.inc(saved)
+            _wirecost.note_saved(self.cost_link, "rx", saved)
+
+    def _lit_cost_reconcile(self, plen: int) -> None:
+        _wirecost.account("reconcile", self.cost_link, "rx", plen,
+                          _header_len(plen))
+
+    def _lit_cost_snapshot(self, plen: int) -> None:
+        _wirecost.account("snapshot", self.cost_link, "rx", plen,
+                          _header_len(plen))
+
+    def _lit_cost_blob(self, length: int) -> None:
+        # accrued in full at frame open — the same moment the
+        # decoder.frame tag prices the whole frame
+        _wirecost.account("blob", self.cost_link, "rx", length,
+                          _header_len(length))
+
+    def _lit_cost_failure(self, message: str) -> None:
+        # a wire fault: the ledger keeps its last watermarks (the cost
+        # did not heal) — only the failure counter moves
+        _wirecost.note_failure(self.cost_link, "rx", message)
+
     def _finish_change(self, payload) -> None:
         try:
             change = decode_change(payload)
@@ -1411,6 +1481,7 @@ class Decoder:
                            kind="change",
                            wire_len=_header_len(len(payload))
                            + len(payload))
+            self._lit_cost_change(len(payload))
         self._state = TYPE_HEADER
         if self._on_change is not None:
             # same deferred-arm ack as the bulk fast loop: a sync ack
@@ -1487,6 +1558,7 @@ class Decoder:
                            kind="change_batch", rows=n,
                            wire_len=_header_len(len(payload))
                            + len(payload))
+            self._lit_cost_batch(len(payload), cols, n)
         self._state = TYPE_HEADER
         # digest tap: the whole frame's rows are owed at acceptance (the
         # blob doctrine — one frame, one accounting point), BEFORE any
@@ -1622,6 +1694,7 @@ class Decoder:
                            kind="reconcile",
                            wire_len=_header_len(len(payload))
                            + len(payload))
+            self._lit_cost_reconcile(len(payload))
         self._state = TYPE_HEADER
         # delivery consumes the frame BEFORE the handler can raise (the
         # change/blob doctrine): a caught raise-then-resume re-enters at
@@ -1664,6 +1737,7 @@ class Decoder:
                            kind="snapshot",
                            wire_len=_header_len(len(payload))
                            + len(payload))
+            self._lit_cost_snapshot(len(payload))
         self._state = TYPE_HEADER
         # delivery consumes the frame BEFORE the handler can raise (the
         # change/blob doctrine): a caught raise-then-resume re-enters at
@@ -1701,6 +1775,7 @@ class Decoder:
                            kind="blob",
                            wire_len=_header_len(self._missing)
                            + self._missing)
+            self._lit_cost_blob(self._missing)
         latch = {"ended": False, "acked": False}
         blob._pending_latch = latch
 

@@ -34,11 +34,12 @@ from typing import Callable, Optional
 from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
 from ..obs.tracing import trace_instant as _trace_instant
+from ..obs import wirecost as _wirecost
 from ..wire.change_codec import Change, _check_uint32, \
     _encode_change_with, _fastpath_mod, encode_change
 from ..wire.framing import CAP_CHANGE_BATCH, CAP_RECONCILE, CAP_SNAPSHOT, \
     TYPE_BLOB, TYPE_CHANGE, TYPE_CHANGE_BATCH, TYPE_RECONCILE, \
-    TYPE_SNAPSHOT, frame_header, frame_wire_len
+    TYPE_SNAPSHOT, frame_header, frame_wire_len, header_len as _header_len
 
 OnDone = Optional[Callable[[], None]]
 
@@ -214,6 +215,7 @@ class BlobWriter:
                 _trace_instant("encoder.frame", offset=self._encoder.bytes,
                                kind="blob",
                                wire_len=frame_wire_len(self.length))
+                self._encoder._lit_cost_blob(self.length)
         for data, cb, t0 in self._parked:
             self._encoder._parked_bytes -= len(data)
             if t0 is not None and _OBS.on:
@@ -235,6 +237,11 @@ class BlobWriter:
 
 class Encoder:
     """Pull-based frame producer. See module docstring for semantics."""
+
+    # the wire cost plane's link label (ISSUE 20): owners carrying more
+    # than one session overwrite it per instance (the sidecar names it
+    # after the session key) — a collector label, runtime by design
+    cost_link = "session"
 
     def __init__(self, high_water: int = DEFAULT_HIGH_WATER,
                  peer_caps: int = 0,
@@ -395,6 +402,7 @@ class Encoder:
         fp = _fastpath_mod()  # bound once for the whole run
         out = bytearray()
         n = 0
+        plen = 0
         obs_on = _OBS.on
         for rec in records:
             payload = _encode_change_with(fp, rec)
@@ -404,6 +412,7 @@ class Encoder:
                                offset=self.bytes + len(out),
                                kind="change",
                                wire_len=len(header) + len(payload))
+                plen += len(payload)
             out += header
             out += payload
             n += 1
@@ -414,6 +423,8 @@ class Encoder:
         self.changes += n
         if obs_on:
             _M_ENC_CHANGES.inc(n)
+            # run totals: framing = framed bytes minus payload bytes
+            self._lit_cost_change(len(out) - plen, plen, n)
         return self._push(bytes(out), on_flush)
 
     # -- ChangeBatch accumulation -------------------------------------------
@@ -508,6 +519,41 @@ class Encoder:
                     and _now() - self._batch_t0 >= pol.max_delay)):
             self.flush_batch()
 
+    # -- wire cost lit helpers (ISSUE 20) ------------------------------------
+    # Each hot path forks ONCE on `_OBS.on`; the helper below the fork
+    # holds every wirecost symbol, so the dark twin's bytecode provably
+    # references none of them (tests/test_wirecost.py asserts it) and
+    # the disabled cost stays one attribute load.  The frame CLASS is a
+    # string literal at every call (the datlint obs-discipline
+    # contract: the class vocabulary must stay greppable).
+
+    def _lit_cost_change(self, framing: int, payload: int,
+                         frames: int = 1) -> None:
+        _wirecost.account("change", self.cost_link, "tx", payload,
+                          framing, frames)
+
+    def _lit_cost_batch(self, framing: int, payload: int,
+                        saved: int) -> None:
+        _wirecost.account("change_batch", self.cost_link, "tx", payload,
+                          framing)
+        if saved > 0:
+            _wirecost.note_saved(self.cost_link, "tx", saved)
+
+    def _lit_cost_reconcile(self, framing: int, payload: int) -> None:
+        _wirecost.account("reconcile", self.cost_link, "tx", payload,
+                          framing)
+
+    def _lit_cost_snapshot(self, framing: int, payload: int) -> None:
+        _wirecost.account("snapshot", self.cost_link, "tx", payload,
+                          framing)
+
+    def _lit_cost_blob(self, length: int) -> None:
+        # accrued in full at header time — the same moment the
+        # encoder.frame tag prices the whole frame (wire_len includes
+        # the declared payload the chunks will stream)
+        _wirecost.account("blob", self.cost_link, "tx", length,
+                          _header_len(length))
+
     def flush_batch(self) -> None:
         """Frame every pending batch row NOW as one ``TYPE_CHANGE_BATCH``
         frame (no-op when nothing is pending)."""
@@ -549,6 +595,7 @@ class Encoder:
             _trace_instant("encoder.frame", offset=self.bytes,
                            kind="change_batch", rows=n,
                            wire_len=len(header) + len(payload))
+            self._lit_cost_batch(len(header), len(payload), int(saved))
         if len(cbs) > 1:
             def all_cbs(cbs=cbs):
                 for cb in cbs:
@@ -569,6 +616,7 @@ class Encoder:
             _trace_instant("encoder.frame", offset=self.bytes,
                            kind="change",
                            wire_len=len(header) + len(payload))
+            self._lit_cost_change(len(header), len(payload))
         self._push(header, None)
         return self._push(payload, on_flush)
 
@@ -607,6 +655,7 @@ class Encoder:
             _trace_instant("encoder.frame", offset=self.bytes,
                            kind="reconcile",
                            wire_len=len(header) + len(payload))
+            self._lit_cost_reconcile(len(header), len(payload))
         return self._push(header + payload, on_flush)
 
     def snapshot_frame(self, payload, on_flush: OnDone = None) -> bool:
@@ -642,6 +691,7 @@ class Encoder:
             _trace_instant("encoder.frame", offset=self.bytes,
                            kind="snapshot",
                            wire_len=len(header) + len(payload))
+            self._lit_cost_snapshot(len(header), len(payload))
         return self._push(header + payload, on_flush)
 
     def blob(self, length: int, on_flush: OnDone = None) -> BlobWriter:
@@ -675,6 +725,7 @@ class Encoder:
                 _trace_instant("encoder.frame", offset=self.bytes,
                                kind="blob",
                                wire_len=len(header) + length)
+                self._lit_cost_blob(length)
             self._push(header, None)
         self._open_blobs.append(ws)
         return ws

@@ -54,6 +54,7 @@ import numpy as np
 
 from ..obs.metrics import OBS as _OBS, counter as _counter, \
     histogram as _histogram
+from ..obs import wirecost as _wirecost
 from ..runtime import native
 from .decoder import Decoder, DecoderDestroyedError
 from .encoder import Encoder, EncoderDestroyedError
@@ -144,6 +145,34 @@ class _RecvState:
         self.stats = np.zeros(2, dtype=np.int64)
 
 
+def _lit_rx(decoder, nbytes: int) -> None:
+    """Lit-side transport ground truth, receive direction (ISSUE 20):
+    the pump IS the transport, so raw received bytes anchor the wire
+    cost ledger's tiling audit.  Callers hold the ``_OBS.on`` gate —
+    the hot loops stay bytecode-free of this module's plane."""
+    _wirecost.note_transport(
+        getattr(decoder, "cost_link", "session"), "rx", nbytes)
+
+
+def _lit_tx(encoder, nbytes: int) -> None:
+    """Lit-side transport ground truth, send direction (ISSUE 20)."""
+    _wirecost.note_transport(
+        getattr(encoder, "cost_link", "session"), "tx", nbytes)
+
+
+def _metered_reader(decoder, read_bytes):
+    """Wrap a python-route ``read_bytes`` so the fallback pump reports
+    the same transport ground truth the native loop does (per-read
+    ``_OBS.on`` fork: the dark path adds one attribute load)."""
+    def metered(n: int) -> bytes:
+        data = read_bytes(n)
+        if data and _OBS.on:
+            _lit_rx(decoder, len(data))
+        return data
+
+    return metered
+
+
 def _note_batch(nbytes: int, stats) -> None:
     syscalls = int(stats[0])
     msgs = int(stats[1])
@@ -171,7 +200,7 @@ def recv_pump(decoder: Decoder, fd: int,
         if _OBS.on:
             _M_FALLBACK.inc()
         read_bytes = _tapped_reader(fd, tap)
-        recv_over(decoder, read_bytes)
+        recv_over(decoder, _metered_reader(decoder, read_bytes))
         return
     st = _RecvState(cap)
     wake = threading.Event()
@@ -183,7 +212,8 @@ def recv_pump(decoder: Decoder, fd: int,
             r = native.pump_recv_scan(fd, buf, PUMP_SLICE, st.starts,
                                       st.lens, st.ids, st.stats)
             if r is None:  # library vanished mid-session (tests reset)
-                recv_over(decoder, _tapped_reader(fd, tap))
+                recv_over(decoder,
+                          _metered_reader(decoder, _tapped_reader(fd, tap)))
                 return
             nbytes, nframes, consumed, _err = r
             if _OBS.on:
@@ -196,6 +226,7 @@ def recv_pump(decoder: Decoder, fd: int,
                 raise OSError(-nbytes, os.strerror(-nbytes))
             if _OBS.on:
                 _note_batch(nbytes, st.stats)
+                _lit_rx(decoder, nbytes)
             # zero-copy handoff: the decoder owns this slab's memory
             # from here (its cursors may pin slices of it); the tap
             # sees the same bytes as one read-only view
@@ -249,6 +280,8 @@ def send_pump(encoder: Encoder, fd: int,
 
         def write_bytes(data) -> None:
             _write_all(fd, data)
+            if _OBS.on and len(data):
+                _lit_tx(encoder, len(data))
             if on_progress is not None:
                 on_progress()
 
@@ -289,6 +322,7 @@ def send_pump(encoder: Encoder, fd: int,
                 raise OSError(-w, os.strerror(-w))
             if _OBS.on:
                 _note_batch(int(w), stats)
+                _lit_tx(encoder, int(w))
             if on_progress is not None:
                 # the sidecar's reply-stall clock: one monotonic read
                 # datlint: allow-callback-escape
@@ -479,6 +513,7 @@ def recv_step(pump: EdgePump, decoder: Decoder, tap=None) -> tuple:
             return (0, True)
         if _OBS.on:
             _note_batch(nbytes, st.stats)
+            _lit_rx(decoder, nbytes)
         data = memoryview(buf)[:nbytes]
         if tap is not None:
             # the broadcast tee (FanoutServer.publish): an append +
@@ -491,6 +526,16 @@ def recv_step(pump: EdgePump, decoder: Decoder, tap=None) -> tuple:
         except DecoderDestroyedError:
             pass  # the loop's teardown predicate sees dec.destroyed
         return (nbytes, False)
+    res = _recv_step_py(pump, decoder, tap)
+    if _OBS.on and res[0]:
+        _lit_rx(decoder, res[0])
+    return res
+
+
+def _recv_step_py(pump: EdgePump, decoder: Decoder, tap=None) -> tuple:
+    """The python arm of :func:`recv_step` (one bounded ``os.read``
+    turn); split out so the transport ground-truth noting forks ONCE on
+    the final byte total instead of at every return point."""
     total = 0
     while total < pump.cap:
         try:
@@ -534,6 +579,16 @@ def send_step(pump: EdgePump, encoder: Encoder) -> tuple:
     still hold (watch ``EVENT_WRITE``).  Native route:
     :func:`send_spans_nb` gather batches; Python route: non-blocking
     ``os.write`` with the partial tail stashed in ``pump.pending``."""
+    res = _send_step_impl(pump, encoder)
+    if _OBS.on and res[0]:
+        _lit_tx(encoder, res[0])
+    return res
+
+
+def _send_step_impl(pump: EdgePump, encoder: Encoder) -> tuple:
+    """The engine of :func:`send_step`; split out so the transport
+    ground-truth noting forks ONCE on the turn's accepted-byte total
+    instead of at every return point."""
     accepted = 0
     for _ in range(_SEND_TURN_PULLS):
         if pump.pending is None:

@@ -49,6 +49,7 @@ from .obs.events import emit as _emit
 from .obs.metrics import OBS as _OBS, counter as _counter
 from .obs.tracing import trace_span as _trace_span
 from .obs.propagation import PROPAGATION as _PROPAGATION
+from .obs.wirecost import WIRECOST as _WIRECOST
 from .obs.watermarks import WATERMARKS as _WATERMARKS
 from .session import pump as session_pump
 from .session.transport import recv_over, send_over
@@ -204,6 +205,13 @@ def run_session(read_bytes, write_bytes, close_write=None,
     # per connection (untracked on exit — dead sessions vanish)
     wm_link = session_key if session_key else "stdio"
     dec.watermark(wm_link)
+    # wire cost plane (ISSUE 20): name this session's ledger link after
+    # the same key the watermark plane uses, so `obs fleet` can join
+    # cost rows against cursors without a translation table.  Plain
+    # attribute writes — the boards only see them when the lit helpers
+    # run, so the dark path is untouched.
+    enc.cost_link = wm_link
+    dec.cost_link = wm_link
 
     # reply write progress, shared by every stall check: refreshed each
     # time a reply byte actually reaches the transport
@@ -1072,6 +1080,14 @@ def snapshot_stats() -> dict:
         prop = _PROPAGATION.snapshot()
         if prop["links"] or prop["frontier"]:
             out["propagation"] = prop
+    # the wire cost plane (ISSUE 20): per-link byte ledger + goodput /
+    # overhead / amplification watermarks.  Presence-gated like the
+    # propagation board above — an empty ledger (plane dark, or lit but
+    # no traffic yet) is omitted entirely, so `obs fleet` can apply the
+    # loud-failure rule to cost SLO keys instead of averaging zeros.
+    wc = _WIRECOST.snapshot()
+    if wc["links"] or wc["amplification"]:
+        out["wirecost"] = wc
     if _ACTIVE_EDGE is not None:
         # edge mode (ISSUE 17): the unified session-table aggregate —
         # per-QoS-class and per-kind session counts, admission/shed

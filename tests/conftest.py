@@ -51,7 +51,7 @@ def obs_enabled():
     afterwards — all five are process-global, so isolation is
     explicit."""
     from dat_replication_protocol_tpu.obs import device, events, flight, \
-        metrics, propagation, tracing, watermarks
+        metrics, propagation, tracing, watermarks, wirecost
 
     was_on = metrics.OBS.on
     metrics.REGISTRY.reset()
@@ -62,6 +62,7 @@ def obs_enabled():
     device.reset_engine_notes()
     watermarks.WATERMARKS.reset_for_tests()
     propagation.PROPAGATION.reset_for_tests()
+    wirecost.WIRECOST.reset_for_tests()
     metrics.enable()
     try:
         yield metrics
@@ -77,3 +78,4 @@ def obs_enabled():
         device.reset_engine_notes()
         watermarks.WATERMARKS.reset_for_tests()
         propagation.PROPAGATION.reset_for_tests()
+        wirecost.WIRECOST.reset_for_tests()

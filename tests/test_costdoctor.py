@@ -1,0 +1,173 @@
+"""The offline costdoctor (ISSUE 20): rebuilding the per-link wire
+cost ledger from frame instants and naming the doctored link on every
+seeded anomaly — unattributed bytes, overhead anomalies, amplification
+regressions — while flagging NOTHING on clean lit logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu import CAP_CHANGE_BATCH
+from dat_replication_protocol_tpu.obs import events as obs_events
+from dat_replication_protocol_tpu.obs import tracing
+from dat_replication_protocol_tpu.obs.__main__ import main as obs_main
+from dat_replication_protocol_tpu.session.resume import WireJournal
+
+
+def _detach():
+    obs_events.EVENTS.detach_sink()
+    tracing.SPANS.detach_sink()
+
+
+def _session_log(tmp_path, name: str = "peer.jsonl") -> tuple[str, int]:
+    """One lit sender session mirrored into a JSONL log; returns the
+    log path and the total wire length."""
+    log = str(tmp_path / name)
+    sink = tracing.attach_jsonl_sink(log)
+    e = protocol.encode()
+    j = WireJournal()
+    e.attach_journal(j)
+    for i in range(40):
+        e.change({"key": f"k{i}", "change": i, "from": i, "to": i + 1,
+                  "value": b"v" * (i % 25)})
+    e.negotiate(CAP_CHANGE_BATCH)
+    e.change_many([{"key": f"b{i}", "change": i, "from": 0, "to": 1,
+                    "value": b"w" * (i % 7),
+                    "subset": "dataset/tag"} for i in range(20)])
+    e.flush_batch()
+    b = e.blob(150)
+    b.write(b"x" * 150)
+    b.end()
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    wire = j.read_from(0)
+    _detach()
+    sink.close()
+    return log, len(wire)
+
+
+def test_clean_log_flags_nothing_and_exits_zero(obs_enabled, tmp_path,
+                                                capsys):
+    log, total = _session_log(tmp_path)
+    rc = obs_main(["costdoctor", log, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["flags"] == []
+    led = report["ledgers"]["peer.jsonl|tx"]
+    # the rebuilt ledger covers the whole wire, split across the
+    # classes the session actually emitted
+    assert led["wire_bytes"] == total
+    assert set(led["classes"]) == {"change", "change_batch", "blob"}
+    assert led["unattributed_bytes"] == 0
+    assert led["overhead_ratio"] < 0.5
+
+
+def test_dropped_frame_names_the_link_as_unattributed(obs_enabled,
+                                                      tmp_path, capsys):
+    log, _total = _session_log(tmp_path)
+    lines = open(log, encoding="utf-8").read().splitlines()
+    idx = [i for i, ln in enumerate(lines) if '"encoder.frame"' in ln]
+    doctored = str(tmp_path / "doctored.jsonl")
+    drop = idx[len(idx) // 2]
+    with open(doctored, "w", encoding="utf-8") as f:
+        f.write("\n".join(ln for i, ln in enumerate(lines) if i != drop)
+                + "\n")
+    rc = obs_main(["costdoctor", doctored, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    flags = [f for f in report["flags"]
+             if f["flag"] == "unattributed-bytes"]
+    assert flags and all(f["link"] == "doctored.jsonl|tx" for f in flags)
+    # the flagged byte count is exactly the dropped frame's wire_len
+    dropped = json.loads(lines[drop])["fields"]["wire_len"]
+    assert f"{dropped} wire byte(s)" in flags[0]["detail"]
+
+
+def test_overhead_anomaly_fires_on_threshold(obs_enabled, tmp_path,
+                                             capsys):
+    log, _total = _session_log(tmp_path)
+    # every real session log has SOME framing; an absurdly low
+    # threshold must trip the anomaly and name the stream
+    rc = obs_main(["costdoctor", log, "--max-overhead", "0.0001",
+                   "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    flags = [f for f in report["flags"] if f["flag"] == "overhead-anomaly"]
+    assert flags and flags[0]["link"] == "peer.jsonl|tx"
+
+
+def test_min_goodput_floor(obs_enabled, tmp_path, capsys):
+    log, _total = _session_log(tmp_path)
+    rc = obs_main(["costdoctor", log, "--min-goodput", "0.9999",
+                   "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["flag"] == "overhead-anomaly" and "goodput" in f["detail"]
+               for f in report["flags"])
+
+
+def _stats_log(tmp_path, amps: list[float], link: str = "fanout") -> str:
+    path = str(tmp_path / "stats.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        for a in amps:
+            f.write(json.dumps({"wirecost": {"links": {}, "amplification": {
+                link: {"source_bytes": 1000,
+                       "delivered_bytes": int(1000 * a),
+                       "peers": {}, "amplification": a}}}}) + "\n")
+    return path
+
+
+def test_amplification_regression_names_the_link(obs_enabled, tmp_path,
+                                                 capsys):
+    path = _stats_log(tmp_path, [3.0, 3.1, 1.0])
+    rc = obs_main(["costdoctor", path, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    flags = [f for f in report["flags"]
+             if f["flag"] == "amplification-regression"]
+    assert flags and flags[0]["link"] == "fanout"
+
+
+def test_steady_amplification_is_clean(obs_enabled, tmp_path, capsys):
+    path = _stats_log(tmp_path, [2.8, 3.0, 2.9])
+    rc = obs_main(["costdoctor", path, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["flags"] == []
+    assert report["amplification"]["fanout"] == [2.8, 3.0, 2.9]
+
+
+def test_nonzero_live_residual_flags_unattributed(obs_enabled, tmp_path,
+                                                  capsys):
+    path = str(tmp_path / "stats.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"wirecost": {"amplification": {}, "links": {
+            "s1|rx": {"residual_bytes": 37}}}}) + "\n")
+    rc = obs_main(["costdoctor", path, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["flag"] == "unattributed-bytes" and f["link"] == "s1|rx"
+               and "37" in f["detail"] for f in report["flags"])
+
+
+def test_dark_log_reports_plane_dark_and_exits_zero(tmp_path, capsys):
+    empty = str(tmp_path / "dark.jsonl")
+    open(empty, "w").close()
+    rc = obs_main(["costdoctor", empty])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "never ran lit" in out
+
+
+def test_human_output_prints_ledger_and_flags(obs_enabled, tmp_path,
+                                              capsys):
+    log, _total = _session_log(tmp_path)
+    rc = obs_main(["costdoctor", log])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "peer.jsonl|tx" in out and "clean" in out

@@ -999,6 +999,45 @@ def test_obs_discipline_still_covers_propagation_call_sites(tmp_path):
     assert sum(f.rule == "obs-discipline" for f in findings) == 1
 
 
+def test_obs_discipline_clean_on_literal_wirecost_classes(tmp_path):
+    # the wire cost plane (ISSUE 20): the CLASS argument of account()
+    # is the greppable vocabulary; the LINK is a collector label,
+    # runtime by design (same split as loopprof's phase vs session)
+    assert _lint(tmp_path, ("wcok.py", '''
+        def f(wirecost, link, payload, framing):
+            wirecost.account("change", link, "tx", payload, framing)
+            wirecost.account("change_batch", link, "rx", payload, framing)
+    ''')) == []
+
+
+def test_obs_discipline_wirecost_class_must_be_literal(tmp_path):
+    # a forwarded class name breaks the grep contract exactly like a
+    # forwarded metric name: one finding per call site
+    findings = _lint(tmp_path, ("wcbad.py", '''
+        def f(wirecost, cls, link, payload, framing):
+            wirecost.account(cls, link, "tx", payload, framing)
+    '''))
+    assert sum(f.rule == "obs-discipline" for f in findings) == 1
+
+
+def test_obs_discipline_exempts_the_wirecost_plumbing_itself(tmp_path):
+    # obs/wirecost.py renders labeled counter names from ledger state
+    # and forwards the class through its module-level helpers —
+    # plumbing; the greppable class literals live at the choke points
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    (obs_dir / "wirecost.py").write_text(textwrap.dedent('''
+        def account(board, cls, link, payload, framing):
+            board.account(cls, link, "tx", payload, framing)
+
+        def _collect(links):
+            return {f"wire.cost.bytes{{link={l},class={c}}}": v
+                    for (l, c), v in links.items()}
+    '''))
+    findings = run_paths([tmp_path])
+    assert "obs-discipline" not in _rules_fired(findings)
+
+
 # -- hub-isolation (ISSUE 8: the shared-engine structural invariants) -------
 
 # the pre-discipline shape: a device dispatch while the hub lock is

@@ -1,0 +1,500 @@
+"""Wire cost plane (ISSUE 20): the per-link byte ledger that EXACTLY
+TILES the wire, its derived goodput/overhead/amplification watermarks,
+the dark-twin bytecode discipline on every instrumented hot path, the
+sender==receiver batch-savings parity (satellite 1), and the fleet
+cost-matrix SLO gate.
+
+The headline invariant is the 20-seed chaos oracle: across session
+(faulty resumable transport), fan-out, and gossip legs, the sum of
+per-class bytes (payload + framing) equals the transport/journal byte
+ground truth, and the unattributed residual is EXACTLY 0 at
+convergence.  Faults keep the last watermark and bump ``failures`` —
+unknown is reported as unknown, never zero.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import types
+
+import pytest
+
+import dat_replication_protocol_tpu as protocol
+from dat_replication_protocol_tpu import CAP_CHANGE_BATCH
+from dat_replication_protocol_tpu.cluster import ReplicaNode, gossip_exchange
+from dat_replication_protocol_tpu.cluster import node as cluster_node
+from dat_replication_protocol_tpu.fanout import FanoutServer
+from dat_replication_protocol_tpu.fanout import server as fanout_server
+from dat_replication_protocol_tpu.obs import fleet
+from dat_replication_protocol_tpu.obs import metrics as obs_metrics
+from dat_replication_protocol_tpu.obs.wirecost import WIRECOST, CLASSES
+from dat_replication_protocol_tpu.session import decoder as decoder_mod
+from dat_replication_protocol_tpu.session import encoder as encoder_mod
+from dat_replication_protocol_tpu.session import pump as pump_mod
+from dat_replication_protocol_tpu.session.faults import (
+    FaultPlan,
+    FaultyReader,
+    TransportFault,
+    bytes_reader,
+)
+from dat_replication_protocol_tpu.session.reconnect import (
+    BackoffPolicy,
+    run_resumable,
+)
+from dat_replication_protocol_tpu.session.resume import WireJournal
+
+
+def _recs(lo: int, hi: int, tag: str = "s", val: bytes = b"v"):
+    return [{"key": f"k{i}", "change": i, "from": 0, "to": 1,
+             "value": val + b"%d" % i, "subset": tag}
+            for i in range(lo, hi)]
+
+
+def _ledger(link: str, direction: str) -> dict:
+    return WIRECOST.snapshot()["links"][f"{link}|{direction}"]
+
+
+def _build_wire(rng: random.Random):
+    """One encoder session mixing every frame class the session layer
+    emits: per-record changes, a coalesced batch, and a blob.  Returns
+    (wire bytes, encoder)."""
+    e = protocol.encode()
+    j = WireJournal()
+    e.attach_journal(j)
+    n = rng.randrange(20, 60)
+    for i in range(n):
+        e.change({"key": f"k{i}", "change": i, "from": i, "to": i + 1,
+                  "value": b"v" * rng.randrange(0, 40)})
+    e.negotiate(CAP_CHANGE_BATCH)
+    e.change_many([{"key": f"b{i}", "change": i, "from": 0, "to": 1,
+                    "value": b"w" * rng.randrange(0, 20)}
+                   for i in range(rng.randrange(10, 30))])
+    e.flush_batch()
+    blob_len = rng.randrange(50, 300)
+    b = e.blob(blob_len)
+    b.write(b"x" * blob_len)
+    b.end()
+    e.finalize()
+    while e.read(4096) is not None:
+        pass
+    return j.read_from(0), e
+
+
+# -- board unit layer ---------------------------------------------------------
+
+
+def test_account_rejects_unknown_class_and_direction(obs_enabled):
+    with pytest.raises(ValueError):
+        WIRECOST.account("framing", "l", "tx", 1, 1)  # synthetic class
+    with pytest.raises(ValueError):
+        WIRECOST.account("change", "l", "out", 1, 1)
+
+
+def test_watermarks_are_none_until_denominators_known(obs_enabled):
+    WIRECOST.account("reconcile", "l", "tx", 100, 4)
+    rec = _ledger("l", "tx")
+    # transport never reported: the residual is unknown, not zero
+    assert rec["residual_bytes"] is None
+    # no completed peel yet: wire-per-diff-byte unknown
+    assert rec["reconcile_wire_per_diff_byte"] is None
+    assert rec["snapshot_cold_ratio"] is None
+    WIRECOST.note_diff("l", "tx", 50)
+    WIRECOST.note_transport("l", "tx", 104)
+    rec = _ledger("l", "tx")
+    assert rec["residual_bytes"] == 0
+    assert rec["reconcile_wire_per_diff_byte"] == pytest.approx(104 / 50)
+
+
+def test_goodput_and_overhead_tile_by_construction(obs_enabled):
+    WIRECOST.account("change", "l", "rx", 90, 10)
+    rec = _ledger("l", "rx")
+    assert rec["ledger_bytes"] == 100
+    assert rec["goodput_fraction"] == pytest.approx(0.9)
+    assert rec["overhead_ratio"] == pytest.approx(0.1)
+    assert rec["goodput_fraction"] + rec["overhead_ratio"] == 1.0
+
+
+def test_failure_keeps_watermarks_and_bumps_counter(obs_enabled):
+    WIRECOST.account("change", "l", "tx", 90, 10)
+    before = _ledger("l", "tx")
+    WIRECOST.note_failure("l", "tx", "TransportFault: injected")
+    after = _ledger("l", "tx")
+    assert after["failures"] == 1
+    assert after["error"] == "TransportFault: injected"
+    # the cost did not heal: every watermark holds its last value
+    for key in ("ledger_bytes", "goodput_fraction", "overhead_ratio"):
+        assert after[key] == before[key]
+
+
+def test_collector_exports_labeled_counters_and_skips_none(obs_enabled):
+    WIRECOST.account("change", "l", "tx", 90, 10, frames=3)
+    snap = obs_metrics.snapshot()
+    assert snap["counters"]["wire.cost.bytes{link=l,dir=tx,class=change}"] \
+        == 90
+    assert snap["counters"][
+        "wire.cost.bytes{link=l,dir=tx,class=framing}"] == 10
+    assert snap["counters"][
+        "wire.cost.frames{link=l,dir=tx,class=change}"] == 3
+    assert snap["gauges"]["wire.cost.goodput_fraction{link=l,dir=tx}"] \
+        == pytest.approx(0.9)
+    # transport unknown: the residual gauge must be ABSENT, not 0
+    assert "wire.cost.residual_bytes{link=l,dir=tx}" not in snap["gauges"]
+
+
+def test_amplification_view_and_gauge(obs_enabled):
+    WIRECOST.note_source("fan", 100)
+    WIRECOST.note_delivered("fan", "p1", 100)
+    WIRECOST.note_delivered("fan", "p2", 100)
+    amp = WIRECOST.snapshot()["amplification"]["fan"]
+    assert amp["source_bytes"] == 100
+    assert amp["delivered_bytes"] == 200
+    assert amp["peers"] == {"p1": 100, "p2": 100}
+    assert amp["amplification"] == pytest.approx(2.0)
+    snap = obs_metrics.snapshot()
+    assert snap["gauges"]["wire.cost.amplification{link=fan}"] \
+        == pytest.approx(2.0)
+    assert snap["counters"][
+        "wire.cost.delivered_bytes{link=fan,peer=p1}"] == 100
+
+
+def test_snapshot_is_jsonable(obs_enabled):
+    WIRECOST.account("snapshot", "l", "tx", 10, 2)
+    WIRECOST.note_dataset("l", "tx", 1000)
+    WIRECOST.note_source("fan", 10)
+    json.dumps(WIRECOST.snapshot())
+
+
+# -- session tiling (direct feed: ledger vs encoder/decoder cursors) ----------
+
+
+def test_session_ledger_tiles_encoder_and_decoder_exactly(obs_enabled):
+    wire, enc = _build_wire(random.Random(7))
+    tx = _ledger("session", "tx")
+    assert tx["ledger_bytes"] == enc.bytes == len(wire)
+    assert set(tx["classes"]) == {"change", "change_batch", "blob"}
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+    dec.blob(lambda blob, done: blob.collect(lambda _d: done()))
+    for off in range(0, len(wire), 97):
+        dec.write(wire[off:off + 97])
+    rx = _ledger("session", "rx")
+    assert rx["ledger_bytes"] == dec.bytes == len(wire)
+    # class-by-class: both ends attributed the SAME frames
+    for cls in tx["classes"]:
+        assert tx["classes"][cls]["payload"] + tx["classes"][cls][
+            "framing"] == rx["classes"][cls]["payload"] + rx["classes"][
+            cls]["framing"], cls
+
+
+def test_batch_savings_sender_equals_receiver(obs_enabled):
+    """Satellite 1: the decoder recomputes the batch savings from the
+    decoded columns with the SAME estimate arithmetic the encoder used
+    pre-encode — the cross-check is an equality, not a proxy."""
+    e = protocol.encode(peer_caps=CAP_CHANGE_BATCH)
+    # rows sharing one subset tag: the columnar shape the batch frame
+    # actually compresses (the tag is encoded once, not per row)
+    e.change_many([{"key": f"k{i}", "change": i, "from": 0, "to": 1,
+                    "value": b"v" * (i % 9),
+                    "subset": "dataset/shared-tag"} for i in range(60)])
+    e.finalize()
+    chunks = []
+    while True:
+        d = e.read(4096)
+        if d is None:
+            break
+        if d:
+            chunks.append(d)
+    wire = b"".join(chunks)
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+    dec.write(wire)
+    tx, rx = _ledger("session", "tx"), _ledger("session", "rx")
+    assert tx["batch_saved_bytes"] > 0
+    assert tx["batch_saved_bytes"] == rx["batch_saved_bytes"]
+    snap = obs_metrics.snapshot()
+    assert snap["counters"]["wire.batch.bytes_saved"] == \
+        snap["counters"]["wire.batch.bytes_saved_rx"]
+
+
+def test_decoder_failure_is_recorded_on_the_ledger(obs_enabled):
+    from dat_replication_protocol_tpu.wire import frame
+    dec = protocol.decode()
+    errs = []
+    dec.on_error(lambda e: errs.append(e))
+    dec.write(frame(7, b"xx"))  # unknown type id: structured wire error
+    assert dec.destroyed and errs
+    rec = WIRECOST.snapshot()["links"].get("session|rx")
+    assert rec is not None and rec["failures"] >= 1
+    assert "unknown type" in rec["error"]
+
+
+# -- the chaos oracle (20 seeds: session + fanout + gossip) -------------------
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_chaos_ledger_exactly_tiles_the_wire(obs_enabled, seed):
+    rng = random.Random(seed)
+
+    # session leg: a faulty, resuming transport — at convergence the
+    # receive ledger covers every wire byte exactly once
+    wire, enc = _build_wire(rng)
+    assert _ledger("session", "tx")["ledger_bytes"] == len(wire)
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+    dec.blob(lambda blob, done: blob.collect(lambda _d: done()))
+
+    def source(ckpt, failures):
+        plan = FaultPlan(
+            seed=seed * 31 + failures, max_segment=64,
+            drop_at=(len(wire) // 2 - ckpt.wire_offset)
+            if failures == 0 else None)
+        return FaultyReader(bytes_reader(wire[ckpt.wire_offset:]), plan)
+
+    stats = run_resumable(source, dec,
+                          BackoffPolicy(base=0, max_retries=3, seed=1),
+                          expected_total=len(wire))
+    assert stats["reconnects"] == 1
+    rx = _ledger("session", "rx")
+    assert rx["ledger_bytes"] == len(wire), \
+        f"seed {seed}: rx ledger {rx['ledger_bytes']} != wire {len(wire)}"
+
+    # fanout leg: source intake vs per-peer delivered — amplification
+    # is exactly the peer count once every peer drained
+    n_peers = rng.randrange(2, 5)
+    srv = FanoutServer(stall_timeout=10.0)
+    try:
+        bufs = [bytearray() for _ in range(n_peers)]
+        def _sink(buf):
+            def sink(views):
+                n = 0
+                for v in views:
+                    buf.extend(bytes(v))
+                    n += len(v)
+                return n
+            return sink
+        peers = [srv.attach_peer(f"p{i}", sink=_sink(bufs[i]))
+                 for i in range(n_peers)]
+        step = rng.randrange(500, 4000)
+        for off in range(0, len(wire), step):
+            srv.publish(wire[off:off + step])
+        srv.seal()
+        assert srv.drain(15)
+        for p in peers:
+            assert p.wait_done(5)
+    finally:
+        srv.close()
+    amp = WIRECOST.snapshot()["amplification"]["fanout"]
+    assert amp["source_bytes"] == len(wire)
+    assert amp["delivered_bytes"] == n_peers * len(wire)
+    assert amp["amplification"] == pytest.approx(n_peers)
+
+    # gossip leg: the exchange's own wire meter is the ground truth —
+    # reconcile + repair-batch classes tile it, residual exactly 0
+    lo = rng.randrange(0, 30)
+    a = ReplicaNode("a", _recs(lo, lo + 40))
+    b = ReplicaNode("b", _recs(lo + 20, lo + 60))
+    res = gossip_exchange(a, b)
+    assert res["ok"]
+    for link in ("a->b", "b->a"):
+        rec = _ledger(link, "tx")
+        assert rec["residual_bytes"] == 0, f"seed {seed} link {link}"
+        assert rec["transport_bytes"] > 0
+
+    # fault arm: a dropped exchange keeps the last watermark and bumps
+    # failures — the ledger never heals itself on a fault
+    before = _ledger("a->b", "tx")
+    with pytest.raises(TransportFault):
+        gossip_exchange(a, b, plan_out=FaultPlan(seed=seed, drop_at=10))
+    after = _ledger("a->b", "tx")
+    assert after["failures"] == before["failures"] + 1
+    assert after["ledger_bytes"] == before["ledger_bytes"]
+    assert after["goodput_fraction"] == before["goodput_fraction"]
+
+
+# -- dark-twin bytecode discipline (the PR 18/19 contract) --------------------
+
+
+def _all_names(code) -> set:
+    names = set(code.co_names)
+    for c in code.co_consts:
+        if isinstance(c, types.CodeType):
+            names |= _all_names(c)
+    return names
+
+
+# every forked hot path: its bytecode (closures included) must reference
+# no symbol of the wirecost module — the dark cost of the whole plane is
+# one attribute load per fork point
+DARK_TWINS = [
+    encoder_mod.Encoder.flush_batch,
+    encoder_mod.Encoder.change_many,
+    encoder_mod.Encoder._frame_change,
+    encoder_mod.Encoder.reconcile_frame,
+    encoder_mod.Encoder.snapshot_frame,
+    encoder_mod.Encoder.blob,
+    encoder_mod.BlobWriter._uncork,
+    decoder_mod.Decoder._deliver_change,
+    decoder_mod.Decoder._finish_change_batch,
+    decoder_mod.Decoder._dispatch_changes_fast,
+    decoder_mod.Decoder._run_indexed,
+    decoder_mod.Decoder.write_indexed,
+    decoder_mod.Decoder._finish_reconcile,
+    decoder_mod.Decoder._finish_snapshot,
+    decoder_mod.Decoder._open_blob_if_ready,
+    decoder_mod.Decoder._protocol_error,
+    pump_mod.recv_pump,
+    pump_mod.send_pump,
+    pump_mod.recv_step,
+    pump_mod.send_step,
+    pump_mod._recv_step_py,
+    pump_mod._send_step_impl,
+    fanout_server.FanoutServer.publish,
+    fanout_server.FanoutServer._serve_peer,
+    cluster_node._exchange,
+]
+
+# the lit twins: each MUST reference the wirecost module — proof the
+# fork actually routes cost recording through them
+LIT_TWINS = [
+    encoder_mod.Encoder._lit_cost_change,
+    encoder_mod.Encoder._lit_cost_batch,
+    encoder_mod.Encoder._lit_cost_reconcile,
+    encoder_mod.Encoder._lit_cost_snapshot,
+    encoder_mod.Encoder._lit_cost_blob,
+    decoder_mod.Decoder._lit_cost_change,
+    decoder_mod.Decoder._lit_cost_change_run,
+    decoder_mod.Decoder._lit_cost_batch,
+    decoder_mod.Decoder._lit_cost_reconcile,
+    decoder_mod.Decoder._lit_cost_snapshot,
+    decoder_mod.Decoder._lit_cost_blob,
+    decoder_mod.Decoder._lit_cost_failure,
+    pump_mod._lit_rx,
+    pump_mod._lit_tx,
+    fanout_server.FanoutServer._lit_cost_published,
+    fanout_server.FanoutServer._lit_cost_served,
+    cluster_node._exchange_lit,
+]
+
+
+@pytest.mark.parametrize(
+    "fn", DARK_TWINS,
+    ids=[f.__qualname__ for f in DARK_TWINS])
+def test_hot_path_bytecode_references_no_wirecost_symbol(fn):
+    names = _all_names(fn.__code__)
+    assert not any("wirecost" in n for n in names), \
+        f"{fn.__qualname__} references {sorted(n for n in names if 'wirecost' in n)}"
+
+
+@pytest.mark.parametrize(
+    "fn", LIT_TWINS,
+    ids=[f.__qualname__ for f in LIT_TWINS])
+def test_lit_twin_bytecode_references_wirecost(fn):
+    assert any("wirecost" in n for n in _all_names(fn.__code__)), \
+        f"{fn.__qualname__} never reaches the wirecost board"
+
+
+def test_dark_path_records_nothing(obs_enabled):
+    obs_metrics.OBS.on = False
+    wire, _enc = _build_wire(random.Random(1))
+    dec = protocol.decode()
+    dec.change(lambda c, done: done())
+    dec.blob(lambda blob, done: blob.collect(lambda _d: done()))
+    dec.write(wire)
+    snap = WIRECOST.snapshot()
+    assert snap["links"] == {} and snap["amplification"] == {}
+
+
+# -- sidecar presence gating + fleet cost-matrix SLO --------------------------
+
+
+def test_sidecar_snapshot_gates_wirecost_on_presence(obs_enabled):
+    from dat_replication_protocol_tpu import sidecar
+    assert "wirecost" not in sidecar.snapshot_stats()
+    WIRECOST.account("change", "s", "tx", 10, 2)
+    assert "wirecost" in sidecar.snapshot_stats()
+    assert "s|tx" in sidecar.snapshot_stats()["wirecost"]["links"]
+
+
+def _target_with(wc):
+    snap = {"ts": 0.0, "monotonic": 0.0,
+            "metrics": {"counters": {}, "gauges": {}},
+            "events_dropped": 0, "jit_sites": {},
+            "watermarks": {"cursors": {}, "marks": {}}}
+    if wc is not None:
+        snap["wirecost"] = wc
+    return lambda: snap
+
+
+def test_fleet_slo_passes_on_clean_cost_matrix(obs_enabled):
+    a = ReplicaNode("a", _recs(0, 40))
+    b = ReplicaNode("b", _recs(20, 60))
+    gossip_exchange(a, b)
+    WIRECOST.note_source("fanout", 500)
+    WIRECOST.note_delivered("fanout", "p1", 500)
+    view = fleet.FleetView([_target_with(WIRECOST.snapshot())])
+    sample = view.poll()
+    rows = fleet.evaluate_slo(
+        {"min_goodput_fraction": 0.5, "max_overhead_ratio": 0.5,
+         "max_egress_bytes_per_peer": 10_000}, sample)
+    assert rows and all(r["status"] == "ok" for r in rows)
+    checks = {r["check"] for r in rows}
+    assert checks == {"min_goodput_fraction", "max_overhead_ratio",
+                      "max_egress_bytes_per_peer"}
+    # the dashboard renders the cost matrix
+    frame = fleet.render_dashboard(view, sample)
+    assert "cost link" in frame and "amplification fanout" in frame
+
+
+def test_fleet_slo_names_the_doctored_link(obs_enabled):
+    wc = {"links": {"bad|tx": {
+        "classes": {"change": {"payload": 10, "framing": 90, "frames": 9}},
+        "ledger_bytes": 100, "payload_bytes": 10, "framing_bytes": 90,
+        "goodput_fraction": 0.1, "overhead_ratio": 0.9,
+        "batch_saved_bytes": 0, "residual_bytes": 0,
+        "transport_bytes": 100, "failures": 0}}, "amplification": {}}
+    sample = fleet.FleetView([_target_with(wc)]).poll()
+    rows = fleet.evaluate_slo(
+        {"min_goodput_fraction": 0.5, "max_overhead_ratio": 0.5}, sample)
+    fails = [r for r in rows if r["status"] == "fail"]
+    assert len(fails) == 2
+    assert all(r["subject"] == "bad|tx" for r in fails)
+
+
+def test_fleet_slo_fails_loud_when_cost_plane_dark(obs_enabled):
+    sample = fleet.FleetView([_target_with(None)]).poll()
+    for slo in ({"min_goodput_fraction": 0.5},
+                {"max_overhead_ratio": 0.5},
+                {"max_egress_bytes_per_peer": 100}):
+        rows = fleet.evaluate_slo(slo, sample)
+        assert any(r["check"] == "wirecost" and r["status"] == "fail"
+                   for r in rows), slo
+
+
+def test_fleet_slo_fails_on_unknown_ratio_not_passes(obs_enabled):
+    # a link with no bytes attributed: ratio None — evaluated as a
+    # failure, never a free pass (unknown is not zero)
+    wc = {"links": {"mute|rx": {
+        "classes": {}, "ledger_bytes": 0, "payload_bytes": 0,
+        "framing_bytes": 0, "goodput_fraction": None,
+        "overhead_ratio": None, "batch_saved_bytes": 0,
+        "residual_bytes": None, "transport_bytes": 0, "failures": 0}},
+        "amplification": {}}
+    sample = fleet.FleetView([_target_with(wc)]).poll()
+    rows = fleet.evaluate_slo({"min_goodput_fraction": 0.1}, sample)
+    assert any(r["status"] == "fail" and r["subject"] == "mute|rx"
+               for r in rows)
+
+
+def test_load_slo_validates_cost_keys(tmp_path):
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps({"min_goodput_fraction": 1.5}))
+    with pytest.raises(ValueError, match="unreachable"):
+        fleet.load_slo(str(p))
+    p.write_text(json.dumps({"max_overhead_ratio": "high"}))
+    with pytest.raises(ValueError, match="number"):
+        fleet.load_slo(str(p))
+    p.write_text(json.dumps({"max_egress_bytes_per_peer": 1_000_000,
+                             "min_goodput_fraction": 0.8}))
+    slo = fleet.load_slo(str(p))
+    assert slo["min_goodput_fraction"] == 0.8
